@@ -1,0 +1,21 @@
+"""solvingpapers_tpu — a TPU-native (JAX/Flax/optax/pjit/Pallas) framework
+with the capabilities of the `prashantpandeygit/solvingpapers` reference
+collection (GPT, LLaMA3, Gemma, DeepSeekV3 MLA+MoE+MTP, ViT, AlexNet,
+autoencoder/VAE, knowledge distillation, attention primitives), rebuilt as
+one shared framework: a single ops library, one training engine, jitted
+cached inference, and mesh/sharding parallelism over TPU ICI/DCN.
+
+Layout (see SURVEY.md §7):
+    ops/        shared primitives: norms, RoPE, activations, attention, losses, sampling
+    kernels/    Pallas TPU kernels + pure-jnp references
+    sharding/   mesh construction, partition rules, collective wrappers
+    models/     Flax model zoo
+    data/       tokenizers + dataset/batch pipelines
+    train/      the single training engine
+    infer/      jitted prefill/decode with KV caches
+    checkpoint/ Orbax checkpoint manager + params-only export
+    metrics/    console/JSONL metrics writers, MFU accounting
+    configs/    typed run configs for every workload
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,41 @@
+"""Shared primitive ops (L1).
+
+One implementation of each primitive the reference re-implements per
+notebook: norms, RoPE (both formulations), activations, attention cores,
+losses, and samplers.
+"""
+
+from solvingpapers_tpu.ops.norms import rms_norm, layer_norm
+from solvingpapers_tpu.ops.rope import (
+    precompute_rope,
+    precompute_freqs_cis,
+    apply_rope,
+    apply_rotary_emb_complex,
+    rope_rotation_matrix,
+)
+from solvingpapers_tpu.ops.activations import (
+    relu,
+    leaky_relu,
+    prelu,
+    elu,
+    gelu_tanh,
+    silu,
+    swish,
+)
+from solvingpapers_tpu.ops.attention import (
+    repeat_kv,
+    causal_mask,
+    dot_product_attention,
+    luong_attention,
+)
+from solvingpapers_tpu.ops.losses import (
+    cross_entropy,
+    distillation_loss,
+    vae_loss,
+    mtp_loss,
+)
+from solvingpapers_tpu.ops.sampling import (
+    sample_greedy,
+    sample_categorical,
+    sample_top_k,
+)

@@ -1,0 +1,108 @@
+"""Attention cores (pure-jnp reference path).
+
+Covers every attention variant in the reference from one implementation:
+  * vanilla causal MHA        (gpt/gpt-jax.ipynb cell 9)
+  * GQA with repeat_kv        (llama3/LLaMA-jax.ipynb cells 18, 24)
+  * MQA-grouped               (gemma/gemma.ipynb cell 8)
+  * bidirectional encoder MHA (vision transformer/ViT.ipynb cell 10)
+  * Luong dot-score attention (attention/luong.ipynb cell 1)
+
+MLA (latent attention) lives with the DeepSeekV3 model (models/deepseekv3.py)
+since its cache layout is model-specific. The Pallas flash-attention kernel
+(kernels/flash_attention.py) is a drop-in replacement for
+`dot_product_attention`; this module is the numerics reference for it.
+
+Layout convention: (batch, seq, num_heads, head_dim) — "BSNH". This keeps
+the sequence axis adjacent to batch for sequence sharding and lets XLA pick
+MXU-friendly contractions via dot_general.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+BIG_NEG = -2.0**30  # mask fill; finite to keep softmax NaN-free in bf16/f32
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """(B, S, n_kv, H) -> (B, S, n_kv * n_rep, H), repeating each kv head.
+
+    Single shared impl of llama3/LLaMA-jax.ipynb cell 18.
+    """
+    if n_rep == 1:
+        return x
+    b, s, n_kv, h = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, n_kv, n_rep, h))
+    return x.reshape(b, s, n_kv * n_rep, h)
+
+
+def causal_mask(q_len: int, kv_len: int, dtype: jnp.dtype = jnp.bool_) -> jax.Array:
+    """(q_len, kv_len) lower-triangular mask aligned to the *end* of the kv axis.
+
+    With kv_len > q_len (cached decode), query i attends to kv positions
+    [0, kv_len - q_len + i].
+    """
+    q_idx = jnp.arange(q_len)[:, None]
+    kv_idx = jnp.arange(kv_len)[None, :]
+    return (kv_idx <= q_idx + (kv_len - q_len)).astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: jax.Array | None = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Scaled dot-product attention over BSNH tensors.
+
+    q: (B, Sq, N, H); k, v: (B, Skv, Nkv, H) with N % Nkv == 0 (GQA/MQA
+    handled by repeating kv heads). `mask` is broadcastable to
+    (B, N, Sq, Skv), True = attend. Softmax is computed in float32.
+    """
+    n, n_kv = q.shape[-2], k.shape[-2]
+    if n != n_kv:
+        if n % n_kv:
+            raise ValueError(f"num q heads {n} not a multiple of kv heads {n_kv}")
+        k = repeat_kv(k, n // n_kv)
+        v = repeat_kv(v, n // n_kv)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # (B, N, Sq, Skv)
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        cmask = causal_mask(q.shape[1], k.shape[1])
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+    if mask is not None:
+        scores = jnp.where(mask, scores, BIG_NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        if dropout_rng is None:
+            raise ValueError("dropout_rng is required when dropout is active")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def luong_attention(
+    decoder_state: jax.Array, encoder_states: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Luong global (dot-score) attention — attention/luong.ipynb cell 1.
+
+    decoder_state:  (B, D)        current decoder hidden state
+    encoder_states: (B, T, D)     encoder outputs over source time
+    Returns (context (B, D), weights (B, T)).
+    """
+    scores = jnp.einsum("bd,btd->bt", decoder_state, encoder_states)
+    weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        encoder_states.dtype
+    )
+    context = jnp.einsum("bt,btd->bd", weights, encoder_states)
+    return context, weights

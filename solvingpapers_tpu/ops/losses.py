@@ -1,0 +1,109 @@
+"""Loss functions.
+
+One shared implementation of every loss in the reference:
+  * token cross-entropy             (gpt/gpt-jax.ipynb cell 13; manual
+                                     log-softmax gather llama3 cell 28)
+  * CE with ignore_index            (deepseekv3/deepseekv3.ipynb cell 54)
+  * multi-token-prediction loss     (deepseekv3 cell 46)
+  * distillation CE + T^2*KL        (knowledge distillation/kd.py:48-68)
+  * VAE summed BCE + analytic KL    (autoencoder/variational autoencoder.ipynb cell 6)
+  * classification CE / MSE         (ViT cell 13; autoencoder cell 6 — via
+                                     cross_entropy / plain jnp mean-square)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: int | None = None,
+) -> jax.Array:
+    """Mean cross-entropy of integer labels; optionally masks ignore_index.
+
+    logits: (..., V); labels: (...) int. Computed in float32.
+    """
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    if ignore_index is None:
+        nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+    valid = labels != ignore_index
+    # Gather with sanitized indices: take_along_axis uses fill-mode for OOB
+    # indices, so a sentinel like -100 gathers NaN, and NaN * 0 mask = NaN.
+    safe = jnp.where(valid, labels, 0)
+    nll = -jnp.take_along_axis(log_probs, safe[..., None], axis=-1)[..., 0]
+    mask = valid.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def distillation_loss(
+    student_logits: jax.Array,
+    teacher_logits: jax.Array,
+    labels: jax.Array,
+    temperature: float = 7.0,
+    alpha: float = 0.3,
+) -> jax.Array:
+    """Hinton KD loss: alpha*CE(student, labels) + (1-alpha)*T^2*KL(teacher||student).
+
+    Matches knowledge distillation/kd.py:48-68 (T=7, alpha=0.3): KL of
+    temperature-softened distributions, scaled by T^2 to keep gradient
+    magnitude comparable to the CE term.
+    """
+    hard = cross_entropy(student_logits, labels)
+    t = temperature
+    s_log = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    t_prob = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    # batchmean KL(teacher || student)
+    kl = jnp.sum(t_prob * (jnp.log(jnp.maximum(t_prob, 1e-12)) - s_log), axis=-1)
+    soft = jnp.mean(kl) * (t * t)
+    return alpha * hard + (1.0 - alpha) * soft
+
+
+def vae_loss(
+    recon: jax.Array,
+    target: jax.Array,
+    mu: jax.Array,
+    logvar: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Summed BCE reconstruction + analytic KL to N(0, I).
+
+    Matches autoencoder/variational autoencoder.ipynb cell 6 (sum
+    reduction, per batch). `recon` is post-sigmoid probabilities in (0,1).
+    Returns (total, bce, kl).
+    """
+    recon32 = jnp.clip(recon.astype(jnp.float32), 1e-7, 1.0 - 1e-7)
+    target32 = target.astype(jnp.float32)
+    bce = -jnp.sum(
+        target32 * jnp.log(recon32) + (1.0 - target32) * jnp.log(1.0 - recon32)
+    )
+    kl = -0.5 * jnp.sum(1.0 + logvar - jnp.square(mu) - jnp.exp(logvar))
+    return bce + kl, bce, kl
+
+
+def mtp_loss(
+    logits: jax.Array,
+    tokens: jax.Array,
+    num_heads: int,
+    ignore_index: int | None = None,
+) -> jax.Array:
+    """Multi-token-prediction loss (deepseekv3/deepseekv3.ipynb cell 46).
+
+    logits: (B, T, K, V) where head k at position i predicts token i+k+1.
+    tokens: (B, T + K) raw token stream providing the shifted targets.
+    Flat mean CE over all (position, head) pairs with valid targets.
+    """
+    b, t, k, v = logits.shape
+    assert k == num_heads
+    if tokens.shape[-1] != t + k:
+        raise ValueError(
+            f"tokens must have T+K={t + k} columns to provide shifted targets, "
+            f"got {tokens.shape[-1]}"
+        )
+    # targets[b, i, k] = tokens[b, i + k + 1]
+    idx = jnp.arange(t)[:, None] + jnp.arange(1, k + 1)[None, :]
+    targets = tokens[:, idx]  # (B, T, K)
+    return cross_entropy(logits.reshape(b * t * k, v), targets.reshape(-1), ignore_index)

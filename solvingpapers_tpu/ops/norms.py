@@ -1,0 +1,46 @@
+"""Normalization primitives.
+
+Single shared implementation replacing the reference's three independent
+RMSNorm impls (llama3/LLaMA-jax.ipynb cell 15, gemma/gemma.ipynb cell 6,
+deepseekv3/deepseekv3.ipynb cell 19) and its LayerNorm usages
+(gpt/gpt-jax.ipynb cell 11, vision transformer/ViT.ipynb cell 10).
+
+TPU notes: statistics are computed in float32 regardless of input dtype
+(bf16-safe), and the result is cast back to the input dtype so the op can
+sit inside a bf16 matmul chain without precision loss in the reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array | None = None, eps: float = 1e-6) -> jax.Array:
+    """Root-mean-square normalization: x / sqrt(mean(x^2) + eps) * weight."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def layer_norm(
+    x: jax.Array,
+    weight: jax.Array | None = None,
+    bias: jax.Array | None = None,
+    eps: float = 1e-5,
+) -> jax.Array:
+    """LayerNorm over the last axis with optional affine transform."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)

@@ -1,0 +1,46 @@
+"""Token samplers.
+
+Single-step sampling functions (logits -> token ids) shared by all decode
+loops, covering the reference's four decoding strategies:
+  * greedy argmax           (gpt/gpt-jax.ipynb cell 19)
+  * categorical sampling    (llama3/LLaMA-jax.ipynb cell 14)
+  * multinomial             (gemma/gemma.ipynb cell 20 — same as categorical)
+  * temperature + top-k     (deepseekv3/deepseekv3.ipynb cell 40)
+
+All are jit-safe (static shapes, no python branching on values) so they can
+live inside a lax.while_loop/scan decode body (infer/decode.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_greedy(logits: jax.Array, rng: jax.Array | None = None) -> jax.Array:
+    """Argmax over the last axis. rng accepted (ignored) for API uniformity."""
+    del rng
+    return jnp.argmax(logits, axis=-1)
+
+
+def sample_categorical(
+    logits: jax.Array, rng: jax.Array, temperature: float = 1.0
+) -> jax.Array:
+    return jax.random.categorical(rng, logits.astype(jnp.float32) / temperature, axis=-1)
+
+
+def sample_top_k(
+    logits: jax.Array,
+    rng: jax.Array,
+    k: int = 50,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """Temperature + top-k sampling: mask all but the k largest logits, sample.
+
+    Static k (jit-friendly): uses lax.top_k threshold rather than a sort.
+    """
+    logits = logits.astype(jnp.float32) / temperature
+    top_vals, _ = jax.lax.top_k(logits, k)
+    thresh = top_vals[..., -1:]
+    masked = jnp.where(logits >= thresh, logits, -jnp.inf)
+    return jax.random.categorical(rng, masked, axis=-1)

@@ -1,0 +1,28 @@
+"""Test environment: force an 8-device CPU platform before JAX initializes.
+
+This is the TPU-world substitute for a fake distributed backend
+(SURVEY.md §4): all sharding/collective tests run against a virtual
+8-device host mesh.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The hosting environment pins JAX_PLATFORMS=axon (real TPU) via sitecustomize;
+# the config update is what actually wins after import.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

@@ -1,0 +1,203 @@
+"""Unit tests for the shared ops library against closed-form references.
+
+Implements the SURVEY.md §4 plan: RoPE complex vs. cos/sin vs. rotation
+matrix must agree; norms vs. NumPy; losses vs. manual formulas.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu import ops
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(4, 7, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    got = ops.rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6)
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_rms_norm_bf16_stats_in_f32():
+    x = jnp.full((2, 8), 3.0, dtype=jnp.bfloat16)
+    y = ops.rms_norm(x)
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y, np.float32), 1.0, rtol=1e-2)
+
+
+def test_layer_norm_matches_numpy():
+    x = np.random.default_rng(2).normal(size=(3, 5, 12)).astype(np.float32)
+    w = np.random.default_rng(3).normal(size=(12,)).astype(np.float32)
+    b = np.random.default_rng(4).normal(size=(12,)).astype(np.float32)
+    got = ops.layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), eps=1e-5)
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    want = (x - mean) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("theta", [10000.0, 100000.0])
+def test_rope_three_formulations_agree(theta):
+    head_dim, seq, heads = 16, 12, 3
+    x = jax.random.normal(jax.random.key(0), (2, seq, heads, head_dim))
+
+    cos, sin = ops.precompute_rope(head_dim, seq, theta)
+    got = ops.apply_rope(x, cos, sin)
+
+    freqs_cis = ops.precompute_freqs_cis(head_dim, seq, theta)
+    want_complex = ops.apply_rotary_emb_complex(x, freqs_cis)
+    np.testing.assert_allclose(got, want_complex, rtol=1e-5, atol=1e-5)
+
+    mats = ops.rope_rotation_matrix(head_dim, seq, theta)
+    want_matrix = jnp.einsum("tij,bthj->bthi", mats, x)
+    np.testing.assert_allclose(got, want_matrix, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_positions_slice_equals_prefix():
+    """Decoding one token at offset p must equal position p of the full roll."""
+    head_dim, seq = 8, 10
+    x = jax.random.normal(jax.random.key(1), (1, seq, 2, head_dim))
+    cos, sin = ops.precompute_rope(head_dim, seq)
+    full = ops.apply_rope(x, cos, sin)
+    p = 7
+    one = ops.apply_rope(x[:, p : p + 1], cos, sin, positions=jnp.array([p]))
+    np.testing.assert_allclose(one[:, 0], full[:, p], rtol=1e-6, atol=1e-6)
+
+
+def test_repeat_kv():
+    x = jnp.arange(2 * 3 * 2 * 4).reshape(2, 3, 2, 4).astype(jnp.float32)
+    y = ops.repeat_kv(x, 3)
+    assert y.shape == (2, 3, 6, 4)
+    # each kv head appears n_rep consecutive times
+    np.testing.assert_array_equal(y[:, :, 0], y[:, :, 2])
+    np.testing.assert_array_equal(y[:, :, 3], y[:, :, 5])
+    assert not np.array_equal(np.asarray(y[:, :, 0]), np.asarray(y[:, :, 3]))
+
+
+def test_causal_attention_matches_manual():
+    b, s, n, h = 2, 6, 2, 8
+    rng = jax.random.key(2)
+    q, k, v = jax.random.normal(rng, (3, b, s, n, h))
+    got = ops.dot_product_attention(q, k, v, causal=True)
+    # manual per-head softmax with tril mask
+    scores = np.einsum("bqnh,bknh->bnqk", q, k) / np.sqrt(h)
+    mask = np.tril(np.ones((s, s), bool))
+    scores = np.where(mask, scores, -1e30)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.einsum("bnqk,bknh->bqnh", probs, v)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gqa_equals_explicit_repeat():
+    b, s, n, n_kv, h = 1, 5, 4, 2, 8
+    q = jax.random.normal(jax.random.key(3), (b, s, n, h))
+    k = jax.random.normal(jax.random.key(4), (b, s, n_kv, h))
+    v = jax.random.normal(jax.random.key(5), (b, s, n_kv, h))
+    got = ops.dot_product_attention(q, k, v, causal=True)
+    want = ops.dot_product_attention(
+        q, ops.repeat_kv(k, 2), ops.repeat_kv(v, 2), causal=True
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_cached_decode_mask_alignment():
+    """causal_mask with kv_len > q_len lets the last query see everything."""
+    m = ops.causal_mask(1, 5)
+    np.testing.assert_array_equal(np.asarray(m), np.ones((1, 5), bool))
+    m2 = ops.causal_mask(2, 5)
+    np.testing.assert_array_equal(np.asarray(m2[0]), [1, 1, 1, 1, 0])
+
+
+def test_luong_attention():
+    b, t, d = 2, 4, 6
+    st = jax.random.normal(jax.random.key(6), (b, d))
+    hs = jax.random.normal(jax.random.key(7), (b, t, d))
+    ctx, w = ops.luong_attention(st, hs)
+    assert ctx.shape == (b, d) and w.shape == (b, t)
+    np.testing.assert_allclose(np.asarray(w).sum(-1), 1.0, rtol=1e-5)
+    scores = np.einsum("bd,btd->bt", st, hs)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    np.testing.assert_allclose(w, e / e.sum(-1, keepdims=True), rtol=1e-5, atol=1e-6)
+
+
+def test_cross_entropy_matches_manual_log_softmax():
+    logits = jax.random.normal(jax.random.key(8), (4, 9))
+    labels = jnp.array([0, 3, 8, 2])
+    got = ops.cross_entropy(logits, labels)
+    lp = np.asarray(jax.nn.log_softmax(logits, -1))
+    want = -lp[np.arange(4), np.asarray(labels)].mean()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jax.random.normal(jax.random.key(9), (4, 9))
+    labels = jnp.array([0, 3, -100, 2])
+    got = ops.cross_entropy(logits, labels, ignore_index=-100)
+    lp = np.asarray(jax.nn.log_softmax(logits, -1))
+    want = -lp[[0, 1, 3], [0, 3, 2]].mean()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_distillation_loss_limits():
+    """alpha=1 reduces to plain CE; identical logits give ~zero KL term."""
+    s = jax.random.normal(jax.random.key(10), (6, 10))
+    t = jax.random.normal(jax.random.key(11), (6, 10))
+    labels = jnp.arange(6)
+    np.testing.assert_allclose(
+        ops.distillation_loss(s, t, labels, alpha=1.0),
+        ops.cross_entropy(s, labels),
+        rtol=1e-6,
+    )
+    same = ops.distillation_loss(s, s, labels, temperature=7.0, alpha=0.0)
+    np.testing.assert_allclose(same, 0.0, atol=1e-5)
+
+
+def test_vae_loss_components():
+    mu = jnp.zeros((2, 3))
+    logvar = jnp.zeros((2, 3))
+    x = jnp.full((2, 4), 0.5)
+    recon = jnp.full((2, 4), 0.5)
+    total, bce, kl = ops.vae_loss(recon, x, mu, logvar)
+    np.testing.assert_allclose(kl, 0.0, atol=1e-6)
+    np.testing.assert_allclose(bce, -8 * np.log(0.5), rtol=1e-5)
+    np.testing.assert_allclose(total, bce + kl, rtol=1e-6)
+
+
+def test_mtp_loss_gathers_correct_targets():
+    b, t, k, v = 1, 3, 2, 5
+    tokens = jnp.arange(t + k)[None, :] % v
+    # logits that put all mass on the correct target => loss ~ 0
+    idx = np.arange(t)[:, None] + np.arange(1, k + 1)[None, :]
+    targets = np.asarray(tokens)[0][idx]
+    logits = np.full((b, t, k, v), -30.0, np.float32)
+    for i in range(t):
+        for j in range(k):
+            logits[0, i, j, targets[i, j]] = 30.0
+    loss = ops.mtp_loss(jnp.asarray(logits), tokens, num_heads=k)
+    assert float(loss) < 1e-3
+
+
+def test_activations_closed_form():
+    x = jnp.linspace(-3, 3, 13)
+    np.testing.assert_allclose(ops.relu(x), np.maximum(x, 0))
+    np.testing.assert_allclose(ops.leaky_relu(x, 0.1), np.where(x >= 0, x, 0.1 * x))
+    np.testing.assert_allclose(ops.elu(x), np.where(x >= 0, x, np.expm1(x)), rtol=1e-6)
+    np.testing.assert_allclose(ops.silu(x), x / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(ops.swish(x, 1.0), ops.silu(x), rtol=1e-6)
+    # tanh-approx GELU tracks exact GELU to ~1e-3
+    exact = np.asarray(jax.nn.gelu(x, approximate=False))
+    np.testing.assert_allclose(ops.gelu_tanh(x), exact, atol=2e-3)
+
+
+def test_samplers():
+    logits = jnp.array([[0.0, 10.0, -5.0, 3.0]])
+    assert int(ops.sample_greedy(logits)[0]) == 1
+    rng = jax.random.key(12)
+    tok = ops.sample_top_k(logits, rng, k=2, temperature=1.0)
+    assert int(tok[0]) in (1, 3)  # only top-2 logits survive
+    # categorical at tiny temperature is effectively greedy
+    tok2 = ops.sample_categorical(logits, rng, temperature=1e-4)
+    assert int(tok2[0]) == 1
