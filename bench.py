@@ -1,0 +1,82 @@
+"""Benchmark of record — prints ONE JSON line.
+
+Workload: the reference's own GPT char-LM training config
+(gpt/gpt-jax.ipynb cell 8: batch 128 x block 256 = 32,768 tok/step,
+dim 256, 1 head, 8 layers), trained with AdamW in bf16 on this repo's
+engine. Baseline: the reference's measured ~16.1k tok/s on its hardware
+(1x T4, BASELINE.md). Metric: steady-state training tokens/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.metrics.mfu import chip_peak_flops, transformer_flops_per_token
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    BASELINE_TOK_S = 16_100.0  # gpt-jax.ipynb cell 18 tqdm, 1x T4
+
+    cfg = GPTConfig(
+        vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=1,
+        dropout=0.1, dtype="bfloat16",
+    )
+    batch = 128
+    tcfg = TrainConfig(
+        steps=0, batch_size=batch, log_every=10_000, eval_every=0,
+        optimizer=OptimizerConfig(name="adamw", max_lr=1e-3, total_steps=1000),
+    )
+    trainer = Trainer(GPT(cfg), tcfg)
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=1_000_000)
+    it = lm_batch_iterator(toks, batch, cfg.block_size, seed=0)
+    b0 = next(it)
+    state = trainer.init_state(b0)
+    trainer._build_steps()
+
+    # compile + warmup; fence via value fetch (block_until_ready does not
+    # actually sync on the axon-tunnelled TPU platform)
+    for _ in range(5):
+        state, metrics = trainer._train_step(state, next(it))
+    float(jax.device_get(metrics["train_loss"]))
+
+    n_steps = 50
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = trainer._train_step(state, next(it))
+    float(jax.device_get(metrics["train_loss"]))
+    dt = time.perf_counter() - t0
+
+    tok_per_step = batch * cfg.block_size
+    tok_s = n_steps * tok_per_step / dt
+
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    fpt = transformer_flops_per_token(n_params, cfg.n_layers, cfg.dim, cfg.block_size)
+    mfu = tok_s * fpt / chip_peak_flops()
+
+    print(json.dumps({
+        "metric": "gpt_charlm_train_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "detail": {
+            "config": "gpt-jax.ipynb cell 8 (bs128 x block256, dim256, L8)",
+            "baseline": "16.1k tok/s on 1x T4 (reference cell 18)",
+            "step_time_ms": round(1000 * dt / n_steps, 2),
+            "mfu": round(mfu, 4),
+            "n_params": int(n_params),
+            "device": str(jax.devices()[0].device_kind),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

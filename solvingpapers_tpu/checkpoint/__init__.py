@@ -1,0 +1,3 @@
+"""Checkpointing (L7): Orbax manager + params-only export."""
+
+from solvingpapers_tpu.checkpoint.manager import CheckpointManager, export_params, load_params

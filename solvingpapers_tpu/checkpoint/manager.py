@@ -1,0 +1,68 @@
+"""One checkpoint system replacing the reference's three ad-hoc ones
+(pickle pytrees — llama3 cell 12; state_dict snapshots — gemma cell 18;
+{step, model, optimizer, loss} dicts with resume — deepseekv3 cell 50).
+
+Capabilities preserved: periodic + final cadence, full-state resume
+(params + optimizer + step), params-only export for weight publishing,
+load-for-inference. Backed by Orbax (sharded-array aware, async-capable);
+`keep_n` retention and restore-latest-at-startup give the preemption
+recovery workflow the reference performs by hand.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_n: int = 3, save_every: int = 1000):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.save_every = save_every
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep_n, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
+        if not force and (self.save_every <= 0 or step % self.save_every):
+            return False
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        self._mgr.wait_until_finished()
+        return True
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, abstract_state: Any) -> tuple[Any, int] | None:
+        """Restore the newest checkpoint, or None if the directory is empty.
+
+        `abstract_state` is a pytree of jax.ShapeDtypeStruct (or a concrete
+        state of the right structure/sharding) used as the restore template.
+        """
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        return restored, step
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def export_params(path: str, params: Any) -> None:
+    """Params-only export (the reference publishes bare weights to HF)."""
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_params(path: str, abstract_params: Any | None = None) -> Any:
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), abstract_params)

@@ -1,0 +1,152 @@
+"""CLI entrypoints — `python -m solvingpapers_tpu.cli <cmd>`.
+
+Replaces the reference's notebook cells with commands (BASELINE.json north
+star: "every notebook's train() cell becomes a CLI entrypoint"):
+
+    cli list
+    cli train  --config gpt_shakespeare [--steps N] [--data-path f.txt]
+               [--checkpoint-dir ckpts] [--jsonl metrics.jsonl]
+    cli sample --config gpt_shakespeare --checkpoint-dir ckpts
+               [--prompt "ROMEO:"] [--max-new-tokens 200] [--top-k 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--config", required=True)
+    p.add_argument("--data-path", default=None)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument(
+        "--platform",
+        default=None,
+        choices=["cpu", "tpu", "axon"],
+        help="force a JAX platform (the env pins the axon TPU; 'cpu' enables "
+        "local debugging and virtual multi-device meshes)",
+    )
+
+
+def _apply_platform(args) -> None:
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+
+
+def cmd_list(_args) -> int:
+    from solvingpapers_tpu.configs import list_configs
+
+    for name in list_configs():
+        print(name)
+    return 0
+
+
+def cmd_train(args) -> int:
+    _apply_platform(args)
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_char_lm_run
+    from solvingpapers_tpu.metrics import ConsoleWriter, JSONLWriter, MultiWriter
+    from solvingpapers_tpu.sharding import batch_sharding, create_mesh
+    from solvingpapers_tpu.train import Trainer
+
+    overrides = {}
+    if args.steps is not None:
+        overrides["steps"] = args.steps
+        # keep the LR schedule aligned with the actual horizon
+    if args.checkpoint_dir:
+        overrides["checkpoint_dir"] = args.checkpoint_dir
+        overrides["ckpt_every"] = args.ckpt_every
+    cfg = get_config(args.config, **overrides)
+    if args.data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
+
+    mesh = create_mesh(cfg.train.mesh)
+    cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
+        cfg, sharding=batch_sharding(mesh)
+    )
+    writer = ConsoleWriter()  # fit() gates cadence by log_every
+    if args.jsonl:
+        writer = MultiWriter(writer, JSONLWriter(args.jsonl))
+    trainer = Trainer(model, cfg.train, mesh=mesh)
+    trainer.fit(train_iter, eval_iter_fn, writer=writer)
+    return 0
+
+
+def cmd_sample(args) -> int:
+    _apply_platform(args)
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.checkpoint import CheckpointManager
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_char_lm_run
+    from solvingpapers_tpu.infer import generate
+
+    cfg = get_config(args.config)
+    if args.data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
+    cfg, model, tok, _, _ = build_char_lm_run(cfg)
+
+    rng = jax.random.key(args.seed)
+    prompt_text = args.prompt or "\n"
+    prompt = jnp.asarray(tok.encode(prompt_text), jnp.int32)[None, :]
+    params = model.init({"params": rng}, prompt)["params"]
+
+    if args.checkpoint_dir:
+        from solvingpapers_tpu.train import Trainer
+
+        trainer = Trainer(model, cfg.train)
+        state = trainer.init_state({"x": prompt, "y": prompt})
+        from solvingpapers_tpu.train.engine import _pure_state
+
+        mgr = CheckpointManager(args.checkpoint_dir, save_every=0)
+        restored = mgr.restore_latest(_pure_state(state))
+        if restored is None:
+            print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
+            return 1
+        params = restored[0]["params"]
+
+    sampler = (
+        ops.sample_greedy
+        if args.greedy
+        else functools.partial(ops.sample_top_k, k=args.top_k, temperature=args.temperature)
+    )
+    out = generate(
+        model, params, prompt, rng, max_new_tokens=args.max_new_tokens, sampler=sampler
+    )
+    print(tok.decode(np.asarray(out[0])))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="solvingpapers_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("list")
+
+    p_train = sub.add_parser("train")
+    _add_common(p_train)
+    p_train.add_argument("--steps", type=int, default=None)
+    p_train.add_argument("--ckpt-every", type=int, default=1000)
+    p_train.add_argument("--jsonl", default=None)
+
+    p_sample = sub.add_parser("sample")
+    _add_common(p_sample)
+    p_sample.add_argument("--prompt", default=None)
+    p_sample.add_argument("--max-new-tokens", type=int, default=200)
+    p_sample.add_argument("--top-k", type=int, default=50)
+    p_sample.add_argument("--temperature", type=float, default=1.0)
+    p_sample.add_argument("--greedy", action="store_true")
+    p_sample.add_argument("--seed", type=int, default=0)
+
+    args = parser.parse_args(argv)
+    return {"list": cmd_list, "train": cmd_train, "sample": cmd_sample}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

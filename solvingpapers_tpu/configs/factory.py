@@ -1,0 +1,64 @@
+"""Build model / data / trainer objects from a RunConfig."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+from solvingpapers_tpu.data import load_char_corpus
+from solvingpapers_tpu.data.batches import lm_batch_iterator
+from solvingpapers_tpu.configs.registry import RunConfig
+
+
+def build_model(cfg: RunConfig):
+    fam = cfg.model_family
+    if fam == "gpt":
+        from solvingpapers_tpu.models.gpt import GPT
+
+        return GPT(cfg.model)
+    if fam == "llama3":
+        from solvingpapers_tpu.models.llama3 import Llama
+
+        return Llama(cfg.model)
+    if fam == "gemma":
+        from solvingpapers_tpu.models.gemma import Gemma
+
+        return Gemma(cfg.model)
+    if fam == "deepseekv3":
+        from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3
+
+        return DeepSeekV3(cfg.model)
+    if fam == "vit":
+        from solvingpapers_tpu.models.vit import ViT
+
+        return ViT(cfg.model)
+    if fam == "alexnet":
+        from solvingpapers_tpu.models.alexnet import AlexNet
+
+        return AlexNet(cfg.model)
+    if fam == "ae":
+        from solvingpapers_tpu.models.autoencoder import AutoEncoder
+
+        return AutoEncoder(cfg.model)
+    if fam == "vae":
+        from solvingpapers_tpu.models.autoencoder import VAE
+
+        return VAE(cfg.model)
+    raise ValueError(f"unknown model family {cfg.model_family!r}")
+
+
+def build_char_lm_run(cfg: RunConfig, sharding=None):
+    """Returns (run_cfg_with_vocab, model, tokenizer, train_iter, eval_iter_fn)."""
+    tok, train_toks, val_toks = load_char_corpus(path=cfg.data.get("path"))
+    block = cfg.data.get("block_size", 256)
+    # the char vocab comes from the corpus; resize the model to match
+    model_cfg = dataclasses.replace(cfg.model, vocab_size=max(tok.vocab_size, 2))
+    cfg = dataclasses.replace(cfg, model=model_cfg)
+    model = build_model(cfg)
+    bsz = cfg.train.batch_size
+    train_iter = lm_batch_iterator(train_toks, bsz, block, seed=cfg.train.seed, sharding=sharding)
+
+    def eval_iter_fn() -> Iterator[dict]:
+        return lm_batch_iterator(val_toks, bsz, block, seed=10_000, sharding=sharding)
+
+    return cfg, model, tok, train_iter, eval_iter_fn

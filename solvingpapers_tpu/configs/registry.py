@@ -1,0 +1,112 @@
+"""Workload registry: name -> RunConfig (model + data + train settings)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+from solvingpapers_tpu.train.engine import TrainConfig
+from solvingpapers_tpu.train.optim import OptimizerConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    name: str
+    model_family: str  # gpt | llama3 | gemma | deepseekv3 | vit | alexnet | ae | vae | kd
+    model: Any
+    train: TrainConfig
+    data: dict = dataclasses.field(default_factory=dict)
+    notes: str = ""
+
+
+_REGISTRY: dict[str, Callable[[], RunConfig]] = {}
+
+
+def register(name: str):
+    def deco(fn: Callable[[], RunConfig]):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_config(name: str, **overrides) -> RunConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown config {name!r}; available: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]()
+    if overrides:
+        train_overrides = {
+            k: v for k, v in overrides.items()
+            if k in {f.name for f in dataclasses.fields(TrainConfig)}
+        }
+        rest = {k: v for k, v in overrides.items() if k not in train_overrides}
+        if train_overrides:
+            train = dataclasses.replace(cfg.train, **train_overrides)
+            # keep the LR schedule horizon aligned with an overridden step count
+            if "steps" in train_overrides:
+                train = dataclasses.replace(
+                    train,
+                    optimizer=dataclasses.replace(
+                        train.optimizer, total_steps=train_overrides["steps"]
+                    ),
+                )
+            cfg = dataclasses.replace(cfg, train=train)
+        if rest:
+            cfg = dataclasses.replace(cfg, **rest)
+    return cfg
+
+
+def list_configs() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------- workloads
+
+
+@register("gpt_tiny")
+def _gpt_tiny() -> RunConfig:
+    """CPU-runnable smoke config (debugging / CI)."""
+    from solvingpapers_tpu.models.gpt import GPTConfig
+
+    return RunConfig(
+        name="gpt_tiny",
+        model_family="gpt",
+        model=GPTConfig(vocab_size=64, block_size=64, dim=64, n_layers=2,
+                        n_heads=2, dropout=0.0),
+        train=TrainConfig(
+            steps=100, batch_size=16, log_every=20, eval_every=50, eval_batches=5,
+            optimizer=OptimizerConfig(max_lr=3e-3, warmup_steps=10, total_steps=100),
+            tokens_per_step=16 * 64,
+        ),
+        data={"kind": "char", "path": None, "block_size": 64},
+        notes="smoke-test config, not a reference workload",
+    )
+
+
+@register("gpt_shakespeare")
+def _gpt_shakespeare() -> RunConfig:
+    """The reference's gpt/gpt-jax.ipynb cell 8 hyperparameters."""
+    from solvingpapers_tpu.models.gpt import GPTConfig
+
+    return RunConfig(
+        name="gpt_shakespeare",
+        model_family="gpt",
+        model=GPTConfig(
+            vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=1,
+            dropout=0.1, dtype="bfloat16",
+        ),
+        train=TrainConfig(
+            steps=1000,
+            batch_size=128,
+            log_every=50,
+            eval_every=100,
+            eval_batches=20,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=0, total_steps=1000,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=128 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="gpt/gpt-jax.ipynb cells 8-19; val loss 1.8871 @ step 1000 on T4",
+    )

@@ -1,0 +1,5 @@
+"""Data pipelines (L4): tokenizers, LM streams, image datasets, sharded batches."""
+
+from solvingpapers_tpu.data.char import CharTokenizer, load_char_corpus
+from solvingpapers_tpu.data.batches import random_crop_batch, sliding_window_split
+from solvingpapers_tpu.data.synthetic import synthetic_text, synthetic_images

@@ -1,0 +1,50 @@
+"""Character-level tokenization + corpus loading.
+
+Capability target: the char vocab pipelines of gpt/gpt-jax.ipynb cell 6 and
+gemma/gemma.ipynb cells 4-5 (sorted unique chars, stoi/itos maps, 90/10
+train/val split).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from solvingpapers_tpu.data.synthetic import synthetic_text
+
+
+class CharTokenizer:
+    def __init__(self, text: str):
+        self.chars = sorted(set(text))
+        self.stoi = {c: i for i, c in enumerate(self.chars)}
+        self.itos = dict(enumerate(self.chars))
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.chars)
+
+    def encode(self, s: str) -> np.ndarray:
+        return np.asarray([self.stoi[c] for c in s], dtype=np.int32)
+
+    def decode(self, ids) -> str:
+        return "".join(self.itos[int(i)] for i in ids)
+
+
+def load_char_corpus(
+    path: str | None = None,
+    val_fraction: float = 0.1,
+    synthetic_chars: int = 200_000,
+    seed: int = 0,
+) -> tuple[CharTokenizer, np.ndarray, np.ndarray]:
+    """Load a text corpus (local file if given/exists, else synthetic),
+    build a char vocab, return (tokenizer, train_tokens, val_tokens)."""
+    if path is not None and os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+    else:
+        text = synthetic_text(synthetic_chars, seed)
+    tok = CharTokenizer(text)
+    data = tok.encode(text)
+    n_val = int(len(data) * val_fraction)
+    return tok, data[:-n_val], data[-n_val:]

@@ -1,0 +1,60 @@
+"""Deterministic synthetic datasets for zero-egress environments.
+
+The reference fetches Tiny-Shakespeare by URL (gpt/gpt-jax.ipynb cell 4,
+gemma/gemma.ipynb cell 4) and MNIST via torchvision. This environment has
+no network egress, so every data module falls back to seeded synthetic data
+with the same shapes/statistics; real files are used when a local path is
+supplied.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_WORDS = (
+    "the quick brown fox jumps over lazy dog when winter comes to verona "
+    "and all our yesterdays have lighted fools the way to dusty death out "
+    "brief candle life is but walking shadow a poor player that struts and "
+    "frets his hour upon the stage and then is heard no more it is a tale "
+    "told by an idiot full of sound and fury signifying nothing my lord "
+    "what say you to this most noble friend shall we proceed anon good sir"
+).split()
+
+
+def synthetic_text(n_chars: int = 200_000, seed: int = 0) -> str:
+    """Pseudo-prose with word/sentence structure (learnable char statistics)."""
+    rng = np.random.default_rng(seed)
+    out: list[str] = []
+    total = 0
+    while total < n_chars:
+        sent_len = int(rng.integers(4, 12))
+        words = rng.choice(_WORDS, size=sent_len)
+        sent = " ".join(words).capitalize() + ". "
+        if rng.random() < 0.1:
+            sent = "\n" + sent
+        out.append(sent)
+        total += len(sent)
+    return "".join(out)[:n_chars]
+
+
+def synthetic_images(
+    n: int = 2048, side: int = 28, n_classes: int = 10, seed: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """MNIST-shaped synthetic classification set: class-dependent blob patterns.
+
+    Returns (images (n, side, side, 1) float32 in [0,1], labels (n,) int32).
+    Classes are separable (distinct frequency/phase gratings + noise) so
+    accuracy-style smoke tests can actually learn.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_classes, size=n).astype(np.int32)
+    yy, xx = np.mgrid[0:side, 0:side].astype(np.float32) / side
+    images = np.empty((n, side, side, 1), np.float32)
+    for c in range(n_classes):
+        freq = 1.0 + c // 2
+        phase = (c % 2) * np.pi / 2
+        base = 0.5 + 0.5 * np.sin(2 * np.pi * freq * (xx * ((c % 3) + 1) + yy) + phase)
+        idx = labels == c
+        noise = rng.normal(0, 0.15, size=(idx.sum(), side, side))
+        images[idx, :, :, 0] = np.clip(base[None] + noise, 0, 1)
+    return images, labels

@@ -1,0 +1,4 @@
+"""Jitted inference: preallocated KV/latent caches + prefill/decode loops."""
+
+from solvingpapers_tpu.infer.cache import KVCache, update_kv_cache
+from solvingpapers_tpu.infer.decode import generate

@@ -1,0 +1,54 @@
+"""Preallocated, static-shape KV caches for jitted decode.
+
+The reference plumbs caches but never exercises them (llama3/LLaMA-jax.ipynb
+cell 24 accepts `(cache, position)` yet cell 14's `generate` recomputes the
+full prefix per token; deepseekv3 cell 40 rebuilds its MLA cache per token).
+Here the cache is a first-class pytree with a fixed `max_len` so the decode
+step compiles once and runs under `lax.scan`/`while_loop`.
+
+Masking contract: slots >= the current length hold stale data; attention
+must mask with `kv_index <= query_position` (ops.attention.causal_mask /
+position-based masks), never rely on zeroed slots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class KVCache:
+    """Per-layer key/value cache, laid out (batch, max_len, n_kv_heads, head_dim)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def init(
+        cls,
+        batch: int,
+        max_len: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "KVCache":
+        shape = (batch, max_len, n_kv_heads, head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[1]
+
+
+def update_kv_cache(
+    cache: KVCache, k_new: jax.Array, v_new: jax.Array, index: jax.Array
+) -> KVCache:
+    """Write `k_new`/`v_new` (B, S, n_kv, H) into the cache at sequence offset
+    `index` (scalar int array) and return the updated cache."""
+    start = (0, index, 0, 0)
+    return KVCache(
+        k=jax.lax.dynamic_update_slice(cache.k, k_new.astype(cache.k.dtype), start),
+        v=jax.lax.dynamic_update_slice(cache.v, v_new.astype(cache.v.dtype), start),
+    )

@@ -1,0 +1,4 @@
+"""Metrics and observability (L7)."""
+
+from solvingpapers_tpu.metrics.writer import MetricsWriter, ConsoleWriter, JSONLWriter, MultiWriter
+from solvingpapers_tpu.metrics.mfu import transformer_flops_per_token, chip_peak_flops, mfu

@@ -1,0 +1,45 @@
+"""MFU accounting (SURVEY.md hard part #5).
+
+flops-per-token uses the PaLM-appendix convention: 6N for the
+fwd+bwd matmul flops of N *active* parameters plus the 12·L·D·S
+attention-score term. For MoE models pass the active (routed top-k +
+shared + non-expert) parameter count, not the total.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
+_PEAK_TFLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5": 459e12,  # v5p
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def chip_peak_flops(device=None) -> float:
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return 197e12  # conservative default: v5e
+
+
+def transformer_flops_per_token(
+    n_active_params: int, n_layers: int, dim: int, seq_len: int, training: bool = True
+) -> float:
+    """6N + 12·L·D·S per trained token (2N + 4·L·D·S for inference)."""
+    mult = 6 if training else 2
+    attn = (12 if training else 4) * n_layers * dim * seq_len
+    return mult * n_active_params + attn
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float, n_chips: int = 1, device=None) -> float:
+    peak = chip_peak_flops(device) * n_chips
+    return tokens_per_sec * flops_per_token / peak

@@ -1,0 +1,64 @@
+"""Metrics writers.
+
+Replaces the reference's wandb-only logging (deepseekv3/deepseekv3.ipynb
+cells 51-54: per-step train_loss / train_perplexity / lr / grad_norm /
+tokens; eval val_loss / val_perplexity) with a sink-agnostic interface.
+The metric names are kept wandb-compatible so an optional wandb sink can
+forward them unchanged; TPU extras (step_time, tokens_per_sec, mfu) ride
+the same channel.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Mapping
+
+
+class MetricsWriter:
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ConsoleWriter(MetricsWriter):
+    def __init__(self, stream: IO = sys.stdout, every: int = 1):
+        self.stream = stream
+        self.every = max(every, 1)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        if step % self.every:
+            return
+        parts = " ".join(
+            f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items()
+        )
+        print(f"step {step}: {parts}", file=self.stream, flush=True)
+
+
+class JSONLWriter(MetricsWriter):
+    def __init__(self, path: str):
+        self.f = open(path, "a", buffering=1)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        rec = {"step": step, "time": time.time(), **{k: float(v) for k, v in metrics.items()}}
+        self.f.write(json.dumps(rec) + "\n")
+
+    def close(self) -> None:
+        self.f.close()
+
+
+class MultiWriter(MetricsWriter):
+    def __init__(self, *writers: MetricsWriter):
+        self.writers = writers
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        for w in self.writers:
+            w.write(step, metrics)
+
+    def close(self) -> None:
+        for w in self.writers:
+            w.close()

@@ -1,0 +1,8 @@
+"""Flax model zoo (L2/L3).
+
+Every model family from the reference, rebuilt on the shared ops/layers:
+gpt, llama3 (GQA+RoPE+SwiGLU), gemma (MQA+GeGLU), deepseekv3 (MLA+MoE+MTP),
+vit, alexnet, autoencoder/vae, kd teacher/student.
+"""
+
+from solvingpapers_tpu.models.layers import Attention, MLP, GLUFFN, RMSNorm, LayerNorm

@@ -1,0 +1,120 @@
+"""GPT decoder-only char LM.
+
+Capability target: gpt/gpt-jax.ipynb cells 8-12 — learned positional
+embedding, pre-LN decoder blocks with fused-qkv causal self-attention and
+4x GELU MLP, final LayerNorm, untied lm_head. Reference defaults:
+block 256, dim 256, 1 head, 8 layers (cell 8).
+
+Differences from the reference (TPU-first): attention/norm math comes from
+the shared ops library (f32 reductions under bf16 compute), and the model
+supports a preallocated KV cache + absolute positions so decode is a
+compiled single-token step instead of the notebook's unjitted
+full-prefix python loop (cell 19).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu.infer.cache import KVCache
+from solvingpapers_tpu.models.layers import Attention, LayerNorm, MLP
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 65
+    block_size: int = 256
+    dim: int = 256
+    n_layers: int = 8
+    n_heads: int = 1
+    mlp_mult: int = 4
+    dropout: float = 0.1
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+        cfg = self.cfg
+        h, cache = Attention(
+            dim=cfg.dim,
+            n_heads=cfg.n_heads,
+            causal=True,
+            dropout=cfg.dropout,
+            use_bias=True,
+            dtype=cfg.compute_dtype,
+            name="attn",
+        )(LayerNorm(name="ln1")(x), positions=positions, cache=cache, deterministic=deterministic)
+        x = x + h
+        x = x + MLP(
+            dim=cfg.dim,
+            hidden_dim=cfg.mlp_mult * cfg.dim,
+            dropout=cfg.dropout,
+            dtype=cfg.compute_dtype,
+            name="mlp",
+        )(LayerNorm(name="ln2")(x), deterministic=deterministic)
+        return x, cache
+
+
+class GPT(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches: list[KVCache] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, list[KVCache] | None]:
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(
+            tokens
+        )
+        pos_table = self.param(
+            "pos_emb", nn.initializers.normal(0.02), (cfg.block_size, cfg.dim)
+        )
+        x = tok_emb + jnp.take(pos_table, positions, axis=0).astype(cfg.compute_dtype)
+        if cfg.dropout > 0.0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        new_caches = [] if caches is not None else None
+        for i in range(cfg.n_layers):
+            x, c = GPTBlock(cfg, name=f"block_{i}")(
+                x,
+                positions=positions,
+                cache=None if caches is None else caches[i],
+                deterministic=deterministic,
+            )
+            if new_caches is not None:
+                new_caches.append(c)
+        x = LayerNorm(name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.compute_dtype, name="lm_head")(x)
+        return logits, new_caches
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.block_size
+
+    def init_caches(self, batch: int, max_len: int, dtype=None) -> list[KVCache]:
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        dtype = dtype or cfg.compute_dtype
+        return [
+            KVCache.init(batch, max_len, cfg.n_heads, head_dim, dtype)
+            for _ in range(cfg.n_layers)
+        ]

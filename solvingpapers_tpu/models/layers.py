@@ -1,0 +1,192 @@
+"""Shared Flax building blocks (L2).
+
+One `Attention` module serves every transformer in the zoo — the variants
+the reference implements separately are config points here:
+  * GPT: causal MHA, fused qkv, no RoPE (gpt/gpt-jax.ipynb cell 9)
+  * LLaMA3: causal GQA + RoPE (llama3/LLaMA-jax.ipynb cell 24)
+  * Gemma: causal MQA-grouped + RoPE (gemma/gemma.ipynb cell 8)
+  * ViT: bidirectional MHA (vision transformer/ViT.ipynb cell 10)
+MLA is structurally different (latent cache) and lives in models/deepseekv3.py.
+
+All dense layers take a compute `dtype` (bf16 for TPU training) with f32
+params; reductions inside ops.* are f32.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.infer.cache import KVCache, update_kv_cache
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        return ops.rms_norm(x, weight, self.eps)
+
+
+class LayerNorm(nn.Module):
+    eps: float = 1e-5
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        weight = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        bias = (
+            self.param("bias", nn.initializers.zeros, (x.shape[-1],))
+            if self.use_bias
+            else None
+        )
+        return ops.layer_norm(x, weight, bias, self.eps)
+
+
+class Attention(nn.Module):
+    """Multi-head attention with optional GQA/MQA, RoPE, causality and KV cache.
+
+    Call: (x, *, positions, cache, deterministic) -> (out, new_cache).
+    `positions` (B, S) absolute positions are required when a cache is
+    passed; otherwise default to arange. The KV cache is preallocated
+    (infer/cache.py); masking is position-based so stale slots never leak.
+    """
+
+    dim: int
+    n_heads: int
+    n_kv_heads: int | None = None  # None => MHA
+    head_dim: int | None = None
+    causal: bool = True
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    max_seq_len: int = 4096  # rope table length
+    dropout: float = 0.0
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        cache: KVCache | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, KVCache | None]:
+        b, s, _ = x.shape
+        n_kv = self.n_kv_heads or self.n_heads
+        head_dim = self.head_dim or self.dim // self.n_heads
+        dense = lambda feats, name: nn.Dense(  # noqa: E731
+            feats, use_bias=self.use_bias, dtype=self.dtype, name=name
+        )
+
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        if n_kv == self.n_heads:
+            qkv = dense(3 * self.n_heads * head_dim, "qkv")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            q = dense(self.n_heads * head_dim, "q")(x)
+            kv = dense(2 * n_kv * head_dim, "kv")(x)
+            k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, s, self.n_heads, head_dim)
+        k = k.reshape(b, s, n_kv, head_dim)
+        v = v.reshape(b, s, n_kv, head_dim)
+
+        if self.use_rope:
+            cos, sin = ops.precompute_rope(head_dim, self.max_seq_len, self.rope_theta)
+            q = ops.apply_rope(q, cos, sin, positions=positions)
+            k = ops.apply_rope(k, cos, sin, positions=positions)
+
+        if cache is not None:
+            # single contiguous segment per step: write at the first position
+            cache = update_kv_cache(cache, k, v, positions[0, 0])
+            k_full, v_full = cache.k, cache.v
+            kv_idx = jnp.arange(cache.max_len)
+            # (B, 1, S, max_len): query at position p sees kv slots <= p
+            mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
+            out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
+        else:
+            mask = None
+            if self.causal:
+                out = ops.dot_product_attention(
+                    q,
+                    k,
+                    v,
+                    causal=True,
+                    dropout_rate=self.dropout,
+                    dropout_rng=(
+                        None if deterministic else self.make_rng("dropout")
+                    ),
+                    deterministic=deterministic,
+                )
+            else:
+                out = ops.dot_product_attention(
+                    q,
+                    k,
+                    v,
+                    dropout_rate=self.dropout,
+                    dropout_rng=(
+                        None if deterministic else self.make_rng("dropout")
+                    ),
+                    deterministic=deterministic,
+                )
+
+        out = out.reshape(b, s, self.n_heads * head_dim)
+        out = dense(self.dim, "out")(out)
+        if self.dropout > 0.0:
+            out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        return out, cache
+
+
+class MLP(nn.Module):
+    """Plain 2-layer MLP (gpt/gpt-jax.ipynb cell 10; ViT.ipynb cell 10)."""
+
+    dim: int
+    hidden_dim: int
+    activation: Callable[[jax.Array], jax.Array] = ops.gelu_tanh
+    dropout: float = 0.0
+    use_bias: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        x = nn.Dense(self.hidden_dim, use_bias=self.use_bias, dtype=self.dtype, name="fc")(x)
+        x = self.activation(x)
+        x = nn.Dense(self.dim, use_bias=self.use_bias, dtype=self.dtype, name="proj")(x)
+        if self.dropout > 0.0:
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        return x
+
+
+class GLUFFN(nn.Module):
+    """Gated-linear-unit FFN: down(act(gate(x)) * up(x)).
+
+    activation=silu → SwiGLU (llama3 cell 25, deepseekv3 cell 21);
+    activation=gelu_tanh → GeGLU (gemma cell 9).
+    """
+
+    dim: int
+    hidden_dim: int
+    activation: Callable[[jax.Array], jax.Array] = ops.silu
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        gate = nn.Dense(self.hidden_dim, use_bias=self.use_bias, dtype=self.dtype, name="gate")(x)
+        up = nn.Dense(self.hidden_dim, use_bias=self.use_bias, dtype=self.dtype, name="up")(x)
+        return nn.Dense(self.dim, use_bias=self.use_bias, dtype=self.dtype, name="down")(
+            self.activation(gate) * up
+        )
+
+
+def swiglu_hidden_dim(dim: int, multiplier: int = 4) -> int:
+    """The (2/3)·4·dim sizing convention (deepseekv3 cell 21: ((2D)*4)//3)."""
+    return (2 * dim * multiplier) // 3

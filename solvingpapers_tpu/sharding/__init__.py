@@ -1,0 +1,22 @@
+"""Mesh construction and sharding rules (L8).
+
+The reference's parallelism ceiling is one `nn.DataParallel` wrap over two
+GPUs (deepseekv3/deepseekv3.ipynb cells 37, 54). Here parallelism is
+expressed the TPU-native way: a `jax.sharding.Mesh` with standardized axes
+('data', 'fsdp', 'model', 'expert'), PartitionSpec rules over parameter
+pytrees, and XLA/GSPMD inserting the collectives over ICI/DCN.
+"""
+
+from solvingpapers_tpu.sharding.mesh import (
+    MESH_AXES,
+    MeshConfig,
+    create_mesh,
+    batch_spec,
+    batch_sharding,
+)
+from solvingpapers_tpu.sharding.rules import (
+    GPT_RULES,
+    LM_RULES,
+    param_specs,
+    param_shardings,
+)

@@ -1,0 +1,67 @@
+"""Device-mesh construction with standardized axis names.
+
+Axes (SURVEY.md §2.3/§5):
+  data   — pure data parallelism (batch split, params replicated)
+  fsdp   — fully-sharded data parallelism (batch AND params split; XLA
+           all-gathers params on use, reduce-scatters grads)
+  model  — tensor parallelism (attention heads / FFN hidden)
+  expert — expert parallelism for MoE all_to_all dispatch
+
+Batches are sharded over (data, fsdp) jointly; parameters over
+(fsdp, model); MoE experts over expert. On a single chip every axis has
+size 1 and all of this compiles to a no-op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "fsdp", "model", "expert")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Axis sizes; -1 means 'absorb all remaining devices' (exactly one allowed)."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        sizes = [self.data, self.fsdp, self.model, self.expert]
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got {sizes}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} needs {fixed} devices, have {n_devices}")
+        return tuple(sizes)
+
+
+def create_mesh(
+    config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def batch_spec(extra_dims: int = 1) -> P:
+    """PartitionSpec for a batch-leading array: batch over (data, fsdp)."""
+    return P(("data", "fsdp"), *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims))

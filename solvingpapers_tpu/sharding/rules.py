@@ -1,0 +1,61 @@
+"""Parameter partition rules: path-pattern -> PartitionSpec.
+
+A single rule table holds for the whole model zoo (SURVEY.md hard part #4)
+by relying on the shared layer naming from models/layers.py:
+
+  column-parallel kernels (qkv / q / kv / gate / up / fc, lm_head):
+      (in, out) -> P('fsdp', 'model')   — out features over TP axis
+  row-parallel kernels (out / down / proj):
+      (in, out) -> P('model', 'fsdp')   — in features over TP axis
+  embeddings: (vocab, dim) -> P(None, 'fsdp')
+  everything else (norm scales, biases, pos tables): replicated
+
+With mesh sizes fsdp=model=1 every spec degenerates to replication; with
+fsdp>1 this is GSPMD FSDP (params gathered on use); with model>1 it is
+Megatron-style TP — all from the same table.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec). First match wins; paths are '/'-joined key tuples.
+LM_RULES: list[tuple[str, P]] = [
+    (r"(qkv|q|kv|gate|up|fc|w_dkv|w_q)/kernel$", P("fsdp", "model")),
+    (r"(out|down|proj|w_o)/kernel$", P("model", "fsdp")),
+    (r"lm_head/kernel$", P("fsdp", "model")),
+    (r"(tok_emb|embedding)/embedding$", P(None, "fsdp")),
+    (r"pos_emb$", P(None, "fsdp")),
+    (r".*", P()),  # norms, biases, scalars: replicated
+]
+
+GPT_RULES = LM_RULES  # shared naming makes the generic table sufficient
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def param_specs(params, rules: list[tuple[str, P]] = LM_RULES):
+    """Map a params pytree to a pytree of PartitionSpec via first-match rules."""
+
+    def spec_for(path, leaf):
+        p = _path_str(path)
+        for pattern, spec in rules:
+            if re.search(pattern, p):
+                # never shard more dims than the leaf has
+                if len(spec) > leaf.ndim:
+                    return P(*spec[: leaf.ndim])
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def param_shardings(mesh: Mesh, params, rules: list[tuple[str, P]] = LM_RULES):
+    specs = param_specs(params, rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))

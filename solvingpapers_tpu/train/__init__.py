@@ -1,0 +1,5 @@
+"""The single training engine (L5) parameterizing every workload."""
+
+from solvingpapers_tpu.train.optim import warmup_cosine, make_optimizer, OptimizerConfig
+from solvingpapers_tpu.train.state import TrainState
+from solvingpapers_tpu.train.engine import Trainer, TrainConfig, lm_loss_fn

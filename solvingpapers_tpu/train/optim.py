@@ -1,0 +1,70 @@
+"""Optimizer construction.
+
+Covers the reference's optimizer settings from one config:
+  * AdamW β=(0.9, 0.95), wd 0.1, eps 1e-8, grad-clip 1.0, linear warmup →
+    cosine decay to 0.1·max_lr (deepseekv3/deepseekv3.ipynb cells 42-44, 54)
+  * optax.adamw TrainState (gpt/gpt-jax.ipynb cell 16)
+  * plain SGD kept as an option for llama3 parity (LLaMA-jax.ipynb cell 29's
+    hand-rolled p - lr·g)
+Gradient accumulation is optax.MultiSteps — the functional replacement for
+the torch accumulate-then-step inner loop; loss scaling is unnecessary
+because TPU training runs bf16, not fp16 (no GradScaler equivalent needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    name: str = "adamw"  # adamw | sgd
+    max_lr: float = 3e-4
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 0
+    total_steps: int = 10_000
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    accum_steps: int = 1
+
+
+def warmup_cosine(
+    max_lr: float, warmup_steps: int, total_steps: int, min_lr_ratio: float = 0.1
+) -> optax.Schedule:
+    """Linear warmup then cosine decay to min_lr_ratio·max_lr (dsv3 cell 44)."""
+    if warmup_steps <= 0:
+        return optax.cosine_decay_schedule(
+            max_lr, max(total_steps, 1), alpha=min_lr_ratio
+        )
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=max_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=max_lr * min_lr_ratio,
+    )
+
+
+def make_optimizer(cfg: OptimizerConfig) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    schedule = warmup_cosine(cfg.max_lr, cfg.warmup_steps, cfg.total_steps, cfg.min_lr_ratio)
+    if cfg.name == "adamw":
+        opt = optax.adamw(
+            schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps, weight_decay=cfg.weight_decay
+        )
+    elif cfg.name == "sgd":
+        opt = optax.sgd(schedule)
+    elif cfg.name == "adam":
+        opt = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+    chain = [optax.clip_by_global_norm(cfg.grad_clip)] if cfg.grad_clip > 0 else []
+    chain.append(opt)
+    tx = optax.chain(*chain)
+    if cfg.accum_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.accum_steps)
+    return tx, schedule

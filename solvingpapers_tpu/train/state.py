@@ -1,0 +1,47 @@
+"""Training state pytree."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    """Functional train state: params + optimizer state + step + PRNG +
+    optional non-differentiable model state (e.g. MoE aux-free router bias,
+    deepseekv3 cell 23's `routing_bias` buffer)."""
+
+    step: jax.Array
+    params: Any
+    opt_state: optax.OptState
+    rng: jax.Array
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+    model_state: Any = None
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, rng, model_state=None):
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+            model_state=model_state,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads, new_model_state=None):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            model_state=new_model_state if new_model_state is not None else self.model_state,
+        )
