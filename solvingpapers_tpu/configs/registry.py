@@ -110,3 +110,39 @@ def _gpt_shakespeare() -> RunConfig:
         data={"kind": "char", "path": None, "block_size": 256},
         notes="gpt/gpt-jax.ipynb cells 8-19; val loss 1.8871 @ step 1000 on T4",
     )
+
+
+@register("llama3_shakespeare")
+def _llama3_shakespeare() -> RunConfig:
+    """The reference's llama3/LLaMA-jax.ipynb cell 9 hyperparameters.
+
+    The notebook trains with hand-rolled SGD (cell 29) over 30 epochs x
+    1000 steps (cell 31); optimizer name 'sgd' preserves that parity while
+    `adamw` remains a config switch. The notebook tokenizes with tiktoken
+    gpt2 BPE; this config defaults to the char pipeline (vocab resized by
+    the factory) since the BPE merges table is not bundled offline.
+    """
+    from solvingpapers_tpu.models.llama3 import LlamaConfig
+
+    return RunConfig(
+        name="llama3_shakespeare",
+        model_family="llama3",
+        model=LlamaConfig(
+            vocab_size=50257, max_seq_len=128, dim=256, n_layers=2, n_heads=4,
+            n_kv_heads=2, hidden_dim=1024, dropout=0.0, dtype="bfloat16",
+        ),
+        train=TrainConfig(
+            steps=30_000,  # 30 epochs x 1000 steps (cell 31)
+            batch_size=16,
+            log_every=100,
+            eval_every=1000,
+            eval_batches=20,
+            optimizer=OptimizerConfig(
+                name="sgd", max_lr=3e-4, warmup_steps=0, total_steps=30_000,
+                grad_clip=0.0, weight_decay=0.0, min_lr_ratio=1.0,
+            ),
+            tokens_per_step=16 * 128,
+        ),
+        data={"kind": "char", "path": None, "block_size": 128},
+        notes="LLaMA-jax.ipynb cells 9, 29-31; epoch-avg loss 8.10→5.47 over 30k steps",
+    )

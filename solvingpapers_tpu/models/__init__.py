@@ -6,3 +6,5 @@ vit, alexnet, autoencoder/vae, kd teacher/student.
 """
 
 from solvingpapers_tpu.models.layers import Attention, MLP, GLUFFN, RMSNorm, LayerNorm
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig

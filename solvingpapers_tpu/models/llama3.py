@@ -1,0 +1,138 @@
+"""LLaMA-3-style decoder-only LM.
+
+Capability target: llama3/LLaMA-jax.ipynb — RMSNorm (cell 15), RoPE
+(cells 16-17, complex formulation), GQA attention with n_kv_heads <
+n_heads (cells 18, 24), SwiGLU feed-forward (cell 25), pre-norm decoder
+blocks with dropout (cell 26), untied output head (cell 27). Reference
+defaults: dim 256, 2 layers, 4 heads / 2 kv-heads, seq 128 (cell 9).
+
+Differences from the reference (TPU-first):
+  * One shared Attention module (models/layers.py) provides GQA + RoPE +
+    a preallocated KV cache that decode actually uses — the reference
+    plumbs `(cache, position)` through `attention` (cell 24) but its
+    `generate` (cell 14) recomputes the full prefix every token.
+  * RoPE comes from the shared real-valued table op (ops/rope.py), proven
+    equal to the notebook's complex64 formulation by tests/test_ops.py.
+  * freqs_cis / mask are not rebuilt per forward (cell 27 recomputes both
+    every call); positions index a precomputed table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.infer.cache import KVCache
+from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, swiglu_hidden_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 50257  # tiktoken gpt2 (LLaMA-jax.ipynb cell 6)
+    max_seq_len: int = 128
+    dim: int = 256
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    hidden_dim: int | None = None  # None => swiglu 2/3·4·dim convention
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def ffn_hidden(self) -> int:
+        return self.hidden_dim or swiglu_hidden_dim(self.dim)
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+        cfg = self.cfg
+        h, cache = Attention(
+            dim=cfg.dim,
+            n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads,
+            causal=True,
+            use_rope=True,
+            rope_theta=cfg.rope_theta,
+            max_seq_len=cfg.max_seq_len,
+            dropout=cfg.dropout,
+            use_bias=False,
+            dtype=cfg.compute_dtype,
+            name="attn",
+        )(
+            RMSNorm(eps=cfg.norm_eps, name="attn_norm")(x),
+            positions=positions,
+            cache=cache,
+            deterministic=deterministic,
+        )
+        x = x + h
+        h = GLUFFN(
+            dim=cfg.dim,
+            hidden_dim=cfg.ffn_hidden,
+            activation=ops.silu,
+            dtype=cfg.compute_dtype,
+            name="ffn",
+        )(RMSNorm(eps=cfg.norm_eps, name="ffn_norm")(x))
+        if cfg.dropout > 0.0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x + h, cache
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches: list[KVCache] | None = None,
+        deterministic: bool = True,
+    ) -> tuple[jax.Array, list[KVCache] | None]:
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(tokens)
+
+        new_caches = [] if caches is not None else None
+        for i in range(cfg.n_layers):
+            x, c = LlamaBlock(cfg, name=f"block_{i}")(
+                x,
+                positions=positions,
+                cache=None if caches is None else caches[i],
+                deterministic=deterministic,
+            )
+            if new_caches is not None:
+                new_caches.append(c)
+        x = RMSNorm(eps=cfg.norm_eps, name="norm_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.compute_dtype, name="lm_head"
+        )(x)
+        return logits, new_caches
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.max_seq_len
+
+    def init_caches(self, batch: int, max_len: int, dtype=None) -> list[KVCache]:
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        dtype = dtype or cfg.compute_dtype
+        return [
+            KVCache.init(batch, max_len, cfg.n_kv_heads, head_dim, dtype)
+            for _ in range(cfg.n_layers)
+        ]

@@ -39,23 +39,40 @@ def _path_str(path) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
 
 
-def param_specs(params, rules: list[tuple[str, P]] = LM_RULES):
-    """Map a params pytree to a pytree of PartitionSpec via first-match rules."""
+def _axis_product(mesh: Mesh | None, entry) -> int:
+    if mesh is None or entry is None:
+        return 1
+    names = entry if isinstance(entry, tuple) else (entry,)
+    n = 1
+    for name in names:
+        n *= mesh.shape.get(name, 1)
+    return n
+
+
+def param_specs(params, rules: list[tuple[str, P]] = LM_RULES, mesh: Mesh | None = None):
+    """Map a params pytree to a pytree of PartitionSpec via first-match rules.
+
+    When `mesh` is given, any dimension whose size is not divisible by the
+    product of its assigned mesh axes degrades to replicated for that dim
+    (e.g. a SwiGLU hidden of (2·4·D)//3 that lands on an odd size).
+    """
 
     def spec_for(path, leaf):
         p = _path_str(path)
         for pattern, spec in rules:
             if re.search(pattern, p):
-                # never shard more dims than the leaf has
-                if len(spec) > leaf.ndim:
-                    return P(*spec[: leaf.ndim])
-                return spec
+                entries = list(spec[: leaf.ndim])  # never shard more dims than leaf
+                entries = [
+                    e if leaf.shape[d] % _axis_product(mesh, e) == 0 else None
+                    for d, e in enumerate(entries)
+                ]
+                return P(*entries)
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def param_shardings(mesh: Mesh, params, rules: list[tuple[str, P]] = LM_RULES):
-    specs = param_specs(params, rules)
+    specs = param_specs(params, rules, mesh=mesh)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))

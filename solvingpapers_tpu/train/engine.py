@@ -107,7 +107,7 @@ class Trainer:
 
         rng = jax.random.key(cfg.seed)
         abstract = jax.eval_shape(make, rng)
-        specs = param_specs(abstract, self.rules)
+        specs = param_specs(abstract, self.rules, mesh=self.mesh)
         self._state_shardings = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), specs,
             is_leaf=lambda x: isinstance(x, P),
