@@ -1,0 +1,9 @@
+"""Pallas TPU kernels (the framework's native-code surface).
+
+The reference has zero custom kernels (SURVEY.md §0: no C++/CUDA at all);
+these are new TPU-first implementations of the hot ops: blockwise flash
+attention (causal + bidirectional, GQA) and MoE dispatch. Each kernel has a
+pure-jnp reference in ops/ and interpret-mode equality tests.
+"""
+
+from solvingpapers_tpu.kernels.flash_attention import flash_attention

@@ -1,0 +1,339 @@
+"""Blockwise flash attention for TPU (Pallas/Mosaic).
+
+New TPU-native code — the reference computes dense (S, S) score matrices in
+every notebook (e.g. gpt/gpt-jax.ipynb cell 9, LLaMA-jax.ipynb cell 24) and
+has no custom kernels to port (SURVEY.md §0). This kernel family provides:
+
+  * forward: online-softmax blockwise attention, causal or bidirectional,
+    never materializing the (S, S) score matrix in HBM
+  * GQA/MQA without materializing repeated KV heads (the kv block index map
+    folds the q-head -> kv-head mapping, replacing ops.repeat_kv)
+  * backward: custom VJP with separate dq and dk/dv kernels recomputing
+    probabilities from the saved log-sum-exp (FlashAttention-2 style)
+
+Numerics reference: ops.dot_product_attention (tests/test_flash_attention.py
+asserts forward and gradient equality in interpret mode).
+
+Layout: public API is BSNH (batch, seq, heads, head_dim) to match ops/;
+kernels run on (batch*heads, seq, head_dim) with seq tiled by 128-aligned
+blocks for the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BIG_NEG = -2.0**30
+DEFAULT_BLOCK = 128
+
+
+def _pick_block(seq: int, requested: int) -> int:
+    block = min(requested, seq)
+    while seq % block:
+        block //= 2
+    return max(block, 1)
+
+
+# --------------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D)
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    d = q_ref.shape[2]
+    j = pl.program_id(1)
+
+    q = q_ref[0, :, :].astype(jnp.float32) * scale
+    num_kb = seq_k // block_k
+    if causal:
+        hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q, block_k))
+    else:
+        hi = num_kb
+
+    def body(kb, carry):
+        m_i, l_i, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, BIG_NEG)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), BIG_NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m_i, l_i, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+
+    o_ref[0, :, :] = (acc / l_i).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = (m_i + jnp.log(l_i))[:, 0]
+
+
+def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
+    """q3: (B*N, S, D); k3/v3: (B*Nkv, Skv, D). Returns (o, lse)."""
+    bn, seq_q, d = q3.shape
+    seq_k = k3.shape[1]
+    group = n_heads // n_kv
+
+    def kv_index(i, j):
+        # flattened q index i = b*n_heads + h -> kv index b*n_kv + h//group,
+        # which is exactly i // group since group divides n_heads
+        return i // group
+
+    grid = (bn, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, seq_q, d), q3.dtype),
+            jax.ShapeDtypeStruct((bn, 1, seq_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# -------------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k):
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    j = pl.program_id(1)
+
+    q = q_ref[0, :, :].astype(jnp.float32) * scale
+    do = do_ref[0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]
+    delta = delta_ref[0, 0, :][:, None]
+    num_kb = seq_k // block_k
+    hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q, block_k)) if causal else num_kb
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            rows = j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, BIG_NEG)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jax.lax.fori_loop(
+        0, hi, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    )
+    dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q):
+    block_k = k_ref.shape[1]
+    seq_q = q_ref.shape[1]
+    kb = pl.program_id(1)
+    d = q_ref.shape[2]
+
+    k_blk = k_ref[0, :, :].astype(jnp.float32)
+    v_blk = v_ref[0, :, :].astype(jnp.float32)
+    num_qb = seq_q // block_q
+    lo = (kb * block_k) // block_q if causal else 0
+
+    def body(jb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(jb * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(jb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(jb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            rows = jb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, BIG_NEG)
+        p = jnp.exp(s - lse)  # (bq, bk)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, num_qb, body, (dk0, dv0))
+    # q was pre-scaled, so ds^T @ q_scaled already carries the softmax scale
+    dk_ref[0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------ public API
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q3, k3, v3, heads, scale, causal, blocks, interpret):
+    o, _ = _fwd(q3, k3, v3, heads[0], heads[1], scale, causal,
+                blocks[0], blocks[1], interpret)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, heads, scale, causal, blocks, interpret):
+    o, lse = _fwd(q3, k3, v3, heads[0], heads[1], scale, causal,
+                  blocks[0], blocks[1], interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
+    q3, k3, v3, o, lse = res
+    n_heads, n_kv = heads
+    block_q, block_k = blocks
+    bn, seq_q, d = q3.shape
+    seq_k = k3.shape[1]
+    group = n_heads // n_kv
+
+    if group > 1:  # materialize repeated kv for the backward pass
+        bkv = k3.shape[0]
+        rep = lambda x: jnp.repeat(  # noqa: E731
+            x.reshape(bkv // n_kv, n_kv, seq_k, d), group, axis=1
+        ).reshape(bn, seq_k, d)
+        k3r, v3r = rep(k3), rep(v3)
+    else:
+        k3r, v3r = k3, v3
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(bn, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        interpret=interpret,
+    )(q3, k3r, v3r, do, lse, delta)
+
+    dk_r, dv_r = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(bn, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, seq_k, d), k3.dtype),
+            jax.ShapeDtypeStruct((bn, seq_k, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3r, v3r, do, lse, delta)
+
+    if group > 1:  # reduce repeated-head grads back to kv heads
+        b = bn // n_heads
+        fold = lambda x: x.reshape(b, n_kv, group, seq_k, d).sum(axis=2).reshape(  # noqa: E731
+            b * n_kv, seq_k, d
+        )
+        dk_r, dv_r = fold(dk_r), fold(dv_r)
+    return dq, dk_r.astype(k3.dtype), dv_r.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over BSNH tensors (drop-in for ops.dot_product_attention
+    when there is no cache/explicit mask and dropout is inactive).
+
+    q: (B, Sq, N, D); k, v: (B, Skv, Nkv, D) with N % Nkv == 0.
+    """
+    b, seq_q, n_heads, d = q.shape
+    seq_k, n_kv = k.shape[1], k.shape[2]
+    if n_heads % n_kv:
+        raise ValueError(f"q heads {n_heads} not a multiple of kv heads {n_kv}")
+    if scale is None:
+        scale = d**-0.5
+    block_q = _pick_block(seq_q, block_q)
+    block_k = _pick_block(seq_k, block_k)
+
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * n_heads, seq_q, d)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, seq_k, d)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * n_kv, seq_k, d)
+    o3 = _flash(
+        q3, k3, v3, (n_heads, n_kv), float(scale), bool(causal),
+        (block_q, block_k), interpret,
+    )
+    return o3.reshape(b, n_heads, seq_q, d).transpose(0, 2, 1, 3)

@@ -34,6 +34,7 @@ class GPTConfig:
     mlp_mult: int = 4
     dropout: float = 0.1
     dtype: str = "float32"
+    use_flash: bool = False
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -53,6 +54,7 @@ class GPTBlock(nn.Module):
             dropout=cfg.dropout,
             use_bias=True,
             dtype=cfg.compute_dtype,
+            use_flash=cfg.use_flash,
             name="attn",
         )(LayerNorm(name="ln1")(x), positions=positions, cache=cache, deterministic=deterministic)
         x = x + h

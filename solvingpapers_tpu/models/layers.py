@@ -68,6 +68,7 @@ class Attention(nn.Module):
     dropout: float = 0.0
     use_bias: bool = False
     dtype: jnp.dtype = jnp.float32
+    use_flash: bool = False  # Pallas kernel for the uncached path
 
     @nn.compact
     def __call__(
@@ -113,24 +114,29 @@ class Attention(nn.Module):
             mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
             out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
         else:
-            mask = None
-            if self.causal:
-                out = ops.dot_product_attention(
-                    q,
-                    k,
-                    v,
-                    causal=True,
-                    dropout_rate=self.dropout,
-                    dropout_rng=(
-                        None if deterministic else self.make_rng("dropout")
-                    ),
-                    deterministic=deterministic,
+            # flash path has no attention-prob dropout; keep the dense path
+            # when that regularizer is active so training semantics hold
+            dropout_active = self.dropout > 0.0 and not deterministic
+            if self.use_flash and dropout_active:
+                import warnings
+
+                warnings.warn(
+                    "use_flash=True is ignored while attention dropout is "
+                    f"active (dropout={self.dropout}, train mode): the flash "
+                    "kernel has no prob-dropout. Set dropout=0.0 to train "
+                    "with the flash kernel.",
+                    stacklevel=2,
                 )
+            if self.use_flash and not dropout_active:
+                from solvingpapers_tpu.kernels import flash_attention
+
+                out = flash_attention(q, k, v, causal=self.causal)
             else:
                 out = ops.dot_product_attention(
                     q,
                     k,
                     v,
+                    causal=self.causal,
                     dropout_rate=self.dropout,
                     dropout_rng=(
                         None if deterministic else self.make_rng("dropout")

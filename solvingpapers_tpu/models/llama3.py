@@ -43,6 +43,7 @@ class LlamaConfig:
     norm_eps: float = 1e-6
     dropout: float = 0.0
     dtype: str = "float32"
+    use_flash: bool = False
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -70,6 +71,7 @@ class LlamaBlock(nn.Module):
             dropout=cfg.dropout,
             use_bias=False,
             dtype=cfg.compute_dtype,
+            use_flash=cfg.use_flash,
             name="attn",
         )(
             RMSNorm(eps=cfg.norm_eps, name="attn_norm")(x),

@@ -1,0 +1,88 @@
+"""Flash attention kernel vs. the dense jnp reference (interpret mode).
+
+SURVEY.md §4 test plan: every kernel ships with a pure-jnp reference and
+interpret-mode equality tests — forward and gradients, causal and
+bidirectional, MHA and GQA/MQA head layouts.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.kernels import flash_attention
+
+
+def make_qkv(key, b, sq, skv, n, n_kv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, n, d), dtype)
+    k = jax.random.normal(kk, (b, skv, n_kv, d), dtype)
+    v = jax.random.normal(kv, (b, skv, n_kv, d), dtype)
+    return q, k, v
+
+
+CASES = [
+    # (b, sq, skv, n, n_kv, d, causal)
+    pytest.param(2, 128, 128, 4, 4, 64, True, id="mha_causal"),
+    pytest.param(2, 128, 128, 4, 4, 64, False, id="mha_bidir"),
+    pytest.param(2, 128, 128, 4, 2, 32, True, id="gqa_causal"),
+    pytest.param(1, 128, 128, 4, 1, 32, True, id="mqa_causal"),
+    pytest.param(1, 256, 256, 2, 2, 64, True, id="multiblock_causal"),
+    pytest.param(1, 64, 256, 2, 2, 32, False, id="cross_qkv_lens"),
+]
+
+
+@pytest.mark.parametrize("b,sq,skv,n,n_kv,d,causal", CASES)
+def test_forward_matches_dense(b, sq, skv, n, n_kv, d, causal):
+    q, k, v = make_qkv(jax.random.key(0), b, sq, skv, n, n_kv, d)
+    out = flash_attention(q, k, v, causal=causal, interpret=True, block_q=64, block_k=64)
+    ref = ops.dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "b,sq,skv,n,n_kv,d,causal",
+    [CASES[0], CASES[2], CASES[4], CASES[1]],
+)
+def test_grads_match_dense(b, sq, skv, n, n_kv, d, causal):
+    q, k, v = make_qkv(jax.random.key(1), b, sq, skv, n, n_kv, d)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, interpret=True,
+                            block_q=64, block_k=64)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_dense(q, k, v):
+        o = ops.dot_product_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4)
+
+
+def test_odd_seq_falls_back_to_smaller_blocks():
+    # 96 = 64 + 32; _pick_block must find a divisor block (32)
+    q, k, v = make_qkv(jax.random.key(2), 1, 96, 96, 2, 2, 32)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_forward_close():
+    q, k, v = make_qkv(jax.random.key(3), 1, 128, 128, 2, 2, 64, jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_rejects_bad_head_ratio():
+    q, k, v = make_qkv(jax.random.key(4), 1, 64, 64, 3, 2, 32)
+    with pytest.raises(ValueError, match="not a multiple"):
+        flash_attention(q, k, v, interpret=True)
