@@ -51,7 +51,7 @@ def cmd_list(_args) -> int:
 def cmd_train(args) -> int:
     _apply_platform(args)
     from solvingpapers_tpu.configs import get_config
-    from solvingpapers_tpu.configs.factory import build_char_lm_run
+    from solvingpapers_tpu.configs.factory import build_char_lm_run, build_image_run
     from solvingpapers_tpu.metrics import ConsoleWriter, JSONLWriter, MultiWriter
     from solvingpapers_tpu.sharding import batch_sharding, create_mesh
     from solvingpapers_tpu.train import Trainer
@@ -68,14 +68,56 @@ def cmd_train(args) -> int:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
 
     mesh = create_mesh(cfg.train.mesh)
-    cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
-        cfg, sharding=batch_sharding(mesh)
-    )
     writer = ConsoleWriter()  # fit() gates cadence by log_every
     if args.jsonl:
         writer = MultiWriter(writer, JSONLWriter(args.jsonl))
-    trainer = Trainer(model, cfg.train, mesh=mesh)
-    trainer.fit(train_iter, eval_iter_fn, writer=writer)
+
+    kind = cfg.data.get("kind", "char")
+    if kind == "char":
+        cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
+            cfg, sharding=batch_sharding(mesh)
+        )
+        trainer = Trainer(model, cfg.train, mesh=mesh)
+        trainer.fit(train_iter, eval_iter_fn, writer=writer)
+        return 0
+    if kind == "images":
+        if cfg.model_family == "kd":
+            return _train_kd(cfg, mesh, writer)
+        model, train_iter, eval_iter_fn, loss_fn = build_image_run(cfg, mesh=mesh)
+        trainer = Trainer(model, cfg.train, loss_fn=loss_fn, mesh=mesh)
+        trainer.fit(train_iter, eval_iter_fn, writer=writer)
+        return 0
+    raise ValueError(f"unknown data kind {kind!r}")
+
+
+def _train_kd(cfg, mesh, writer) -> int:
+    """kd.py pipeline: pretrain teacher, freeze, distill student."""
+    import jax as _jax
+
+    from solvingpapers_tpu.configs.factory import build_image_run
+    from solvingpapers_tpu.models.kd import MLPClassifier, teacher_config
+    from solvingpapers_tpu.train import Trainer, make_kd_loss_fn
+
+    _, train_iter, eval_iter_fn, cls_loss = build_image_run(cfg, mesh=mesh)
+    teacher_steps = cfg.data.get("teacher_steps", 1200)
+    t_cfg = dataclasses.replace(
+        cfg.train, steps=teacher_steps, checkpoint_dir=None, ckpt_every=0
+    )
+    teacher = MLPClassifier(teacher_config(dtype=cfg.model.dtype))
+    print(f"[kd] pretraining teacher for {teacher_steps} steps")
+    t_trainer = Trainer(teacher, t_cfg, loss_fn=cls_loss, mesh=mesh)
+    t_state = t_trainer.fit(train_iter, eval_iter_fn, writer=writer)
+
+    print(f"[kd] distilling student for {cfg.train.steps} steps")
+    student = MLPClassifier(cfg.model)
+    kd_loss = make_kd_loss_fn(
+        teacher,
+        _jax.device_get(t_state.params),
+        temperature=cfg.data.get("temperature", 7.0),
+        alpha=cfg.data.get("alpha", 0.3),
+    )
+    s_trainer = Trainer(student, cfg.train, loss_fn=kd_loss, mesh=mesh)
+    s_trainer.fit(train_iter, eval_iter_fn, writer=writer)
     return 0
 
 

@@ -44,7 +44,62 @@ def build_model(cfg: RunConfig):
         from solvingpapers_tpu.models.autoencoder import VAE
 
         return VAE(cfg.model)
+    if fam == "kd":
+        from solvingpapers_tpu.models.kd import MLPClassifier
+
+        return MLPClassifier(cfg.model)
     raise ValueError(f"unknown model family {cfg.model_family!r}")
+
+
+def loss_fn_for(cfg: RunConfig):
+    """Objective for a RunConfig's family (kd's teacher phase uses
+    classification; its student phase is built in train.kd_pipeline)."""
+    from solvingpapers_tpu.train import (
+        classification_loss_fn,
+        lm_loss_fn,
+        reconstruction_loss_fn,
+        vae_loss_fn,
+    )
+
+    return {
+        "gpt": lm_loss_fn,
+        "llama3": lm_loss_fn,
+        "gemma": lm_loss_fn,
+        "deepseekv3": lm_loss_fn,
+        "vit": classification_loss_fn,
+        "alexnet": classification_loss_fn,
+        "kd": classification_loss_fn,
+        "ae": reconstruction_loss_fn,
+        "vae": vae_loss_fn,
+    }[cfg.model_family]
+
+
+def build_image_run(cfg: RunConfig, mesh=None):
+    """Returns (model, train_iter, eval_iter_fn, loss_fn) for image workloads."""
+    from solvingpapers_tpu.data.images import image_batch_iterator, load_image_dataset
+
+    d = cfg.data
+    tx, ty, vx, vy = load_image_dataset(
+        path=d.get("path"),
+        n_train=d.get("n_train", 8192),
+        n_test=d.get("n_test", 2048),
+        side=d.get("side", 28),
+        n_classes=d.get("n_classes", 10),
+        seed=cfg.train.seed,
+    )
+    flatten = d.get("flatten", False)
+    bsz = cfg.train.batch_size
+    model = build_model(cfg)
+    train_iter = image_batch_iterator(
+        tx, ty, bsz, seed=cfg.train.seed, flatten=flatten, mesh=mesh
+    )
+
+    def eval_iter_fn():
+        return image_batch_iterator(
+            vx, vy, bsz, seed=10_000, flatten=flatten, mesh=mesh, loop=False
+        )
+
+    return model, train_iter, eval_iter_fn, loss_fn_for(cfg)
 
 
 def build_char_lm_run(cfg: RunConfig, sharding=None):

@@ -1,0 +1,90 @@
+"""Image dataset loading + batch iteration.
+
+Capability target: the torchvision MNIST loaders of ViT.ipynb cells 4/7,
+autoencoder.ipynb cell 2 and kd.py:71-82. Zero-egress environment: a local
+.npz (keys: images, labels) is used when provided; otherwise the seeded
+synthetic MNIST-shaped set from data/synthetic.py (class-separable, so
+accuracy targets remain meaningful).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import jax
+import numpy as np
+
+from solvingpapers_tpu.data.synthetic import synthetic_images
+
+
+def load_image_dataset(
+    path: str | None = None,
+    *,
+    n_train: int = 8192,
+    n_test: int = 2048,
+    side: int = 28,
+    n_classes: int = 10,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (train_x, train_y, test_x, test_y); x is NHWC float32 in [0,1]."""
+    if path is not None and os.path.exists(path):
+        with np.load(path) as z:
+            images = z["images"].astype(np.float32)
+            labels = z["labels"].astype(np.int32)
+        if images.ndim == 3:
+            images = images[..., None]
+        if images.max() > 1.5:
+            images = images / 255.0
+        if len(images) < 2:
+            raise ValueError(f"dataset at {path} has {len(images)} images; need >= 2")
+        n_test = max(1, min(n_test, len(images) // 5))
+        split = len(images) - n_test
+        return images[:split], labels[:split], images[split:], labels[split:]
+    train_x, train_y = synthetic_images(n_train, side, n_classes, seed)
+    test_x, test_y = synthetic_images(n_test, side, n_classes, seed + 1)
+    return train_x, train_y, test_x, test_y
+
+
+def image_batch_iterator(
+    images: np.ndarray,
+    labels: np.ndarray,
+    batch_size: int,
+    *,
+    seed: int = 0,
+    flatten: bool = False,
+    mesh=None,
+    loop: bool = True,
+) -> Iterator[dict]:
+    """Yields {'x': images, 'y': labels} with per-epoch reshuffling.
+
+    flatten=True reshapes x to (B, H*W*C) for the MLP/AE families.
+    `mesh` device-puts batches sharded over the (data, fsdp) axes; x and y
+    get rank-appropriate specs (x is 2-D or 4-D, y is 1-D).
+    """
+    n = len(images)
+    if batch_size > n:
+        raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+    batch_shardings = None
+    if mesh is not None:
+        from solvingpapers_tpu.sharding.mesh import batch_sharding
+
+        x_dims = 1 if flatten else images.ndim - 1
+        batch_shardings = {
+            "x": batch_sharding(mesh, x_dims),
+            "y": batch_sharding(mesh, 0),
+        }
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(n)
+        for start in range(0, n - batch_size + 1, batch_size):
+            idx = order[start : start + batch_size]
+            x = images[idx]
+            if flatten:
+                x = x.reshape(len(idx), -1)
+            batch = {"x": x, "y": labels[idx]}
+            if batch_shardings is not None:
+                batch = jax.device_put(batch, batch_shardings)
+            yield batch
+        if not loop:
+            return

@@ -42,8 +42,11 @@ def _pick_block(seq: int, requested: int) -> int:
 # --------------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
-    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
+                offset):
+    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D). `offset` end-aligns the
+    # causal mask when seq_q != seq_k (ops.attention.causal_mask semantics:
+    # query i attends to kv positions <= i + (seq_k - seq_q)).
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
     d = q_ref.shape[2]
@@ -52,7 +55,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
     q = q_ref[0, :, :].astype(jnp.float32) * scale
     num_kb = seq_k // block_k
     if causal:
-        hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q, block_k))
+        hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q + offset, block_k))
     else:
         hi = num_kb
 
@@ -71,7 +74,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
             cols = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(cols <= rows, s, BIG_NEG)
+            s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_i - m_new)
@@ -104,7 +107,8 @@ def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
 
     grid = (bn, seq_q // block_q)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_k=block_k
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        offset=seq_k - seq_q,
     )
     return pl.pallas_call(
         kernel,
@@ -130,7 +134,7 @@ def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k):
+                   *, scale, causal, block_k, offset):
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
     j = pl.program_id(1)
@@ -140,7 +144,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     lse = lse_ref[0, 0, :][:, None]
     delta = delta_ref[0, 0, :][:, None]
     num_kb = seq_k // block_k
-    hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q, block_k)) if causal else num_kb
+    hi = (
+        jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q + offset, block_k))
+        if causal
+        else num_kb
+    )
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -153,7 +161,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, s.shape, 0
             )
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols <= rows, s, BIG_NEG)
+            s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -170,7 +178,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q):
+                    dk_ref, dv_ref, *, scale, causal, block_q, offset):
     block_k = k_ref.shape[1]
     seq_q = q_ref.shape[1]
     kb = pl.program_id(1)
@@ -179,7 +187,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_blk = k_ref[0, :, :].astype(jnp.float32)
     v_blk = v_ref[0, :, :].astype(jnp.float32)
     num_qb = seq_q // block_q
-    lo = (kb * block_k) // block_q if causal else 0
+    # first q block whose last row (jb+1)*bq - 1 + offset can reach col kb*bk
+    lo = jnp.maximum(kb * block_k - offset, 0) // block_q if causal else 0
 
     def body(jb, carry):
         dk, dv = carry
@@ -193,7 +202,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(cols <= rows, s, BIG_NEG)
+            s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         p = jnp.exp(s - lse)  # (bq, bk)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -254,7 +263,7 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, offset=seq_k - seq_q),
         grid=(bn, seq_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -271,7 +280,7 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
 
     dk_r, dv_r = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, offset=seq_k - seq_q),
         grid=(bn, seq_k // block_k),
         in_specs=[
             pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),

@@ -1,0 +1,99 @@
+"""Dense autoencoder + VAE.
+
+Capability targets:
+  * autoencoder/autoencoder.ipynb cell 4 — AutoEncoder 784-256-32-256-784
+    with ReLU hidden layers and Sigmoid output (MSE objective, cell 7)
+  * autoencoder/variational autoencoder.ipynb cells 5-6 — VAE(784,256,128)
+    with reparameterization and summed BCE + analytic KL (ops.vae_loss)
+
+Both operate on flattened images (B, input_dim) in [0, 1].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoEncoderConfig:
+    input_dim: int = 784
+    hidden_dim: int = 256
+    latent_dim: int = 32
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+
+class AutoEncoder(nn.Module):
+    cfg: AutoEncoderConfig
+
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        return self.decode(self.encode(x))
+
+    def setup(self):
+        cfg = self.cfg
+        dt = cfg.compute_dtype
+        self.enc1 = nn.Dense(cfg.hidden_dim, dtype=dt)
+        self.enc2 = nn.Dense(cfg.latent_dim, dtype=dt)
+        self.dec1 = nn.Dense(cfg.hidden_dim, dtype=dt)
+        self.dec2 = nn.Dense(cfg.input_dim, dtype=dt)
+
+    def encode(self, x: jax.Array) -> jax.Array:
+        return self.enc2(ops.relu(self.enc1(x.astype(self.cfg.compute_dtype))))
+
+    def decode(self, z: jax.Array) -> jax.Array:
+        return jax.nn.sigmoid(self.dec2(ops.relu(self.dec1(z))))
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    input_dim: int = 784
+    hidden_dim: int = 256
+    latent_dim: int = 128
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+
+class VAE(nn.Module):
+    cfg: VAEConfig
+
+    def setup(self):
+        cfg = self.cfg
+        dt = cfg.compute_dtype
+        self.enc = nn.Dense(cfg.hidden_dim, dtype=dt)
+        self.mu_head = nn.Dense(cfg.latent_dim, dtype=dt)
+        self.logvar_head = nn.Dense(cfg.latent_dim, dtype=dt)
+        self.dec1 = nn.Dense(cfg.hidden_dim, dtype=dt)
+        self.dec2 = nn.Dense(cfg.input_dim, dtype=dt)
+
+    def encode(self, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        h = ops.relu(self.enc(x.astype(self.cfg.compute_dtype)))
+        return self.mu_head(h), self.logvar_head(h)
+
+    def reparameterize(self, mu, logvar, *, deterministic: bool = False):
+        """z = mu + eps * sigma (variational autoencoder.ipynb cell 5)."""
+        if deterministic:
+            return mu
+        eps = jax.random.normal(self.make_rng("sample"), mu.shape, mu.dtype)
+        return mu + eps * jnp.exp(0.5 * logvar)
+
+    def decode(self, z: jax.Array) -> jax.Array:
+        return jax.nn.sigmoid(self.dec2(ops.relu(self.dec1(z))))
+
+    def __call__(
+        self, x: jax.Array, *, deterministic: bool = False
+    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        mu, logvar = self.encode(x)
+        z = self.reparameterize(mu, logvar, deterministic=deterministic)
+        return self.decode(z), mu, logvar

@@ -1,0 +1,56 @@
+"""Knowledge-distillation teacher/student MLP pair.
+
+Capability target: knowledge distillation/kd.py — Teacher 784-1024-1024-10
+(kd.py:17-30), Student 784-256-10 (kd.py:33-45), distillation loss T=7,
+alpha=0.3 (ops.distillation_loss, kd.py:48-68). The reference pipeline
+(pretrain teacher 3 epochs, freeze, distill student 10 epochs, kd.py:85-142)
+is train.objectives.make_kd_loss_fn + two Trainer runs; run screenshot
+records 97.50% student accuracy at epoch 10.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPClassifierConfig:
+    input_dim: int = 784
+    hidden_dims: tuple[int, ...] = (1024, 1024)  # teacher; student: (256,)
+    n_classes: int = 10
+    dropout: float = 0.0
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+
+def teacher_config(**kw) -> MLPClassifierConfig:
+    return MLPClassifierConfig(hidden_dims=(1024, 1024), **kw)
+
+
+def student_config(**kw) -> MLPClassifierConfig:
+    return MLPClassifierConfig(hidden_dims=(256,), **kw)
+
+
+class MLPClassifier(nn.Module):
+    """ReLU MLP over flattened images; serves as both Teacher and Student."""
+
+    cfg: MLPClassifierConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.cfg
+        x = x.reshape(x.shape[0], -1).astype(cfg.compute_dtype)
+        for i, h in enumerate(cfg.hidden_dims):
+            x = ops.relu(nn.Dense(h, dtype=cfg.compute_dtype, name=f"fc{i}")(x))
+            if cfg.dropout > 0.0:
+                x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        return nn.Dense(cfg.n_classes, dtype=cfg.compute_dtype, name="head")(x)

@@ -5,7 +5,7 @@ notebook: norms, RoPE (both formulations), activations, attention cores,
 losses, and samplers.
 """
 
-from solvingpapers_tpu.ops.norms import rms_norm, layer_norm
+from solvingpapers_tpu.ops.norms import rms_norm, layer_norm, local_response_norm
 from solvingpapers_tpu.ops.rope import (
     precompute_rope,
     precompute_freqs_cis,

@@ -27,6 +27,35 @@ def rms_norm(x: jax.Array, weight: jax.Array | None = None, eps: float = 1e-6) -
     return y.astype(dtype)
 
 
+def local_response_norm(
+    x: jax.Array,
+    size: int = 5,
+    alpha: float = 1e-4,
+    beta: float = 0.75,
+    k: float = 1.0,
+) -> jax.Array:
+    """AlexNet cross-channel LRN: x / (k + alpha/size * sum_adj(x^2))^beta.
+
+    Matches torch.nn.LocalResponseNorm semantics used by alexnet/alexnet.py:9
+    (channel-last layout here: x is (..., C); the window of `size` channels
+    is centered on each channel with zero padding).
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    sq = jnp.square(x32)
+    half = size // 2
+    # sum over a sliding channel window via cumulative sums
+    pad = [(0, 0)] * (sq.ndim - 1) + [(half, size - 1 - half)]
+    padded = jnp.pad(sq, pad)
+    csum = jnp.cumsum(padded, axis=-1)
+    zero = jnp.zeros_like(csum[..., :1])
+    csum = jnp.concatenate([zero, csum], axis=-1)
+    c = x.shape[-1]
+    window = csum[..., size : size + c] - csum[..., :c]
+    denom = jnp.power(k + (alpha / size) * window, beta)
+    return (x32 / denom).astype(dtype)
+
+
 def layer_norm(
     x: jax.Array,
     weight: jax.Array | None = None,

@@ -92,6 +92,7 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._state_shardings = None
+        self._batch_shardings = None
 
     # ------------------------------------------------------------------ init
 
@@ -106,6 +107,7 @@ class Trainer:
             )
 
         rng = jax.random.key(cfg.seed)
+        self._set_batch_shardings(example_batch)
         abstract = jax.eval_shape(make, rng)
         specs = param_specs(abstract, self.rules, mesh=self.mesh)
         self._state_shardings = jax.tree.map(
@@ -115,10 +117,16 @@ class Trainer:
         state = jax.jit(make, out_shardings=self._state_shardings)(rng)
         return state
 
+    def _set_batch_shardings(self, example_batch: dict) -> None:
+        """Record rank-appropriate batch shardings (x may be 2-D tokens or
+        4-D images; y may be 2-D targets or 1-D labels)."""
+        self._batch_shardings = jax.tree.map(
+            lambda a: batch_sharding(self.mesh, jnp.ndim(a) - 1), example_batch
+        )
+
     # ------------------------------------------------------------------ steps
 
     def _build_steps(self):
-        bs = batch_sharding(self.mesh)
         replicated = NamedSharding(self.mesh, P())
 
         def train_step(state: TrainState, batch: dict):
@@ -149,7 +157,13 @@ class Trainer:
             )
             return {"val_loss": loss, **{f"val_{k}": v for k, v in aux.items()}}
 
-        data_sharding = jax.tree.map(lambda _: bs, {"x": 0, "y": 0})
+        if self._batch_shardings is None:
+            raise RuntimeError(
+                "batch shardings unknown: call init_state(example_batch) or "
+                "fit() (which derives them from the first batch) before "
+                "building steps"
+            )
+        data_sharding = self._batch_shardings
         self._train_step = jax.jit(
             train_step,
             in_shardings=(self._state_shardings, data_sharding),
@@ -179,7 +193,9 @@ class Trainer:
             first = next(batch_iter)
             state = self.init_state(first)
         else:
-            first = None
+            first = next(batch_iter) if self._batch_shardings is None else None
+            if first is not None:
+                self._set_batch_shardings(first)
         if self._train_step is None:
             self._build_steps()
 
@@ -237,6 +253,13 @@ class Trainer:
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
         if self._eval_step is None:
+            if self._batch_shardings is None:
+                import itertools
+
+                eval_iter = iter(eval_iter)
+                first = next(eval_iter)
+                self._set_batch_shardings(first)
+                eval_iter = itertools.chain([first], eval_iter)
             self._build_steps()
         acc: dict[str, float] = {}
         n = 0

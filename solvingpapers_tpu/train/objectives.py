@@ -1,0 +1,85 @@
+"""Task objectives for the shared Trainer.
+
+Each reference training loop's objective as a LossFn
+(model, params, batch, rng, model_state, train) -> (loss, aux, model_state):
+
+  * lm_loss_fn (train/engine.py)    — gpt/llama3/gemma/deepseekv3 LM CE
+  * classification_loss_fn          — ViT.ipynb cell 13, kd.py teacher
+  * reconstruction_loss_fn          — autoencoder.ipynb cells 6-7 (MSE)
+  * vae_loss_fn                     — variational autoencoder.ipynb cell 6
+  * make_kd_loss_fn                 — kd.py:48-68 distillation objective
+                                      (teacher frozen under stop_gradient)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from solvingpapers_tpu import ops
+
+
+def classification_loss_fn(model, params, batch, rng, model_state, train):
+    """CE over class logits + accuracy (ViT.ipynb cells 13-15; kd.py:145-156)."""
+    logits = model.apply(
+        {"params": params},
+        batch["x"],
+        deterministic=not train,
+        rngs={"dropout": rng} if train else None,
+    )
+    loss = ops.cross_entropy(logits, batch["y"])
+    acc = jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))
+    return loss, {"accuracy": acc}, model_state
+
+
+def reconstruction_loss_fn(model, params, batch, rng, model_state, train):
+    """Mean-square reconstruction of the input (autoencoder.ipynb cell 7)."""
+    recon = model.apply({"params": params}, batch["x"], deterministic=not train)
+    x32 = batch["x"].astype(jnp.float32)
+    loss = jnp.mean(jnp.square(recon.astype(jnp.float32) - x32))
+    return loss, {}, model_state
+
+
+def vae_loss_fn(model, params, batch, rng, model_state, train):
+    """Summed BCE + KL ELBO (variational autoencoder.ipynb cells 6, 8)."""
+    recon, mu, logvar = model.apply(
+        {"params": params},
+        batch["x"],
+        deterministic=not train,
+        rngs={"sample": rng} if train else None,
+    )
+    total, bce, kl = ops.vae_loss(recon, batch["x"], mu, logvar)
+    # reference reports the batch-summed loss; optimize the per-sample mean
+    # so LR settings are batch-size independent
+    n = batch["x"].shape[0]
+    return total / n, {"bce": bce / n, "kl": kl / n}, model_state
+
+
+def make_kd_loss_fn(teacher_model, teacher_params, temperature=7.0, alpha=0.3):
+    """Distillation objective with a frozen teacher (kd.py:48-68, 110-142).
+
+    The teacher forward runs inside the jitted step under stop_gradient —
+    the functional equivalent of the reference's `with torch.no_grad()`.
+    """
+
+    def kd_loss_fn(model, params, batch, rng, model_state, train):
+        teacher_logits = jax.lax.stop_gradient(
+            teacher_model.apply(
+                {"params": teacher_params}, batch["x"], deterministic=True
+            )
+        )
+        student_logits = model.apply(
+            {"params": params},
+            batch["x"],
+            deterministic=not train,
+            rngs={"dropout": rng} if train else None,
+        )
+        loss = ops.distillation_loss(
+            student_logits, teacher_logits, batch["y"], temperature, alpha
+        )
+        acc = jnp.mean(
+            (jnp.argmax(student_logits, -1) == batch["y"]).astype(jnp.float32)
+        )
+        return loss, {"accuracy": acc}, model_state
+
+    return kd_loss_fn

@@ -32,6 +32,9 @@ CASES = [
     pytest.param(1, 128, 128, 4, 1, 32, True, id="mqa_causal"),
     pytest.param(1, 256, 256, 2, 2, 64, True, id="multiblock_causal"),
     pytest.param(1, 64, 256, 2, 2, 32, False, id="cross_qkv_lens"),
+    # end-aligned causal mask: query i sees kv <= i + (skv - sq)
+    pytest.param(1, 64, 256, 2, 2, 32, True, id="cross_qkv_lens_causal"),
+    pytest.param(2, 128, 192, 4, 2, 32, True, id="cross_gqa_causal"),
 ]
 
 
@@ -45,7 +48,7 @@ def test_forward_matches_dense(b, sq, skv, n, n_kv, d, causal):
 
 @pytest.mark.parametrize(
     "b,sq,skv,n,n_kv,d,causal",
-    [CASES[0], CASES[2], CASES[4], CASES[1]],
+    [CASES[0], CASES[2], CASES[4], CASES[1], CASES[6]],
 )
 def test_grads_match_dense(b, sq, skv, n, n_kv, d, causal):
     q, k, v = make_qkv(jax.random.key(1), b, sq, skv, n, n_kv, d)

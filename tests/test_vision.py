@@ -1,0 +1,168 @@
+"""Vision/MLP zoo tests: ViT accuracy, AE/VAE reconstruction, KD pipeline,
+AlexNet forward, LRN vs torch semantics (SURVEY.md §4 targets: 97.25% ViT /
+97.50% KD on MNIST — here asserted as 'well above chance' on the synthetic
+class-separable set, since MNIST itself is not downloadable offline).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.data.images import image_batch_iterator, load_image_dataset
+from solvingpapers_tpu.models.alexnet import AlexNet, AlexNetConfig
+from solvingpapers_tpu.models.autoencoder import (
+    AutoEncoder,
+    AutoEncoderConfig,
+    VAE,
+    VAEConfig,
+)
+from solvingpapers_tpu.models.kd import MLPClassifier, student_config, teacher_config
+from solvingpapers_tpu.models.vit import ViT, ViTConfig
+from solvingpapers_tpu.train import (
+    OptimizerConfig,
+    TrainConfig,
+    Trainer,
+    classification_loss_fn,
+    make_kd_loss_fn,
+    reconstruction_loss_fn,
+    vae_loss_fn,
+)
+
+
+def small_train_cfg(steps, lr=1e-3, batch=32):
+    return TrainConfig(
+        steps=steps, batch_size=batch, log_every=10_000, eval_every=0,
+        optimizer=OptimizerConfig(max_lr=lr, warmup_steps=5, total_steps=steps),
+    )
+
+
+def one_device_mesh():
+    """Single-device mesh: the 8-virtual-device default oversubscribes the
+    1-core CPU host and can deadlock the all-reduce rendezvous (40s XLA
+    timeout). Multi-device meshes are exercised only by the short
+    sharded-equality tests."""
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+
+    return create_mesh(MeshConfig(data=1, fsdp=1, model=1), jax.devices()[:1])
+
+
+def run_steps(trainer, it, steps):
+    b0 = next(it)
+    state = trainer.init_state(b0)
+    trainer._build_steps()
+    state, m = trainer._train_step(state, b0)
+    first = jax.device_get(m)
+    for _ in range(steps):
+        state, m = trainer._train_step(state, next(it))
+    return state, first, jax.device_get(m)
+
+
+def test_vit_learns_classification():
+    tx, ty, _, _ = load_image_dataset(n_train=2048, n_test=1)
+    cfg = ViTConfig(dim=32, n_layers=2, n_heads=2)
+    trainer = Trainer(ViT(cfg), small_train_cfg(120, lr=3e-3),
+                      loss_fn=classification_loss_fn, mesh=one_device_mesh())
+    it = image_batch_iterator(tx, ty, 32, seed=0)
+    _, first, last = run_steps(trainer, it, 120)
+    assert last["train_accuracy"] > 0.65, (first, last)
+    assert last["train_loss"] < first["train_loss"]
+
+
+def test_autoencoder_reconstructs():
+    tx, ty, _, _ = load_image_dataset(n_train=1024, n_test=1)
+    model = AutoEncoder(AutoEncoderConfig())
+    trainer = Trainer(model, small_train_cfg(80, lr=2e-3),
+                      loss_fn=reconstruction_loss_fn, mesh=one_device_mesh())
+    it = image_batch_iterator(tx, ty, 32, seed=0, flatten=True)
+    _, first, last = run_steps(trainer, it, 80)
+    # untrained MSE vs mean-ish reconstruction; must drop substantially
+    assert last["train_loss"] < 0.6 * first["train_loss"], (first, last)
+
+
+def test_vae_elbo_decreases_and_parts_logged():
+    tx, ty, _, _ = load_image_dataset(n_train=1024, n_test=1)
+    model = VAE(VAEConfig(latent_dim=16, hidden_dim=64))
+    trainer = Trainer(model, small_train_cfg(80, lr=1e-3), loss_fn=vae_loss_fn,
+                      mesh=one_device_mesh())
+    it = image_batch_iterator(tx, ty, 32, seed=0, flatten=True)
+    _, first, last = run_steps(trainer, it, 80)
+    assert last["train_loss"] < first["train_loss"]
+    assert "train_bce" in last and "train_kl" in last
+    assert last["train_kl"] >= 0.0
+
+
+def test_kd_student_learns_from_frozen_teacher():
+    """kd.py pipeline: pretrain teacher, freeze, distill student."""
+    tx, ty, _, _ = load_image_dataset(n_train=2048, n_test=1)
+
+    teacher = MLPClassifier(teacher_config())
+    t_trainer = Trainer(teacher, small_train_cfg(100, lr=1e-3),
+                        loss_fn=classification_loss_fn, mesh=one_device_mesh())
+    t_it = image_batch_iterator(tx, ty, 64, seed=0, flatten=True)
+    t_state, _, t_last = run_steps(t_trainer, t_it, 100)
+    assert t_last["train_accuracy"] > 0.7, t_last
+
+    student = MLPClassifier(student_config())
+    s_trainer = Trainer(
+        student, small_train_cfg(100, lr=1e-3),
+        loss_fn=make_kd_loss_fn(teacher, jax.device_get(t_state.params)),
+        mesh=one_device_mesh(),
+    )
+    s_it = image_batch_iterator(tx, ty, 64, seed=1, flatten=True)
+    _, s_first, s_last = run_steps(s_trainer, s_it, 100)
+    assert s_last["train_accuracy"] > 0.7, (s_first, s_last)
+    assert s_last["train_loss"] < s_first["train_loss"]
+
+
+def test_alexnet_forward_shape():
+    model = AlexNet(AlexNetConfig(n_classes=10, in_channels=3))
+    x = jnp.zeros((2, 224, 224, 3))
+    params = model.init({"params": jax.random.key(0)}, x)["params"]
+    logits = model.apply({"params": params}, x, deterministic=True)
+    assert logits.shape == (2, 10)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_local_response_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, 16)).astype(np.float32)
+    ours = np.asarray(ops.local_response_norm(jnp.asarray(x), size=5))
+    # torch LRN is NCHW
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    ref = torch.nn.LocalResponseNorm(5)(xt).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_vae_sampling_is_stochastic_in_train_mode():
+    model = VAE(VAEConfig(latent_dim=4, hidden_dim=16, input_dim=32))
+    x = jnp.ones((2, 32)) * 0.5
+    params = model.init(
+        {"params": jax.random.key(0), "sample": jax.random.key(1)}, x
+    )["params"]
+    r1, _, _ = model.apply({"params": params}, x, rngs={"sample": jax.random.key(2)})
+    r2, _, _ = model.apply({"params": params}, x, rngs={"sample": jax.random.key(3)})
+    det, mu, _ = model.apply({"params": params}, x, deterministic=True)
+    assert not np.allclose(np.asarray(r1), np.asarray(r2))
+    det2, _, _ = model.apply({"params": params}, x, deterministic=True)
+    np.testing.assert_array_equal(np.asarray(det), np.asarray(det2))
+
+
+def test_sharded_vit_matches_single_device(devices):
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+
+    tx, ty, _, _ = load_image_dataset(n_train=512, n_test=1)
+    cfg = ViTConfig(dim=32, n_layers=2, n_heads=2)
+
+    def run(mesh_cfg, devs):
+        mesh = create_mesh(mesh_cfg, devs)
+        trainer = Trainer(ViT(cfg), small_train_cfg(2, lr=1e-3, batch=16),
+                          loss_fn=classification_loss_fn, mesh=mesh)
+        it = image_batch_iterator(tx, ty, 16, seed=5, mesh=mesh)
+        _, first, last = run_steps(trainer, it, 2)
+        return [first["train_loss"], last["train_loss"]]
+
+    single = run(MeshConfig(data=1, fsdp=1, model=1), devices[:1])
+    sharded = run(MeshConfig(data=4, fsdp=2, model=1), devices)
+    np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-5)
