@@ -51,7 +51,12 @@ def cmd_list(_args) -> int:
 def cmd_train(args) -> int:
     _apply_platform(args)
     from solvingpapers_tpu.configs import get_config
-    from solvingpapers_tpu.configs.factory import build_char_lm_run, build_image_run
+    from solvingpapers_tpu.configs.factory import (
+        build_char_lm_run,
+        build_image_run,
+        init_fn_for,
+        loss_fn_for,
+    )
     from solvingpapers_tpu.metrics import ConsoleWriter, JSONLWriter, MultiWriter
     from solvingpapers_tpu.sharding import batch_sharding, create_mesh
     from solvingpapers_tpu.train import Trainer
@@ -77,7 +82,10 @@ def cmd_train(args) -> int:
         cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
             cfg, sharding=batch_sharding(mesh)
         )
-        trainer = Trainer(model, cfg.train, mesh=mesh)
+        trainer = Trainer(
+            model, cfg.train, loss_fn=loss_fn_for(cfg),
+            init_fn=init_fn_for(cfg), mesh=mesh,
+        )
         trainer.fit(train_iter, eval_iter_fn, writer=writer)
         return 0
     if kind == "images":
@@ -137,12 +145,15 @@ def cmd_sample(args) -> int:
     rng = jax.random.key(args.seed)
     prompt_text = args.prompt or "\n"
     prompt = jnp.asarray(tok.encode(prompt_text), jnp.int32)[None, :]
-    params = model.init({"params": rng}, prompt)["params"]
+    variables = model.init({"params": rng}, prompt)
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
 
     if args.checkpoint_dir:
+        from solvingpapers_tpu.configs.factory import init_fn_for
         from solvingpapers_tpu.train import Trainer
 
-        trainer = Trainer(model, cfg.train)
+        trainer = Trainer(model, cfg.train, init_fn=init_fn_for(cfg))
         state = trainer.init_state({"x": prompt, "y": prompt})
         from solvingpapers_tpu.train.engine import _pure_state
 
@@ -152,6 +163,8 @@ def cmd_sample(args) -> int:
             print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
             return 1
         params = restored[0]["params"]
+        if restored[0].get("model_state"):
+            extra = restored[0]["model_state"]
 
     sampler = (
         ops.sample_greedy
@@ -159,7 +172,8 @@ def cmd_sample(args) -> int:
         else functools.partial(ops.sample_top_k, k=args.top_k, temperature=args.temperature)
     )
     out = generate(
-        model, params, prompt, rng, max_new_tokens=args.max_new_tokens, sampler=sampler
+        model, params, prompt, rng, max_new_tokens=args.max_new_tokens,
+        sampler=sampler, extra_variables=extra or None,
     )
     print(tok.decode(np.asarray(out[0])))
     return 0

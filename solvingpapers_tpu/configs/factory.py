@@ -60,18 +60,28 @@ def loss_fn_for(cfg: RunConfig):
         reconstruction_loss_fn,
         vae_loss_fn,
     )
+    from solvingpapers_tpu.train.objectives import dsv3_loss_fn
 
     return {
         "gpt": lm_loss_fn,
         "llama3": lm_loss_fn,
         "gemma": lm_loss_fn,
-        "deepseekv3": lm_loss_fn,
+        "deepseekv3": dsv3_loss_fn,
         "vit": classification_loss_fn,
         "alexnet": classification_loss_fn,
         "kd": classification_loss_fn,
         "ae": reconstruction_loss_fn,
         "vae": vae_loss_fn,
     }[cfg.model_family]
+
+
+def init_fn_for(cfg: RunConfig):
+    """Trainer init_fn override (None = default params-only init)."""
+    if cfg.model_family == "deepseekv3":
+        from solvingpapers_tpu.train.objectives import dsv3_init_fn
+
+        return dsv3_init_fn
+    return None
 
 
 def build_image_run(cfg: RunConfig, mesh=None):

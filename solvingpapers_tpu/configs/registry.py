@@ -148,6 +148,74 @@ def _llama3_shakespeare() -> RunConfig:
     )
 
 
+@register("dsv3_tinystories")
+def _dsv3_tinystories() -> RunConfig:
+    """deepseekv3/deepseekv3.ipynb cells 4, 42-44, 54: the reference flagship.
+
+    196.08M params; 10k steps x 4,096 tok/step (bs 16 x block 256); AdamW
+    6e-4 beta=(0.9,0.95) wd 0.1 clip 1.0, warmup 400 -> cosine to 0.1*max;
+    final train loss 2.90068 / ppl 18.18644 on 2xT4 (readme tables).
+    The notebook tokenizes TinyStories with GPT-2 BPE; offline default here
+    is the char pipeline (factory resizes the vocab).
+    """
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config
+
+    return RunConfig(
+        name="dsv3_tinystories",
+        model_family="deepseekv3",
+        model=DeepSeekV3Config(dtype="bfloat16"),
+        train=TrainConfig(
+            steps=10_000,
+            batch_size=16,
+            log_every=100,
+            eval_every=500,
+            eval_batches=20,
+            ckpt_every=1000,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=6e-4, warmup_steps=400, total_steps=10_000,
+                b1=0.9, b2=0.95, weight_decay=0.1, grad_clip=1.0, eps=1e-8,
+            ),
+            tokens_per_step=16 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="deepseekv3 readme: loss 2.90068 / ppl 18.18644 @ 10k steps",
+    )
+
+
+@register("gemma_char")
+def _gemma_char() -> RunConfig:
+    """gemma/gemma.ipynb hyperparameters (char Tiny-Shakespeare).
+
+    Reference: dim 768, 12 layers, 4/2 heads, block 128, batch 64. The
+    notebook's cell-1 beta/wd knobs are DEAD — cell 17 constructs plain
+    torch AdamW(lr=2.5e-4), i.e. betas (0.9, 0.999), wd 0.01, constant LR,
+    no clipping; those actually-used values are what this config encodes.
+    Run stopped at step 3500 of 5000 (markdown cell 19).
+    """
+    from solvingpapers_tpu.models.gemma import GemmaConfig
+
+    return RunConfig(
+        name="gemma_char",
+        model_family="gemma",
+        model=GemmaConfig(dtype="bfloat16"),
+        train=TrainConfig(
+            steps=5000,
+            batch_size=64,
+            log_every=100,
+            eval_every=500,
+            eval_batches=20,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=2.5e-4, warmup_steps=0, total_steps=5000,
+                b1=0.9, b2=0.999, weight_decay=0.01, grad_clip=0.0,
+                min_lr_ratio=1.0,
+            ),
+            tokens_per_step=64 * 128,
+        ),
+        data={"kind": "char", "path": None, "block_size": 128},
+        notes="gemma.ipynb cells 1, 17-18; 127.5M params, stopped at 3500 steps",
+    )
+
+
 @register("vit_mnist")
 def _vit_mnist() -> RunConfig:
     """vision transformer/ViT.ipynb cells 4-15: tiny ViT on MNIST-shaped data.

@@ -1,4 +1,9 @@
 """Jitted inference: preallocated KV/latent caches + prefill/decode loops."""
 
-from solvingpapers_tpu.infer.cache import KVCache, update_kv_cache
+from solvingpapers_tpu.infer.cache import (
+    KVCache,
+    LatentCache,
+    update_kv_cache,
+    update_latent_cache,
+)
 from solvingpapers_tpu.infer.decode import generate

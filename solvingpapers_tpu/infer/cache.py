@@ -42,6 +42,40 @@ class KVCache:
         return self.k.shape[1]
 
 
+@struct.dataclass
+class LatentCache:
+    """MLA compressed-KV cache: stores latents (batch, max_len, latent_dim),
+    not decompressed k/v — the point of multi-head latent attention
+    (deepseekv3/deepseekv3.ipynb cell 25). One cache per layer, shared by
+    all heads (the paper's layout; the reference instead threads a single
+    cache through heads AND layers, growing it per head — a quirk documented
+    in SURVEY.md §2.2 and deliberately not reproduced)."""
+
+    c: jax.Array
+
+    @classmethod
+    def init(
+        cls, batch: int, max_len: int, latent_dim: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "LatentCache":
+        return cls(c=jnp.zeros((batch, max_len, latent_dim), dtype))
+
+    @property
+    def max_len(self) -> int:
+        return self.c.shape[1]
+
+
+def update_latent_cache(
+    cache: LatentCache, c_new: jax.Array, index: jax.Array
+) -> LatentCache:
+    """Write latents (B, S, L) at sequence offset `index`."""
+    return LatentCache(
+        c=jax.lax.dynamic_update_slice(
+            cache.c, c_new.astype(cache.c.dtype), (0, index, 0)
+        )
+    )
+
+
 def update_kv_cache(
     cache: KVCache, k_new: jax.Array, v_new: jax.Array, index: jax.Array
 ) -> KVCache:

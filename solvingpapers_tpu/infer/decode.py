@@ -32,11 +32,14 @@ def generate(
     max_new_tokens: int = 64,
     sampler: Callable = ops.sample_greedy,
     max_len: int | None = None,
+    extra_variables: dict | None = None,
 ) -> jax.Array:
     """Generate `max_new_tokens` continuations of `prompt` (B, S0) int32.
 
     Returns (B, S0 + max_new_tokens). The whole function is one XLA program:
     a prefill pass filling the caches, then a scan of single-token steps.
+    `extra_variables` carries non-param collections (e.g. DeepSeekV3's
+    'moe_state' routing bias).
     """
     b, s0 = prompt.shape
     total = s0 + max_new_tokens
@@ -52,7 +55,7 @@ def generate(
 
     caches = model.init_caches(b, max_len)
     positions = jnp.broadcast_to(jnp.arange(s0), (b, s0))
-    variables = {"params": params}
+    variables = {"params": params, **(extra_variables or {})}
     logits, caches = model.apply(
         variables, prompt, positions=positions, caches=caches, deterministic=True
     )

@@ -8,6 +8,7 @@ vit, alexnet, autoencoder/vae, kd teacher/student.
 from solvingpapers_tpu.models.layers import Attention, MLP, GLUFFN, RMSNorm, LayerNorm
 from solvingpapers_tpu.models.gpt import GPT, GPTConfig
 from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+from solvingpapers_tpu.models.gemma import Gemma, GemmaConfig
 from solvingpapers_tpu.models.vit import ViT, ViTConfig
 from solvingpapers_tpu.models.alexnet import AlexNet, AlexNetConfig
 from solvingpapers_tpu.models.autoencoder import (

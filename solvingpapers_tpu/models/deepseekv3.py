@@ -1,0 +1,336 @@
+"""DeepSeek-V3-style decoder: MLA + MoE + optional MTP.
+
+Capability target: deepseekv3/deepseekv3.ipynb — the reference's flagship.
+  * config (cell 4): block 256, dim 512, 8 heads, 6 layers, latent 64,
+    8 experts top-2 + shared expert, aux-free load balancing (rate 0.001),
+    noisy top-k off, mtp_heads 0, vocab 50257, dropout 0.1
+  * sinusoidal PE added to embeddings (cells 16-17; the `base_freq` config
+    knob is dead in the reference — not reproduced)
+  * MLA with absorbed query attending latents directly (cell 25)
+  * MoE with masked-softmax top-2 over biased gate logits, shared expert,
+    no-grad bias update sign(mean(load)-load) (cell 23)
+  * depth scaling 2*L^-0.5 after the layer stack, final RMSNorm, lm_head
+    weight-tied to the embedding (cell 31)
+  * MTP: per extra head k, merge Linear(2D->D) of [norm(h), norm(emb of
+    token i+k)] -> extra DecoderLayer -> proj head -> shared lm_head
+    (cell 33's machinery, vectorized; the shipped config disables it)
+
+TPU-first divergences (documented per SURVEY.md hard part #2):
+  * One latent per layer shared by all heads with per-head decompression
+    (the paper's MLA); the reference gives each head its own W_dkv and
+    threads one growing cache through heads AND layers (cell 27 quirk).
+  * MoE dispatch is static-shape one-hot einsums over expert capacity slots
+    (ops/moe.py), not a python loop; expert weights are stacked (E, ...)
+    so the `expert` mesh axis shards them (EP via GSPMD all_to_all).
+  * MTP is computed for all positions in parallel, not a per-position
+    python loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.infer.cache import LatentCache, update_latent_cache
+from solvingpapers_tpu.models.layers import GLUFFN, RMSNorm, LayerNorm, swiglu_hidden_dim
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepSeekV3Config:
+    vocab_size: int = 50257
+    block_size: int = 256
+    dim: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    latent_dim: int = 64
+    n_experts: int = 8
+    top_experts: int = 2
+    use_shared_expert: bool = True
+    noisy_topk: bool = False
+    use_aux_free: bool = True
+    aux_free_bias_update_rate: float = 0.001
+    moe_impl: str = "dispatch"  # dispatch | dense
+    capacity_factor: float = 2.0
+    mtp_heads: int = 0
+    mtp_loss_weight: float = 0.3
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    norm_eps: float = 1e-6
+    dtype: str = "float32"
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def expert_hidden(self) -> int:
+        return swiglu_hidden_dim(self.dim)  # ((2D)*4)//3, cell 21
+
+
+class MLA(nn.Module):
+    """Multi-head latent attention with absorbed queries (cell 25).
+
+    The (B, S, L) latent is both the cache and the attention target:
+    scores = (x W_q W_k^T) @ latent^T, context = probs @ latent, decompressed
+    per head only on output (@ W_v). No (S, head_dim) k/v are materialized.
+    """
+
+    cfg: DeepSeekV3Config
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        n, hd, lat = cfg.n_heads, cfg.head_dim, cfg.latent_dim
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+        latent = nn.Dense(
+            lat, use_bias=False, dtype=cfg.compute_dtype, name="w_dkv"
+        )(x)  # (B, S, L)
+        init = nn.initializers.normal(0.02)
+        w_q = self.param("w_q", init, (cfg.dim, n, hd))
+        w_k = self.param("w_k", init, (lat, n, hd))
+        w_v = self.param("w_v", init, (lat, n, hd))
+
+        dt = cfg.compute_dtype
+        q = jnp.einsum("bsd,dnh->bsnh", x.astype(dt), w_q.astype(dt))
+        # absorbed query: project q into latent space once, score vs latents
+        q_lat = jnp.einsum("bsnh,lnh->bsnl", q, w_k.astype(dt))
+
+        if cache is not None:
+            cache = update_latent_cache(cache, latent, positions[0, 0])
+            c_full = cache.c
+            kv_idx = jnp.arange(cache.max_len)
+            mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
+        else:
+            c_full = latent
+            q_idx = jnp.arange(s)
+            mask = (q_idx[None, :, None] >= q_idx[None, None, :])[:, None]
+
+        scores = (
+            jnp.einsum("bsnl,btl->bnst", q_lat, c_full.astype(dt)).astype(
+                jnp.float32
+            )
+            * hd**-0.5
+        )
+        scores = jnp.where(mask, scores, ops.attention.BIG_NEG)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if cfg.attn_dropout > 0.0 and not deterministic:
+            keep = jax.random.bernoulli(
+                self.make_rng("dropout"), 1.0 - cfg.attn_dropout, probs.shape
+            )
+            probs = probs * keep / (1.0 - cfg.attn_dropout)
+        probs = probs.astype(dt)
+
+        ctx = jnp.einsum("bnst,btl->bsnl", probs, c_full.astype(dt))
+        out = jnp.einsum("bsnl,lnh->bsnh", ctx, w_v.astype(dt))
+        out = out.reshape(b, s, n * hd)
+        out = nn.Dense(cfg.dim, use_bias=False, dtype=dt, name="out")(out)
+        if cfg.attn_dropout > 0.0:
+            out = nn.Dropout(cfg.attn_dropout)(out, deterministic=deterministic)
+        return out, cache
+
+
+class MoELayer(nn.Module):
+    """Top-k MoE with shared expert and aux-free load balancing (cell 23).
+
+    Expert weights are stacked (E, ...) arrays (SwiGLU per expert, cell 21:
+    w3(swish(w1 x) * (w2 x)), hidden ((2D)*4)//3). The routing bias lives in
+    the 'moe_state' variable collection — the functional analogue of the
+    reference's registered buffer updated under no_grad; the train step
+    threads it through TrainState.model_state.
+    """
+
+    cfg: DeepSeekV3Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.cfg
+        b, s, d = x.shape
+        h = cfg.expert_hidden
+        e = cfg.n_experts
+        dt = cfg.compute_dtype
+        xt = x.reshape(b * s, d).astype(dt)
+
+        gate_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="gate"
+        )(xt.astype(jnp.float32))
+        if cfg.noisy_topk and not deterministic:
+            noise_scale = jax.nn.softplus(
+                nn.Dense(e, use_bias=False, dtype=jnp.float32, name="noise")(
+                    xt.astype(jnp.float32)
+                )
+            )
+            gate_logits = gate_logits + noise_scale * jax.random.normal(
+                self.make_rng("dropout"), gate_logits.shape
+            )
+
+        bias = self.variable(
+            "moe_state", "routing_bias", lambda: jnp.zeros((e,), jnp.float32)
+        )
+        biased = gate_logits + bias.value if cfg.use_aux_free else gate_logits
+        # reference detail: both selection AND softmax weights use the biased
+        # logits (cell 23 scatters top_k_values of the biased tensor)
+        probs = ops.moe.topk_gate_probs(biased, cfg.top_experts)
+
+        init = nn.initializers.normal(0.02)
+        w1 = self.param("w1", init, (e, d, h))
+        w2 = self.param("w2", init, (e, d, h))
+        w3 = self.param("w3", init, (e, h, d))
+
+        if cfg.moe_impl == "dense":
+            def expert_fn_all(xt):
+                a = jnp.einsum("td,edh->eth", xt, w1.astype(dt))
+                g = jnp.einsum("td,edh->eth", xt, w2.astype(dt))
+                return jnp.einsum("eth,ehd->etd", ops.swish(a) * g, w3.astype(dt))
+
+            out = ops.moe.moe_dense_combine(xt, probs, expert_fn_all)
+        else:
+            def expert_fn(xe):  # (E, C, D) -> (E, C, D)
+                a = jnp.einsum("ecd,edh->ech", xe, w1.astype(dt))
+                g = jnp.einsum("ecd,edh->ech", xe, w2.astype(dt))
+                return jnp.einsum("ech,ehd->ecd", ops.swish(a) * g, w3.astype(dt))
+
+            cap = ops.moe.expert_capacity(
+                b * s, e, cfg.top_experts, cfg.capacity_factor
+            )
+            out = ops.moe.moe_dispatch_combine(xt, probs, expert_fn, cap)
+
+        if cfg.use_shared_expert:
+            out = out + GLUFFN(
+                dim=d, hidden_dim=h, activation=ops.swish, dtype=dt,
+                name="shared_expert",
+            )(xt)
+
+        if (
+            cfg.use_aux_free
+            and not deterministic
+            and self.is_mutable_collection("moe_state")
+        ):
+            bias.value = ops.moe.aux_free_bias_update(
+                probs, bias.value, cfg.aux_free_bias_update_rate
+            )
+        return out.reshape(b, s, d).astype(x.dtype)
+
+
+class DSV3DecoderLayer(nn.Module):
+    """Pre-RMSNorm MLA + residual; pre-RMSNorm MoE + residual (cell 29)."""
+
+    cfg: DeepSeekV3Config
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+        cfg = self.cfg
+        h, cache = MLA(cfg, name="mla")(
+            RMSNorm(eps=cfg.norm_eps, name="norm1")(x),
+            positions=positions,
+            cache=cache,
+            deterministic=deterministic,
+        )
+        x = x + h
+        x = x + MoELayer(cfg, name="moe")(
+            RMSNorm(eps=cfg.norm_eps, name="norm2")(x),
+            deterministic=deterministic,
+        )
+        return x, cache
+
+
+class DeepSeekV3(nn.Module):
+    cfg: DeepSeekV3Config
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches: list[LatentCache] | None = None,
+        deterministic: bool = True,
+        return_mtp: bool = False,
+    ):
+        """Returns (logits, caches) or ((logits, mtp_logits), caches) when
+        return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V))."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype,
+            embedding_init=nn.initializers.normal(0.02), name="tok_emb",
+        )
+        pe = ops.sinusoidal_position_encoding(cfg.block_size, cfg.dim)
+        # no input dropout: the reference's forward goes embedding -> PE ->
+        # decoder directly (cell 33); dropout appears only after the layer
+        # stack (cell 31)
+        x = embed(tokens) + jnp.take(pe, positions, axis=0).astype(cfg.compute_dtype)
+
+        new_caches = [] if caches is not None else None
+        for i in range(cfg.n_layers):
+            x, c = DSV3DecoderLayer(cfg, name=f"layer_{i}")(
+                x,
+                positions=positions,
+                cache=None if caches is None else caches[i],
+                deterministic=deterministic,
+            )
+            if new_caches is not None:
+                new_caches.append(c)
+
+        if cfg.dropout > 0.0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        x = 2.0 * cfg.n_layers**-0.5 * x  # deepseek depth scaling (cell 31)
+        x = RMSNorm(eps=cfg.norm_eps, name="norm_f")(x)
+        logits = embed.attend(x.astype(cfg.compute_dtype))  # weight-tied head
+
+        if not (return_mtp and cfg.mtp_heads > 0):
+            return logits, new_caches
+
+        # ---- MTP: vectorized version of cell 33's per-position loop ----
+        mtp_logits = []
+        h_prev = x
+        for k in range(1, cfg.mtp_heads + 1):
+            # embedding of token at position i+k (zero-padded past the end;
+            # the loss masks those targets out)
+            shifted = jnp.pad(tokens[:, k:], ((0, 0), (0, k)))
+            emb_k = embed(shifted)
+            merged = jnp.concatenate(
+                [
+                    LayerNorm(name=f"mtp_norm_h_{k}")(h_prev),
+                    LayerNorm(name=f"mtp_norm_e_{k}")(emb_k),
+                ],
+                axis=-1,
+            )
+            merged = nn.Dense(
+                cfg.dim, use_bias=False, dtype=cfg.compute_dtype,
+                name=f"mtp_merge_{k}",
+            )(merged)
+            h_k, _ = DSV3DecoderLayer(cfg, name=f"mtp_layer_{k}")(
+                merged, positions=positions, deterministic=deterministic
+            )
+            proj = nn.Dense(
+                cfg.dim, use_bias=False, dtype=cfg.compute_dtype,
+                name=f"mtp_proj_{k}",
+            )(h_k)
+            mtp_logits.append(embed.attend(proj.astype(cfg.compute_dtype)))
+            h_prev = h_k
+        return (logits, jnp.stack(mtp_logits, axis=2)), new_caches
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.block_size
+
+    def init_caches(self, batch: int, max_len: int, dtype=None) -> list[LatentCache]:
+        cfg = self.cfg
+        dtype = dtype or cfg.compute_dtype
+        return [
+            LatentCache.init(batch, max_len, cfg.latent_dim, dtype)
+            for _ in range(cfg.n_layers)
+        ]

@@ -12,7 +12,9 @@ from solvingpapers_tpu.ops.rope import (
     apply_rope,
     apply_rotary_emb_complex,
     rope_rotation_matrix,
+    sinusoidal_position_encoding,
 )
+from solvingpapers_tpu.ops import moe
 from solvingpapers_tpu.ops.activations import (
     relu,
     leaky_relu,

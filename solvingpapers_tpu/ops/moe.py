@@ -1,0 +1,90 @@
+"""Mixture-of-Experts routing + dispatch primitives.
+
+Capability target: deepseekv3/deepseekv3.ipynb cell 23 (`MoeLayer`) — linear
+gate, optional softplus-noise top-k, learned routing bias added before
+selection (aux-free load balancing), top-k -inf-masked softmax over all
+experts, weighted expert combine, shared expert, and the no-grad bias update
+`bias += rate * sign(mean(load) - load)`.
+
+TPU-first: the reference's python loop over experts with boolean gather/
+scatter becomes static-shape one-hot einsum dispatch (tokens -> expert
+capacity slots) so the whole layer is three MXU einsums; a dense
+all-experts path is kept as the numerics reference (exact — no capacity
+drops) and for tiny configs. Expert weights are stacked (E, ...) arrays so
+an `expert` mesh axis shards them directly and GSPMD inserts the
+all_to_alls (SURVEY.md §2.3 EP row).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from solvingpapers_tpu.ops.attention import BIG_NEG
+
+
+def topk_gate_probs(gate_logits: jax.Array, k: int) -> jax.Array:
+    """(T, E) logits -> (T, E) probs: softmax over the top-k entries per row,
+    zero elsewhere (deepseekv3 cell 23's masked-scatter softmax; computed in
+    float32)."""
+    logits32 = gate_logits.astype(jnp.float32)
+    kth = jax.lax.top_k(logits32, k)[0][..., -1:]
+    masked = jnp.where(logits32 >= kth, logits32, BIG_NEG)
+    return jax.nn.softmax(masked, axis=-1)
+
+
+def aux_free_bias_update(
+    probs: jax.Array, bias: jax.Array, rate: float
+) -> jax.Array:
+    """New routing bias per deepseekv3 cell 23: load c_i = sum of routed
+    probabilities per expert; bias += rate * sign(mean(c) - c). Run under
+    stop_gradient (the reference wraps it in torch.no_grad)."""
+    ci = jax.lax.stop_gradient(jnp.sum(probs, axis=0))
+    err = jnp.mean(ci) - ci
+    return bias + rate * jnp.sign(err).astype(bias.dtype)
+
+
+def expert_capacity(
+    n_tokens: int, n_experts: int, top_k: int, capacity_factor: float
+) -> int:
+    """Per-expert slot count for dispatch: ceil(T*k/E * cf), 8-aligned."""
+    c = int(n_tokens * top_k / n_experts * capacity_factor)
+    return max(8, -(-c // 8) * 8)
+
+
+def moe_dispatch_combine(
+    x: jax.Array,
+    probs: jax.Array,
+    expert_fn,
+    capacity: int,
+) -> jax.Array:
+    """Static-shape MoE: route (T, D) tokens to (E, C, D) slots, run
+    `expert_fn((E, C, D)) -> (E, C, D)`, combine back weighted by probs.
+
+    Tokens beyond an expert's capacity are dropped for that expert (their
+    probability mass contributes nothing) — set capacity_factor high enough
+    that drops are rare; the dense path below is drop-free.
+    """
+    t, e = probs.shape
+    sel = probs > 0.0
+    # slot index of each token within its expert queue (ordered by token id)
+    pos = jnp.cumsum(sel.astype(jnp.int32), axis=0) - 1  # (T, E)
+    keep = sel & (pos < capacity)
+    onehot_slot = jax.nn.one_hot(
+        jnp.where(keep, pos, capacity), capacity, dtype=x.dtype
+    )  # (T, E, C); overflow row maps past the last slot and is dropped
+    dispatch = onehot_slot * keep[..., None].astype(x.dtype)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)
+    ye = expert_fn(xe)
+    combine = dispatch * probs[..., None].astype(x.dtype)
+    return jnp.einsum("tec,ecd->td", combine, ye)
+
+
+def moe_dense_combine(x: jax.Array, probs: jax.Array, expert_fn_all) -> jax.Array:
+    """Drop-free reference path: run every expert on every token.
+
+    `expert_fn_all((T, D)) -> (E, T, D)`. Exact semantics of the reference's
+    per-expert loop; costs E/k times the dispatch path's FLOPs.
+    """
+    ye = expert_fn_all(x)  # (E, T, D)
+    return jnp.einsum("te,etd->td", probs.astype(x.dtype), ye)

@@ -116,3 +116,16 @@ def rope_rotation_matrix(head_dim: int, max_seq_len: int, theta: float = 10000.0
     mats = mats.at[:, odd, even].set(sin)
     mats = mats.at[:, odd, odd].set(cos)
     return mats
+
+
+def sinusoidal_position_encoding(max_len: int, dim: int) -> jax.Array:
+    """Classic sin/cos position table (deepseekv3/deepseekv3.ipynb cell 16):
+    pe[p, 2i] = sin(p / 10000^(2i/dim)), pe[p, 2i+1] = cos(...). Returns
+    (max_len, dim) float32, precomputed once and indexed by position."""
+    pos = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    i = jnp.arange(0, dim, 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, i / dim)
+    pe = jnp.zeros((max_len, dim), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle[:, : dim // 2]))
+    return pe

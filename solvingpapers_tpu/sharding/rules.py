@@ -24,6 +24,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (path regex, spec). First match wins; paths are '/'-joined key tuples.
 LM_RULES: list[tuple[str, P]] = [
+    # MLA raw weights (D|L, heads, head_dim): heads over the TP axis
+    (r"mla/(w_q|w_k|w_v)$", P(None, "model", None)),
+    # stacked MoE expert weights (E, in, out): experts over the expert axis
+    (r"moe/(w1|w2)$", P("expert", "fsdp", "model")),
+    (r"moe/w3$", P("expert", "model", "fsdp")),
     (r"(qkv|q|kv|gate|up|fc|w_dkv|w_q)/kernel$", P("fsdp", "model")),
     (r"(out|down|proj|w_o)/kernel$", P("model", "fsdp")),
     (r"lm_head/kernel$", P("fsdp", "model")),

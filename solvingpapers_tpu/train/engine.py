@@ -101,9 +101,14 @@ class Trainer:
 
         def make(rng):
             p_rng, d_rng, s_rng = jax.random.split(rng, 3)
-            params = self.init_fn(self.model, {"params": p_rng, "dropout": d_rng}, example_batch)
+            out = self.init_fn(
+                self.model, {"params": p_rng, "dropout": d_rng}, example_batch
+            )
+            # init_fn may return params alone or (params, model_state)
+            params, model_state = out if isinstance(out, tuple) else (out, None)
             return TrainState.create(
-                apply_fn=self.model.apply, params=params, tx=self.tx, rng=s_rng
+                apply_fn=self.model.apply, params=params, tx=self.tx, rng=s_rng,
+                model_state=model_state,
             )
 
         rng = jax.random.key(cfg.seed)

@@ -55,6 +55,57 @@ def vae_loss_fn(model, params, batch, rng, model_state, train):
     return total / n, {"bce": bce / n, "kl": kl / n}, model_state
 
 
+def dsv3_init_fn(model, rngs, batch):
+    """Init returning (params, model_state): DeepSeekV3 carries the MoE
+    routing bias in the 'moe_state' collection (deepseekv3 cell 23 buffer).
+    Initializes through the MTP branch when enabled so its params exist."""
+    variables = model.init(rngs, batch["x"], return_mtp=model.cfg.mtp_heads > 0)
+    return variables["params"], {"moe_state": variables["moe_state"]}
+
+
+def dsv3_loss_fn(model, params, batch, rng, model_state, train):
+    """DeepSeekV3 objective: next-token CE (+ weighted MTP loss when
+    mtp_heads > 0), threading the mutable MoE routing bias through the step
+    (the functional form of cell 23's no-grad buffer update + cell 54's loss).
+    """
+    cfg = model.cfg
+    use_mtp = cfg.mtp_heads > 0
+    variables = {"params": params, **(model_state or {})}
+    kwargs = dict(deterministic=not train, return_mtp=use_mtp)
+    if train:
+        (out, _), mutated = model.apply(
+            variables,
+            batch["x"],
+            rngs={"dropout": rng},
+            mutable=["moe_state"],
+            **kwargs,
+        )
+        new_ms = {"moe_state": mutated["moe_state"]}
+    else:
+        out, _ = model.apply(variables, batch["x"], **kwargs)
+        new_ms = model_state
+    if use_mtp:
+        logits, mtp_logits = out
+    else:
+        logits, mtp_logits = out, None
+
+    main = ops.cross_entropy(logits, batch["y"])
+    aux = {"perplexity": jnp.exp(main)}
+    loss = main
+    if mtp_logits is not None:
+        # mtp_loss wants the stream shifted so head j's target is token
+        # i+(j+1)+1; y already holds tokens 1..T, pad the unknown tail
+        k = cfg.mtp_heads
+        pad = jnp.full((batch["y"].shape[0], k), -1, batch["y"].dtype)
+        mtp = ops.mtp_loss(
+            mtp_logits, jnp.concatenate([batch["y"], pad], axis=1), k,
+            ignore_index=-1,
+        )
+        aux["mtp_loss"] = mtp
+        loss = main + cfg.mtp_loss_weight * mtp
+    return loss, aux, new_ms
+
+
 def make_kd_loss_fn(teacher_model, teacher_params, temperature=7.0, alpha=0.3):
     """Distillation objective with a frozen teacher (kd.py:48-68, 110-142).
 
