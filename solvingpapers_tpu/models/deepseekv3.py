@@ -164,15 +164,18 @@ class MoELayer(nn.Module):
         gate_logits = nn.Dense(
             e, use_bias=False, dtype=jnp.float32, name="gate"
         )(xt.astype(jnp.float32))
-        if cfg.noisy_topk and not deterministic:
+        if cfg.noisy_topk:
+            # layer created unconditionally so init (deterministic) still
+            # builds its params; noise applied only in train mode
             noise_scale = jax.nn.softplus(
                 nn.Dense(e, use_bias=False, dtype=jnp.float32, name="noise")(
                     xt.astype(jnp.float32)
                 )
             )
-            gate_logits = gate_logits + noise_scale * jax.random.normal(
-                self.make_rng("dropout"), gate_logits.shape
-            )
+            if not deterministic:
+                gate_logits = gate_logits + noise_scale * jax.random.normal(
+                    self.make_rng("dropout"), gate_logits.shape
+                )
 
         bias = self.variable(
             "moe_state", "routing_bias", lambda: jnp.zeros((e,), jnp.float32)

@@ -70,10 +70,11 @@ def moe_dispatch_combine(
     # slot index of each token within its expert queue (ordered by token id)
     pos = jnp.cumsum(sel.astype(jnp.int32), axis=0) - 1  # (T, E)
     keep = sel & (pos < capacity)
-    onehot_slot = jax.nn.one_hot(
+    # (T, E, C); dropped/unselected tokens index the sentinel `capacity`,
+    # which one_hot encodes as an all-zero row — no extra masking needed
+    dispatch = jax.nn.one_hot(
         jnp.where(keep, pos, capacity), capacity, dtype=x.dtype
-    )  # (T, E, C); overflow row maps past the last slot and is dropped
-    dispatch = onehot_slot * keep[..., None].astype(x.dtype)
+    )
     xe = jnp.einsum("tec,td->ecd", dispatch, x)
     ye = expert_fn(xe)
     combine = dispatch * probs[..., None].astype(x.dtype)

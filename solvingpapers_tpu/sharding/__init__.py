@@ -3,8 +3,10 @@
 The reference's parallelism ceiling is one `nn.DataParallel` wrap over two
 GPUs (deepseekv3/deepseekv3.ipynb cells 37, 54). Here parallelism is
 expressed the TPU-native way: a `jax.sharding.Mesh` with standardized axes
-('data', 'fsdp', 'model', 'expert'), PartitionSpec rules over parameter
-pytrees, and XLA/GSPMD inserting the collectives over ICI/DCN.
+('data', 'fsdp', 'model', 'expert', 'context'), PartitionSpec rules over
+parameter pytrees, XLA/GSPMD inserting the collectives over ICI/DCN, and
+shard_map + explicit collectives for ring attention / Ulysses context
+parallelism.
 """
 
 from solvingpapers_tpu.sharding.mesh import (
@@ -19,4 +21,10 @@ from solvingpapers_tpu.sharding.rules import (
     LM_RULES,
     param_specs,
     param_shardings,
+)
+from solvingpapers_tpu.sharding.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+    ulysses_attention,
+    ulysses_attention_local,
 )

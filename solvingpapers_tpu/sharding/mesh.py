@@ -8,8 +8,9 @@ Axes (SURVEY.md §2.3/§5):
   expert — expert parallelism for MoE all_to_all dispatch
 
 Batches are sharded over (data, fsdp) jointly; parameters over
-(fsdp, model); MoE experts over expert. On a single chip every axis has
-size 1 and all of this compiles to a no-op.
+(fsdp, model); MoE experts over expert; the sequence axis over context
+(ring attention / Ulysses — both in sharding/ring_attention.py). On a
+single chip every axis has size 1 and all of this compiles to a no-op.
 """
 
 from __future__ import annotations
@@ -21,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("data", "fsdp", "model", "expert")
+MESH_AXES = ("data", "fsdp", "model", "expert", "context")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -32,9 +33,10 @@ class MeshConfig:
     fsdp: int = 1
     model: int = 1
     expert: int = 1
+    context: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        sizes = [self.data, self.fsdp, self.model, self.expert]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = [self.data, self.fsdp, self.model, self.expert, self.context]
         wild = [i for i, s in enumerate(sizes) if s == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one -1 axis allowed, got {sizes}")

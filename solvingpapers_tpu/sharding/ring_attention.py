@@ -1,0 +1,156 @@
+"""Ring attention: context parallelism over the `context` mesh axis.
+
+Not present in the reference (max trained context is 256 tokens,
+SURVEY.md §5 "Long-context — absent") — this is the capability the new
+framework adds for sequences larger than one chip's HBM. Each device holds
+a sequence shard of Q, K, V; K/V chunks rotate around the ring via
+`lax.ppermute` over ICI while every device accumulates its queries' online
+softmax (the blockwise/flash recurrence, so the full (S, S) score matrix
+never exists anywhere).
+
+Layout: BSNH shards inside shard_map. Causality is resolved from global
+chunk positions (device i holds positions [i*S_loc, (i+1)*S_loc)); fully
+masked chunks still traverse the ring (uniform schedule keeps the
+collective static) but contribute zero mass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from solvingpapers_tpu.ops.attention import BIG_NEG
+
+
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Per-shard ring attention body; call inside shard_map.
+
+    q, k, v: local (B, S_loc, N, H) sequence shards. Returns the local
+    (B, S_loc, N, H) output shard of exact softmax attention over the full
+    sequence.
+    """
+    b, s_loc, n, h = q.shape
+    if scale is None:
+        scale = h**-0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = my_idx * s_loc + jnp.arange(s_loc)
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        # ppermute sends to (j+1): after i steps we hold chunk (my_idx - i)
+        src = (my_idx - i) % axis_size
+        s_ = jnp.einsum(
+            "bqnh,bknh->bnqk", q32, k_cur.astype(jnp.float32)
+        )
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+            s_ = jnp.where(mask, s_, BIG_NEG)
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1, keepdims=True))
+        p = jnp.exp(s_ - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bnqk,bknh->bqnh", p, v_cur.astype(jnp.float32)
+        ).transpose(0, 2, 1, 3)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    # derive initial accumulators from q so they inherit its varying-axes
+    # type (shard_map vma typing: plain zeros would be device-invariant)
+    q_bnsh = jnp.moveaxis(q32, 1, 2)  # (B, N, S_loc, H)
+    m0 = jnp.full_like(q_bnsh[..., :1], BIG_NEG)
+    l0 = jnp.zeros_like(q_bnsh[..., :1])
+    acc0 = jnp.zeros_like(q_bnsh)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(axis_size)
+    )
+    out = acc / jnp.maximum(l, 1e-30)  # (B, N, S_loc, H)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    axis_name: str = "context",
+) -> jax.Array:
+    """Full-array entry point: shards the sequence axis over `axis_name`
+    (batch over data/fsdp) and runs the ring. q, k, v: (B, S, N, H) with
+    S divisible by the context axis size."""
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    fn = functools.partial(
+        ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def ulysses_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    attn_fn,
+) -> jax.Array:
+    """Ulysses sequence parallelism: all_to_all swaps the sequence shard for
+    a head shard around the attention core (SURVEY.md §2.3 Ulysses row).
+
+    q, k, v: local (B, S_loc, N, H); requires N % axis_size == 0. attn_fn
+    receives full-sequence (B, S, N_loc, H) tensors — any attention core
+    works (dense, flash kernel).
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    if q.shape[2] % axis_size:
+        raise ValueError(
+            f"Ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({axis_size})"
+        )
+    # split heads across devices, gather sequence: (B, S, N/axis, H)
+    q_g = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k_g = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v_g = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    o_g = attn_fn(q_g, k_g, v_g)
+    # swap back: scatter sequence, gather heads
+    return jax.lax.all_to_all(o_g, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    attn_fn,
+    *,
+    axis_name: str = "context",
+) -> jax.Array:
+    """Full-array Ulysses entry: sequence sharded over `axis_name`, heads
+    resharded around `attn_fn` via all_to_all."""
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    fn = functools.partial(
+        ulysses_attention_local, axis_name=axis_name, attn_fn=attn_fn
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -1,0 +1,77 @@
+"""Context-parallel attention tests on the virtual 8-device mesh:
+ring attention and Ulysses must equal single-device dense attention
+(SURVEY.md §4 multichip test plan; capability added beyond the reference).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+from solvingpapers_tpu.sharding.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def make_qkv(key, b, s, n, h, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, n, h), dtype),
+        jax.random.normal(kk, (b, s, n, h), dtype),
+        jax.random.normal(kv, (b, s, n, h), dtype),
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])
+@pytest.mark.parametrize("ctx", [4, 8])
+def test_ring_attention_matches_dense(devices, causal, ctx):
+    mesh = create_mesh(
+        MeshConfig(data=8 // ctx, fsdp=1, model=1, expert=1, context=ctx), devices
+    )
+    q, k, v = make_qkv(jax.random.key(0), 2, 64, 2, 16)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = ops.dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_flow(devices):
+    mesh = create_mesh(MeshConfig(data=2, context=4), devices)
+    q, k, v = make_qkv(jax.random.key(1), 2, 32, 2, 8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(ops.dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])
+def test_ulysses_matches_dense(devices, causal):
+    ctx = 4
+    mesh = create_mesh(MeshConfig(data=2, context=ctx), devices)
+    # heads must be divisible by the context axis
+    q, k, v = make_qkv(jax.random.key(2), 2, 32, 4, 16)
+    attn_fn = functools.partial(ops.dot_product_attention, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, attn_fn)
+    ref = ops.dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_long_sequence_streams(devices):
+    """8-way context split of a longer sequence (the memory win: each device
+    only ever holds S/8 of K/V plus one in-flight chunk)."""
+    mesh = create_mesh(MeshConfig(data=1, context=8), devices)
+    q, k, v = make_qkv(jax.random.key(3), 1, 512, 2, 16)
+    out = ring_attention(q, k, v, mesh, causal=True)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
