@@ -33,6 +33,8 @@ class CheckpointManager:
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         if not force and (self.save_every <= 0 or step % self.save_every):
             return False
+        if step in self._mgr.all_steps():
+            return False  # already saved (e.g. preemption save after periodic)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
         return True

@@ -113,8 +113,29 @@ def build_image_run(cfg: RunConfig, mesh=None):
 
 
 def build_char_lm_run(cfg: RunConfig, sharding=None):
-    """Returns (run_cfg_with_vocab, model, tokenizer, train_iter, eval_iter_fn)."""
-    tok, train_toks, val_toks = load_char_corpus(path=cfg.data.get("path"))
+    """Returns (run_cfg_with_vocab, model, tokenizer, train_iter, eval_iter_fn).
+
+    data.kind 'char' builds a char vocab (gpt/gemma pipelines); 'bpe' trains
+    a byte-level BPE on the corpus (the offline stand-in for the reference's
+    tiktoken/HF GPT-2 tables — llama3 cell 6, deepseekv3 cell 6), or loads
+    GPT-2-format tables from data['vocab_path']/data['merges_path'].
+    """
+    if cfg.data.get("kind") == "bpe":
+        from solvingpapers_tpu.data.bpe import ByteBPETokenizer
+        from solvingpapers_tpu.data.char import load_text, split_train_val
+
+        text = load_text(cfg.data.get("path"))
+        if cfg.data.get("vocab_path") and cfg.data.get("merges_path"):
+            tok = ByteBPETokenizer.from_files(
+                cfg.data["vocab_path"], cfg.data["merges_path"]
+            )
+        else:
+            tok = ByteBPETokenizer.train(
+                text, cfg.data.get("bpe_vocab_size", 1024)
+            )
+        train_toks, val_toks = split_train_val(tok.encode(text))
+    else:
+        tok, train_toks, val_toks = load_char_corpus(path=cfg.data.get("path"))
     block = cfg.data.get("block_size", 256)
     # the char vocab comes from the corpus; resize the model to match
     model_cfg = dataclasses.replace(cfg.model, vocab_size=max(tok.vocab_size, 2))

@@ -1,0 +1,231 @@
+"""Byte-level BPE tokenization.
+
+Capability target: the reference's subword pipelines — tiktoken GPT-2 in
+llama3 (LLaMA-jax.ipynb cell 6) and HF AutoTokenizer('gpt2') in deepseekv3
+(deepseekv3.ipynb cell 6, vocab 50257). This environment has no network
+egress (both libraries fetch their BPE tables on first use), so this module
+provides a self-contained byte-level BPE with three sources:
+
+  1. `ByteBPETokenizer.train(text, vocab_size)` — learn merges from a local
+     corpus (classic BPE: iteratively merge the most frequent symbol pair);
+  2. `ByteBPETokenizer.from_files(vocab.json, merges.txt)` — load GPT-2
+     format tables if the user has them locally;
+  3. `gpt2_tokenizer()` — best-effort tiktoken / HF fast paths when their
+     caches exist, else a clear error.
+
+Byte-level means no <unk>: any UTF-8 string round-trips exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+import numpy as np
+
+try:  # stdlib `re` lacks \p{L}; `regex` ships with transformers
+    import regex as _re
+
+    _GPT2_SPLIT = _re.compile(
+        r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"""
+    )
+except ImportError:  # pragma: no cover
+    import re as _re
+
+    _GPT2_SPLIT = _re.compile(r" ?\w+| ?[^\w\s]+|\s+")
+
+
+def bytes_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte <-> printable-unicode mapping (so merges
+    files are text-safe). Standard table: printable ASCII + latin-1 ranges
+    stay themselves; the rest shift up past 255."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+_BYTE_ENC = bytes_to_unicode()
+_BYTE_DEC = {v: k for k, v in _BYTE_ENC.items()}
+
+
+def _get_pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
+    return set(zip(word[:-1], word[1:]))
+
+
+class ByteBPETokenizer:
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]]):
+        self.vocab = vocab
+        self.inv_vocab = {v: k for k, v in vocab.items()}
+        self.ranks = {pair: i for i, pair in enumerate(merges)}
+        self._cache: dict[str, list[str]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    # ------------------------------------------------------------ construct
+
+    @classmethod
+    def from_files(cls, vocab_path: str, merges_path: str) -> "ByteBPETokenizer":
+        """Load GPT-2-format vocab.json + merges.txt."""
+        with open(vocab_path, encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(merges_path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.rstrip("\n")
+                # only the optional '#version' header is metadata — '#' is a
+                # legitimate merge symbol (e.g. GPT-2's '# #' -> '##')
+                if not line.strip() or (i == 0 and line.startswith("#version")):
+                    continue
+                a, b = line.split(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    @classmethod
+    def train(
+        cls, text: str, vocab_size: int, *, min_pair_count: int = 2
+    ) -> "ByteBPETokenizer":
+        """Learn merges from `text` until `vocab_size` (>= 256) is reached."""
+        if vocab_size < 256:
+            raise ValueError("byte-level BPE needs vocab_size >= 256")
+        # word frequency over pre-tokenized chunks, as byte-unicode symbols
+        words = Counter(
+            tuple(_BYTE_ENC[b] for b in tok.encode("utf-8"))
+            for tok in _GPT2_SPLIT.findall(text)
+        )
+        vocab = {c: i for i, c in enumerate(_BYTE_ENC[b] for b in range(256))}
+        merges: list[tuple[str, str]] = []
+        while len(vocab) < vocab_size:
+            pairs: Counter = Counter()
+            for word, freq in words.items():
+                for pair in zip(word[:-1], word[1:]):
+                    pairs[pair] += freq
+            if not pairs:
+                break
+            best, count = pairs.most_common(1)[0]
+            if count < min_pair_count:
+                break
+            merges.append(best)
+            merged = best[0] + best[1]
+            vocab[merged] = len(vocab)
+
+            def apply(word: tuple[str, ...]) -> tuple[str, ...]:
+                out, i = [], 0
+                while i < len(word):
+                    if (
+                        i < len(word) - 1
+                        and word[i] == best[0]
+                        and word[i + 1] == best[1]
+                    ):
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(word[i])
+                        i += 1
+                return tuple(out)
+
+            words = Counter(
+                {apply(w): f for w, f in words.items()}
+            )
+        return cls(vocab, merges)
+
+    def save(self, vocab_path: str, merges_path: str) -> None:
+        with open(vocab_path, "w", encoding="utf-8") as f:
+            json.dump(self.vocab, f, ensure_ascii=False)
+        with open(merges_path, "w", encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            for a, b in sorted(self.ranks, key=self.ranks.get):
+                f.write(f"{a} {b}\n")
+
+    # --------------------------------------------------------------- encode
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = _get_pairs(word)
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            out, i = [], 0
+            while i < len(word):
+                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+                    out.append(word[i] + word[i + 1])
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            word = tuple(out)
+        result = list(word)
+        self._cache[token] = result
+        return result
+
+    def encode(self, text: str) -> np.ndarray:
+        ids: list[int] = []
+        for tok in _GPT2_SPLIT.findall(text):
+            symbols = "".join(_BYTE_ENC[b] for b in tok.encode("utf-8"))
+            ids.extend(self.vocab[s] for s in self._bpe(symbols))
+        return np.asarray(ids, dtype=np.int32)
+
+    def decode(self, ids) -> str:
+        text = "".join(self.inv_vocab[int(i)] for i in ids)
+        return bytes(_BYTE_DEC[c] for c in text).decode("utf-8", errors="replace")
+
+
+def gpt2_tokenizer(vocab_path: str | None = None, merges_path: str | None = None):
+    """The reference's GPT-2 BPE (50257 tokens) if obtainable offline:
+    local files > tiktoken cache > HF cache; raises with guidance otherwise."""
+    if vocab_path and merges_path:
+        return ByteBPETokenizer.from_files(vocab_path, merges_path)
+    try:
+        import tiktoken
+
+        enc = tiktoken.get_encoding("gpt2")
+
+        class _Tik:
+            vocab_size = enc.n_vocab
+
+            def encode(self, text):
+                return np.asarray(
+                    enc.encode(text, allowed_special="all"), dtype=np.int32
+                )
+
+            def decode(self, ids):
+                return enc.decode([int(i) for i in ids])
+
+        return _Tik()
+    except Exception:
+        pass
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained("gpt2", local_files_only=True)
+
+        class _HF:
+            vocab_size = tok.vocab_size
+
+            def encode(self, text):
+                return np.asarray(tok.encode(text), dtype=np.int32)
+
+            def decode(self, ids):
+                return tok.decode([int(i) for i in ids])
+
+        return _HF()
+    except Exception:
+        pass
+    raise RuntimeError(
+        "GPT-2 BPE tables unavailable offline. Pass vocab.json/merges.txt "
+        "paths, or train a corpus tokenizer with ByteBPETokenizer.train()."
+    )

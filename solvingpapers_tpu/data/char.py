@@ -31,6 +31,22 @@ class CharTokenizer:
         return "".join(self.itos[int(i)] for i in ids)
 
 
+def load_text(path: str | None = None, synthetic_chars: int = 200_000, seed: int = 0) -> str:
+    """Raw corpus text: the local file if given/exists, else synthetic."""
+    if path is not None and os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+    return synthetic_text(synthetic_chars, seed)
+
+
+def split_train_val(
+    data: np.ndarray, val_fraction: float = 0.1
+) -> tuple[np.ndarray, np.ndarray]:
+    """Tail split (gpt/gemma notebooks' 90/10 convention), at least 1 val token."""
+    n_val = max(int(len(data) * val_fraction), 1)
+    return data[:-n_val], data[-n_val:]
+
+
 def load_char_corpus(
     path: str | None = None,
     val_fraction: float = 0.1,
@@ -39,12 +55,7 @@ def load_char_corpus(
 ) -> tuple[CharTokenizer, np.ndarray, np.ndarray]:
     """Load a text corpus (local file if given/exists, else synthetic),
     build a char vocab, return (tokenizer, train_tokens, val_tokens)."""
-    if path is not None and os.path.exists(path):
-        with open(path, "r", encoding="utf-8") as f:
-            text = f.read()
-    else:
-        text = synthetic_text(synthetic_chars, seed)
+    text = load_text(path, synthetic_chars, seed)
     tok = CharTokenizer(text)
-    data = tok.encode(text)
-    n_val = int(len(data) * val_fraction)
-    return tok, data[:-n_val], data[-n_val:]
+    train, val = split_train_val(tok.encode(text), val_fraction)
+    return tok, train, val

@@ -1,4 +1,11 @@
 """Metrics and observability (L7)."""
 
-from solvingpapers_tpu.metrics.writer import MetricsWriter, ConsoleWriter, JSONLWriter, MultiWriter
+from solvingpapers_tpu.metrics.writer import (
+    MetricsWriter,
+    ConsoleWriter,
+    JSONLWriter,
+    MultiWriter,
+    TensorBoardWriter,
+    WandbWriter,
+)
 from solvingpapers_tpu.metrics.mfu import transformer_flops_per_token, chip_peak_flops, mfu

@@ -25,7 +25,8 @@ class MetricsWriter:
 
 
 class ConsoleWriter(MetricsWriter):
-    def __init__(self, stream: IO = sys.stdout, every: int = 1):
+    def __init__(self, stream: IO | None = None, every: int = 1):
+        # stream resolved at write time so runtime redirection works
         self.stream = stream
         self.every = max(every, 1)
 
@@ -36,7 +37,7 @@ class ConsoleWriter(MetricsWriter):
             f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
             for k, v in metrics.items()
         )
-        print(f"step {step}: {parts}", file=self.stream, flush=True)
+        print(f"step {step}: {parts}", file=self.stream or sys.stdout, flush=True)
 
 
 class JSONLWriter(MetricsWriter):
@@ -49,6 +50,44 @@ class JSONLWriter(MetricsWriter):
 
     def close(self) -> None:
         self.f.close()
+
+
+class TensorBoardWriter(MetricsWriter):
+    """TensorBoard scalars via torch.utils.tensorboard (lazy import)."""
+
+    def __init__(self, log_dir: str):
+        from torch.utils.tensorboard import SummaryWriter
+
+        self.writer = SummaryWriter(log_dir)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        for k, v in metrics.items():
+            self.writer.add_scalar(k, float(v), step)
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+class WandbWriter(MetricsWriter):
+    """wandb sink with the reference's metric names (deepseekv3 cell 54).
+    Lazy import: raises with guidance if wandb is not installed."""
+
+    def __init__(self, project: str, config: Mapping | None = None, **kwargs):
+        try:
+            import wandb
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "wandb is not installed; use JSONLWriter/TensorBoardWriter "
+                "or `pip install wandb`"
+            ) from e
+        self.wandb = wandb
+        self.run = wandb.init(project=project, config=dict(config or {}), **kwargs)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        self.wandb.log({k: float(v) for k, v in metrics.items()}, step=step)
+
+    def close(self) -> None:
+        self.run.finish()
 
 
 class MultiWriter(MetricsWriter):

@@ -55,6 +55,10 @@ class TrainConfig:
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     tokens_per_step: int | None = None  # enables tokens/sec + MFU metrics
     flops_per_token: float | None = None
+    # aux subsystems (SURVEY.md §5)
+    debug_nans: bool = False  # jax_debug_nans: fail fast at the faulting op
+    profile_dir: str | None = None  # jax.profiler trace output (TensorBoard)
+    profile_steps: tuple[int, int] = (10, 15)  # [start, stop) steps to trace
 
 
 def lm_loss_fn(model, params, batch, rng, model_state, train):
@@ -81,6 +85,8 @@ class Trainer:
     ):
         self.model = model
         self.config = config
+        # debug_nans is enabled inside fit() and restored on exit so the
+        # process-global flag does not leak across Trainers
         self.loss_fn = loss_fn
         self.rules = rules
         self.mesh = mesh if mesh is not None else create_mesh(config.mesh)
@@ -213,47 +219,91 @@ class Trainer:
                 pure, start_step = restored
                 state = _apply_pure(state, pure)
 
+        # preemption handling: SIGTERM/SIGINT request a final checkpoint at
+        # the next step boundary (the auto-resume path restores it — the
+        # workflow the reference performs by hand after Kaggle preemptions)
+        preempted = {"flag": False}
+        old_handlers = {}
+        if ckpt is not None:
+            import signal
+
+            def _on_signal(signum, frame):
+                preempted["flag"] = True
+
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    old_handlers[sig] = signal.signal(sig, _on_signal)
+                except ValueError:  # non-main thread
+                    break
+
+        profiling = False
+        nan_debug_prev = None
+        if cfg.debug_nans:
+            nan_debug_prev = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
         t_prev = time.perf_counter()
         last_log_step = start_step
-        for step in range(start_step, cfg.steps):
-            batch = first if (first is not None and step == start_step) else next(batch_iter)
-            first_used = first is not None and step == start_step
-            if first_used:
-                first = None
-            state, metrics = self._train_step(state, batch)
+        try:
+            for step in range(start_step, cfg.steps):
+                if preempted["flag"]:
+                    ckpt.maybe_save(step, _pure_state(state), force=True)
+                    writer.write(step, {"preempted": 1.0})
+                    break
+                if cfg.profile_dir and step - start_step == cfg.profile_steps[0]:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                if profiling and step - start_step == cfg.profile_steps[1]:
+                    jax.profiler.stop_trace()
+                    profiling = False
+                batch = first if (first is not None and step == start_step) else next(batch_iter)
+                first_used = first is not None and step == start_step
+                if first_used:
+                    first = None
+                state, metrics = self._train_step(state, batch)
 
-            if cfg.eval_every > 0 and eval_iter_fn and (step + 1) % cfg.eval_every == 0:
-                t_eval = time.perf_counter()
-                val = self.evaluate(state, eval_iter_fn())
-                writer.write(step + 1, {k: float(v) for k, v in val.items()})
-                t_prev += time.perf_counter() - t_eval  # keep eval out of step timing
+                if cfg.eval_every > 0 and eval_iter_fn and (step + 1) % cfg.eval_every == 0:
+                    t_eval = time.perf_counter()
+                    val = self.evaluate(state, eval_iter_fn())
+                    writer.write(step + 1, {k: float(v) for k, v in val.items()})
+                    t_prev += time.perf_counter() - t_eval  # keep eval out of step timing
 
-            if (step + 1) % max(cfg.log_every, 1) == 0 or step == cfg.steps - 1:
-                metrics = jax.device_get(metrics)  # blocks; also fences timing
-                now = time.perf_counter()
-                dt = (now - t_prev) / max(step + 1 - last_log_step, 1)
-                t_prev = now
-                last_log_step = step + 1
-                metrics["step_time_s"] = dt
-                if cfg.tokens_per_step:
-                    metrics["tokens_per_sec"] = cfg.tokens_per_step / dt
-                    metrics["tokens"] = (step + 1) * cfg.tokens_per_step
-                    if cfg.flops_per_token:
-                        from solvingpapers_tpu.metrics.mfu import chip_peak_flops
+                if (step + 1) % max(cfg.log_every, 1) == 0 or step == cfg.steps - 1:
+                    metrics = jax.device_get(metrics)  # blocks; also fences timing
+                    now = time.perf_counter()
+                    dt = (now - t_prev) / max(step + 1 - last_log_step, 1)
+                    t_prev = now
+                    last_log_step = step + 1
+                    metrics["step_time_s"] = dt
+                    if cfg.tokens_per_step:
+                        metrics["tokens_per_sec"] = cfg.tokens_per_step / dt
+                        metrics["tokens"] = (step + 1) * cfg.tokens_per_step
+                        if cfg.flops_per_token:
+                            from solvingpapers_tpu.metrics.mfu import chip_peak_flops
 
-                        n_chips = self.mesh.devices.size
-                        metrics["mfu"] = (
-                            metrics["tokens_per_sec"] * cfg.flops_per_token
-                            / (chip_peak_flops() * n_chips)
-                        )
-                writer.write(step + 1, {k: float(v) for k, v in metrics.items()})
+                            n_chips = self.mesh.devices.size
+                            metrics["mfu"] = (
+                                metrics["tokens_per_sec"] * cfg.flops_per_token
+                                / (chip_peak_flops() * n_chips)
+                            )
+                    writer.write(step + 1, {k: float(v) for k, v in metrics.items()})
 
+                if ckpt is not None:
+                    ckpt.maybe_save(step + 1, _pure_state(state))
+
+            if ckpt is not None and not preempted["flag"]:
+                ckpt.maybe_save(cfg.steps, _pure_state(state), force=True)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+            if nan_debug_prev is not None:
+                jax.config.update("jax_debug_nans", nan_debug_prev)
             if ckpt is not None:
-                ckpt.maybe_save(step + 1, _pure_state(state))
+                ckpt.close()
+            if old_handlers:
+                import signal
 
-        if ckpt is not None:
-            ckpt.maybe_save(cfg.steps, _pure_state(state), force=True)
-            ckpt.close()
+                for sig, h in old_handlers.items():
+                    signal.signal(sig, h)
         return state
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:

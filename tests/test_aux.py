@@ -1,0 +1,130 @@
+"""Aux-subsystem tests (SURVEY.md §5): BPE tokenizer round-trips and
+training, checkpoint resume, preemption-signal save, metrics sinks.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.data.bpe import ByteBPETokenizer, bytes_to_unicode
+from solvingpapers_tpu.data.synthetic import synthetic_text
+
+
+def test_bytes_to_unicode_bijective():
+    m = bytes_to_unicode()
+    assert len(m) == 256
+    assert len(set(m.values())) == 256
+
+
+def test_bpe_train_roundtrip_and_compression():
+    text = synthetic_text(30_000, seed=0)
+    tok = ByteBPETokenizer.train(text, vocab_size=512)
+    assert 256 < tok.vocab_size <= 512
+    sample = "The quick brown fox jumps over the lazy dog. éü☃"
+    ids = tok.encode(sample)
+    assert tok.decode(ids) == sample  # byte-level: exact round-trip, no <unk>
+    # merges must actually compress the training distribution
+    assert len(tok.encode(text[:5000])) < 5000 * 0.6
+
+
+def test_bpe_save_load_identical(tmp_path):
+    # '#' is a legitimate merge symbol (GPT-2 has '# #' -> '##'); the loader
+    # must only skip the '#version' header, not every '#'-prefixed line
+    text = synthetic_text(10_000, seed=1) + " ## hashtag # code # comment" * 200
+    tok = ByteBPETokenizer.train(text, vocab_size=400)
+    vp, mp = str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt")
+    tok.save(vp, mp)
+    tok2 = ByteBPETokenizer.from_files(vp, mp)
+    assert tok2.ranks == tok.ranks
+    s = "hello world, shall we proceed anon? ## tags #1"
+    np.testing.assert_array_equal(tok.encode(s), tok2.encode(s))
+
+
+def test_bpe_lm_run_builds():
+    import dataclasses
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_char_lm_run
+
+    cfg = get_config("gpt_tiny")
+    cfg = dataclasses.replace(
+        cfg, data={**cfg.data, "kind": "bpe", "bpe_vocab_size": 300,
+                   "block_size": 32}
+    )
+    cfg2, model, tok, train_iter, eval_iter_fn = build_char_lm_run(cfg)
+    assert cfg2.model.vocab_size == tok.vocab_size
+    batch = next(train_iter)
+    assert batch["x"].shape == (cfg.train.batch_size, 32)
+    assert int(batch["x"].max()) < tok.vocab_size
+
+
+def test_preemption_signal_saves_checkpoint(tmp_path):
+    """SIGTERM mid-fit must write a resumable checkpoint and stop the loop."""
+    from solvingpapers_tpu.data import load_char_corpus
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    tiny = GPTConfig(vocab_size=64, block_size=16, dim=16, n_layers=1,
+                     n_heads=2, dropout=0.0)
+    _, toks, _ = load_char_corpus(synthetic_chars=5_000)
+    ckdir = str(tmp_path / "ck")
+    mesh = create_mesh(MeshConfig(data=1), jax.devices()[:1])
+
+    class SignalingIter:
+        """Raises SIGTERM in-process after a few batches."""
+
+        def __init__(self, inner, at):
+            self.inner, self.n, self.at = inner, 0, at
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            self.n += 1
+            if self.n == self.at:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return next(self.inner)
+
+    cfg = TrainConfig(
+        steps=50, batch_size=4, log_every=1000, eval_every=0,
+        checkpoint_dir=ckdir, ckpt_every=1000,  # periodic save never fires
+        optimizer=OptimizerConfig(max_lr=1e-3, total_steps=50),
+    )
+    trainer = Trainer(GPT(tiny), cfg, mesh=mesh)
+    it = SignalingIter(lm_batch_iterator(toks, 4, tiny.block_size, seed=0), at=4)
+    trainer.fit(it, None)
+
+    from solvingpapers_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(ckdir, save_every=0)
+    step = mgr.latest_step()
+    assert step is not None and 0 < step < 50, step
+
+
+def test_jsonl_and_console_writers(tmp_path, capsys):
+    from solvingpapers_tpu.metrics import ConsoleWriter, JSONLWriter, MultiWriter
+
+    path = str(tmp_path / "m.jsonl")
+    w = MultiWriter(ConsoleWriter(), JSONLWriter(path))
+    w.write(10, {"loss": 1.5, "lr": 0.001})
+    w.close()
+    out = capsys.readouterr().out
+    assert "step 10" in out and "loss=1.5" in out
+    rec = json.loads(open(path).read().strip())
+    assert rec["step"] == 10 and rec["loss"] == 1.5
+
+
+def test_tensorboard_writer(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    from solvingpapers_tpu.metrics import TensorBoardWriter
+
+    w = TensorBoardWriter(str(tmp_path / "tb"))
+    w.write(1, {"loss": 2.0})
+    w.close()
+    assert any(f.startswith("events") for f in os.listdir(tmp_path / "tb"))
