@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import functools
+import json
 import sys
 
 import jax
@@ -78,7 +79,7 @@ def cmd_train(args) -> int:
         writer = MultiWriter(writer, JSONLWriter(args.jsonl))
 
     kind = cfg.data.get("kind", "char")
-    if kind == "char":
+    if kind in ("char", "bpe"):
         cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
             cfg, sharding=batch_sharding(mesh)
         )
@@ -86,14 +87,56 @@ def cmd_train(args) -> int:
             model, cfg.train, loss_fn=loss_fn_for(cfg),
             init_fn=init_fn_for(cfg), mesh=mesh,
         )
-        trainer.fit(train_iter, eval_iter_fn, writer=writer)
+        callbacks = None
+        if args.artifacts_dir:
+            # deepseekv3 cell 54: sample + save generated_{step}.txt each eval
+            from solvingpapers_tpu import ops
+            from solvingpapers_tpu.infer import generate
+            from solvingpapers_tpu.metrics.viz import save_text_sample
+
+            # one sampler object: it is a static jit arg of generate, and a
+            # fresh partial per call would retrace + recompile every sample
+            sampler = functools.partial(ops.sample_top_k, k=50)
+
+            def sample_cb(state, step, _tok=tok, _model=model):
+                prompt = jnp.asarray(_tok.encode("\n"), jnp.int32)[None, :]
+                extra = state.model_state or None
+                limit = getattr(_model, "max_positions", None) or 1_000_000
+                out = generate(
+                    _model, state.params, prompt, jax.random.key(step),
+                    max_new_tokens=min(200, limit - prompt.shape[1]),
+                    sampler=sampler,
+                    extra_variables=extra,
+                )
+                path = save_text_sample(
+                    _tok.decode(np.asarray(out[0])), args.artifacts_dir, step
+                )
+                print(f"[sample] wrote {path}")
+
+            every = cfg.train.eval_every or cfg.train.log_every
+            callbacks = [(every, sample_cb)]
+        trainer.fit(train_iter, eval_iter_fn, writer=writer, callbacks=callbacks)
         return 0
     if kind == "images":
         if cfg.model_family == "kd":
             return _train_kd(cfg, mesh, writer)
         model, train_iter, eval_iter_fn, loss_fn = build_image_run(cfg, mesh=mesh)
         trainer = Trainer(model, cfg.train, loss_fn=loss_fn, mesh=mesh)
-        trainer.fit(train_iter, eval_iter_fn, writer=writer)
+        state = trainer.fit(train_iter, eval_iter_fn, writer=writer)
+        if args.artifacts_dir and cfg.model_family in ("ae", "vae"):
+            # autoencoder.ipynb cell 9 / vae cell 9: reconstruction grid
+            from solvingpapers_tpu.metrics.viz import save_reconstruction_grid
+
+            batch = next(eval_iter_fn())
+            out = model.apply(
+                {"params": state.params}, batch["x"], deterministic=True
+            )
+            recon = out[0] if isinstance(out, tuple) else out
+            path = save_reconstruction_grid(
+                np.asarray(batch["x"]), np.asarray(jax.device_get(recon)),
+                f"{args.artifacts_dir}/reconstructions.png",
+            )
+            print(f"[viz] wrote {path}")
         return 0
     raise ValueError(f"unknown data kind {kind!r}")
 
@@ -132,7 +175,6 @@ def _train_kd(cfg, mesh, writer) -> int:
 def cmd_sample(args) -> int:
     _apply_platform(args)
     from solvingpapers_tpu import ops
-    from solvingpapers_tpu.checkpoint import CheckpointManager
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import build_char_lm_run
     from solvingpapers_tpu.infer import generate
@@ -150,21 +192,15 @@ def cmd_sample(args) -> int:
     extra = {k: v for k, v in variables.items() if k != "params"}
 
     if args.checkpoint_dir:
-        from solvingpapers_tpu.configs.factory import init_fn_for
-        from solvingpapers_tpu.train import Trainer
-
-        trainer = Trainer(model, cfg.train, init_fn=init_fn_for(cfg))
-        state = trainer.init_state({"x": prompt, "y": prompt})
-        from solvingpapers_tpu.train.engine import _pure_state
-
-        mgr = CheckpointManager(args.checkpoint_dir, save_every=0)
-        restored = mgr.restore_latest(_pure_state(state))
+        restored = _restore_for_inference(
+            cfg, model, args.checkpoint_dir, {"x": prompt, "y": prompt}
+        )
         if restored is None:
             print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
             return 1
-        params = restored[0]["params"]
-        if restored[0].get("model_state"):
-            extra = restored[0]["model_state"]
+        _, params, extra_restored = restored
+        if extra_restored:
+            extra = extra_restored
 
     sampler = (
         ops.sample_greedy
@@ -179,6 +215,102 @@ def cmd_sample(args) -> int:
     return 0
 
 
+def _restore_for_inference(cfg, model, checkpoint_dir, example_batch, trainer=None):
+    """Shared restore path: returns (state, params, extra_variables) from
+    the newest checkpoint, or None if the directory is empty."""
+    from solvingpapers_tpu.checkpoint import CheckpointManager
+    from solvingpapers_tpu.configs.factory import init_fn_for
+    from solvingpapers_tpu.train import Trainer
+    from solvingpapers_tpu.train.engine import _apply_pure, _pure_state
+
+    if trainer is None:
+        trainer = Trainer(model, cfg.train, init_fn=init_fn_for(cfg))
+    state = trainer.init_state(example_batch)
+    mgr = CheckpointManager(checkpoint_dir, save_every=0)
+    restored = mgr.restore_latest(_pure_state(state))
+    if restored is None:
+        return None
+    state = _apply_pure(state, restored[0])
+    extra = restored[0].get("model_state") or {}
+    return state, restored[0]["params"], extra
+
+
+def cmd_eval(args) -> int:
+    """estimate_loss over the held-out split (gpt cell 14 / gemma cell 17 /
+    dsv3 cell 48) or accuracy for classifiers (ViT cell 15, kd.py:145)."""
+    _apply_platform(args)
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import (
+        build_char_lm_run,
+        build_image_run,
+        init_fn_for,
+        loss_fn_for,
+    )
+    from solvingpapers_tpu.sharding import batch_sharding, create_mesh
+    from solvingpapers_tpu.train import Trainer
+
+    cfg = get_config(args.config)
+    if args.data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
+    mesh = create_mesh(cfg.train.mesh)
+    if cfg.data.get("kind", "char") == "images":
+        model, _, eval_iter_fn, loss_fn = build_image_run(cfg, mesh=mesh)
+    else:
+        cfg, model, _, _, eval_iter_fn = build_char_lm_run(
+            cfg, sharding=batch_sharding(mesh)
+        )
+        loss_fn = loss_fn_for(cfg)
+    trainer = Trainer(model, cfg.train, loss_fn=loss_fn,
+                      init_fn=init_fn_for(cfg), mesh=mesh)
+    eval_iter = eval_iter_fn()
+    first = next(eval_iter)
+    if args.checkpoint_dir:
+        restored = _restore_for_inference(
+            cfg, model, args.checkpoint_dir, first, trainer=trainer
+        )
+        if restored is None:
+            print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
+            return 1
+        state = restored[0]
+    else:
+        state = trainer.init_state(first)
+    import itertools
+
+    metrics = trainer.evaluate(state, itertools.chain([first], eval_iter))
+    print(json.dumps({k: round(float(v), 6) for k, v in metrics.items()}))
+    return 0
+
+
+def cmd_export(args) -> int:
+    """Params-only export (the reference publishes bare weights to HF)."""
+    _apply_platform(args)
+    from solvingpapers_tpu.checkpoint import export_params
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import (
+        build_char_lm_run,
+        build_image_run,
+    )
+    from solvingpapers_tpu.sharding import create_mesh
+
+    cfg = get_config(args.config)
+    if args.data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
+    mesh = create_mesh(cfg.train.mesh)
+    if cfg.data.get("kind", "char") == "images":
+        model, train_iter, _, _ = build_image_run(cfg, mesh=mesh)
+    else:
+        cfg, model, _, train_iter, _ = build_char_lm_run(cfg)
+    first = next(train_iter)
+    restored = _restore_for_inference(cfg, model, args.checkpoint_dir, first)
+    if restored is None:
+        print(f"no checkpoint found in {args.checkpoint_dir}", file=sys.stderr)
+        return 1
+    _, params, _ = restored
+    export_params(args.out, params)
+    print(f"exported params to {args.out}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="solvingpapers_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -190,6 +322,12 @@ def main(argv=None) -> int:
     p_train.add_argument("--steps", type=int, default=None)
     p_train.add_argument("--ckpt-every", type=int, default=1000)
     p_train.add_argument("--jsonl", default=None)
+    p_train.add_argument(
+        "--artifacts-dir",
+        default=None,
+        help="write qualitative artifacts here: generated_{step}.txt each "
+        "eval for LMs, reconstructions.png after AE/VAE training",
+    )
 
     p_sample = sub.add_parser("sample")
     _add_common(p_sample)
@@ -200,8 +338,21 @@ def main(argv=None) -> int:
     p_sample.add_argument("--greedy", action="store_true")
     p_sample.add_argument("--seed", type=int, default=0)
 
+    p_eval = sub.add_parser("eval")
+    _add_common(p_eval)
+
+    p_export = sub.add_parser("export")
+    _add_common(p_export)
+    p_export.add_argument("--out", required=True)
+
     args = parser.parse_args(argv)
-    return {"list": cmd_list, "train": cmd_train, "sample": cmd_sample}[args.cmd](args)
+    return {
+        "list": cmd_list,
+        "train": cmd_train,
+        "sample": cmd_sample,
+        "eval": cmd_eval,
+        "export": cmd_export,
+    }[args.cmd](args)
 
 
 if __name__ == "__main__":

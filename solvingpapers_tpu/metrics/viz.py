@@ -1,0 +1,66 @@
+"""Qualitative-eval artifacts.
+
+Capability targets: the reconstruction comparison grids of
+autoencoder/autoencoder.ipynb cell 9 and variational autoencoder.ipynb
+cell 9 (originals vs reconstructions, saved as PNG here instead of shown
+inline), and deepseekv3's generated-text snapshots (cell 51 writes
+`generated_{step}.txt` at each eval).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_reconstruction_grid(
+    originals: np.ndarray,
+    reconstructions: np.ndarray,
+    path: str,
+    *,
+    n: int = 8,
+    side: int | None = None,
+) -> str:
+    """Two-row PNG: originals on top, reconstructions below.
+
+    Accepts flattened (B, H*W) or image (B, H, W[, C]) arrays in [0, 1].
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    def to_img(x):
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            s = side or int(round(x.size**0.5))
+            x = x.reshape(s, -1)
+        if x.ndim == 3 and x.shape[-1] == 1:
+            x = x[..., 0]
+        return x
+
+    n = min(n, len(originals), len(reconstructions))
+    fig, axes = plt.subplots(2, n, figsize=(1.2 * n, 2.6))
+    if n == 1:
+        axes = axes.reshape(2, 1)
+    for i in range(n):
+        for row, batch in enumerate((originals, reconstructions)):
+            ax = axes[row][i]
+            ax.imshow(to_img(batch[i]), cmap="gray", vmin=0.0, vmax=1.0)
+            ax.axis("off")
+    axes[0][0].set_title("original", fontsize=8, loc="left")
+    axes[1][0].set_title("reconstruction", fontsize=8, loc="left")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    fig.savefig(path, dpi=120, bbox_inches="tight")
+    plt.close(fig)
+    return path
+
+
+def save_text_sample(text: str, directory: str, step: int) -> str:
+    """deepseekv3 cell 51's `generated_{step}.txt` artifact."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"generated_{step}.txt")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    return path

@@ -1,0 +1,80 @@
+"""Checkpoint round-trips (SURVEY.md §4 plan): full-state resume restores
+identical training trajectories; params-only export round-trips; viz
+artifacts render.
+"""
+
+import os
+
+import jax
+import numpy as np
+
+from solvingpapers_tpu.data import load_char_corpus
+from solvingpapers_tpu.data.batches import lm_batch_iterator
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+TINY = GPTConfig(vocab_size=64, block_size=16, dim=16, n_layers=1, n_heads=2,
+                 dropout=0.0)
+
+
+def make_trainer(steps, ckdir=None, ckpt_every=0, total_steps=4):
+    # schedule horizon fixed at 4 so the interrupted and straight runs see
+    # identical LR at every step
+    mesh = create_mesh(MeshConfig(data=1), jax.devices()[:1])
+    cfg = TrainConfig(
+        steps=steps, batch_size=4, log_every=1000, eval_every=0,
+        checkpoint_dir=ckdir, ckpt_every=ckpt_every,
+        optimizer=OptimizerConfig(max_lr=1e-3, warmup_steps=0,
+                                  total_steps=total_steps),
+    )
+    return Trainer(GPT(TINY), cfg, mesh=mesh)
+
+
+def test_resume_matches_uninterrupted(tmp_path):
+    """Train 4 steps straight == train 2, resume from checkpoint, train 2."""
+    _, toks, _ = load_char_corpus(synthetic_chars=5_000)
+    it_fn = lambda: lm_batch_iterator(toks, 4, TINY.block_size, seed=0)  # noqa: E731
+
+    straight = make_trainer(4).fit(it_fn())
+
+    ckdir = str(tmp_path / "ck")
+    make_trainer(2, ckdir, ckpt_every=2).fit(it_fn())
+    # resume: same deterministic batch stream; fit skips to start_step by
+    # restoring, so feed the iterator from the same seed and let steps 0-1
+    # be consumed by the restored start_step offset
+    it = it_fn()
+    for _ in range(2):
+        next(it)  # the two batches already trained before preemption
+    resumed = make_trainer(4, ckdir, ckpt_every=100).fit(it)
+
+    for a, b in zip(jax.tree.leaves(straight.params), jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    assert int(resumed.step) == 4
+
+
+def test_params_export_roundtrip(tmp_path):
+    from solvingpapers_tpu.checkpoint import export_params, load_params
+
+    model = GPT(TINY)
+    toks = jax.numpy.zeros((1, 8), jax.numpy.int32)
+    params = model.init({"params": jax.random.key(0)}, toks)["params"]
+    path = str(tmp_path / "export")
+    export_params(path, jax.device_get(params))
+    loaded = load_params(path)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reconstruction_grid_renders(tmp_path):
+    from solvingpapers_tpu.metrics.viz import save_reconstruction_grid, save_text_sample
+
+    rng = np.random.default_rng(0)
+    orig = rng.random((8, 784)).astype(np.float32)
+    recon = rng.random((8, 28, 28, 1)).astype(np.float32)
+    path = save_reconstruction_grid(orig, recon, str(tmp_path / "g.png"))
+    assert os.path.getsize(path) > 1000
+
+    tpath = save_text_sample("hello", str(tmp_path / "arts"), 500)
+    assert tpath.endswith("generated_500.txt")
+    assert open(tpath).read() == "hello"
