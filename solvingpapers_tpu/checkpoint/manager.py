@@ -51,7 +51,19 @@ class CheckpointManager:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract_state))
+        try:
+            restored = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        except Exception as e:
+            if "rng" in str(e) or "(2,)" in str(e) or "(4,)" in str(e):
+                raise RuntimeError(
+                    f"checkpoint restore failed at step {step} — if the shape "
+                    "mismatch involves 'rng', the checkpoint was written under "
+                    "a different PRNG impl; set TrainConfig.prng_impl to match "
+                    "('rbg' stores (4,) uint32 key data, threefry (2,))"
+                ) from e
+            raise
         return restored, step
 
     def close(self) -> None:

@@ -292,6 +292,9 @@ def cmd_export(args) -> int:
     )
     from solvingpapers_tpu.sharding import create_mesh
 
+    if not args.checkpoint_dir:
+        print("export requires --checkpoint-dir", file=sys.stderr)
+        return 2
     cfg = get_config(args.config)
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})

@@ -8,4 +8,9 @@ from solvingpapers_tpu.metrics.writer import (
     TensorBoardWriter,
     WandbWriter,
 )
-from solvingpapers_tpu.metrics.mfu import transformer_flops_per_token, chip_peak_flops, mfu
+from solvingpapers_tpu.metrics.mfu import (
+    transformer_flops_per_token,
+    chip_peak_flops,
+    mfu,
+    active_param_count,
+)

@@ -43,3 +43,22 @@ def transformer_flops_per_token(
 def mfu(tokens_per_sec: float, flops_per_token: float, n_chips: int = 1, device=None) -> float:
     peak = chip_peak_flops(device) * n_chips
     return tokens_per_sec * flops_per_token / peak
+
+
+def active_param_count(params, top_experts: int | None = None, n_experts: int | None = None) -> int:
+    """Parameters touched per token. For MoE pytrees (stacked expert weights
+    under .../moe/w1|w2|w3) only top_experts/n_experts of the routed expert
+    params count as active — the correct N for the 6N flops model
+    (SURVEY.md hard part #5: 'MoE's active-params-only flops')."""
+    import jax.tree_util as jtu
+
+    total = 0
+    routed = 0
+    for path, leaf in jtu.tree_flatten_with_path(params)[0]:
+        p = "/".join(str(getattr(k, "key", k)) for k in path)
+        total += leaf.size
+        if "/moe/w1" in p or "/moe/w2" in p or "/moe/w3" in p:
+            routed += leaf.size
+    if top_experts and n_experts and routed:
+        total -= routed - routed * top_experts // n_experts
+    return total

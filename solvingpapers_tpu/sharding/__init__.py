@@ -28,3 +28,12 @@ from solvingpapers_tpu.sharding.ring_attention import (
     ulysses_attention,
     ulysses_attention_local,
 )
+from solvingpapers_tpu.sharding.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+)
+from solvingpapers_tpu.sharding.distributed import (
+    initialize as initialize_distributed,
+    host_batch_slice,
+    host_seed,
+)

@@ -22,7 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("data", "fsdp", "model", "expert", "context")
+MESH_AXES = ("data", "fsdp", "model", "expert", "context", "pipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -34,9 +34,11 @@ class MeshConfig:
     model: int = 1
     expert: int = 1
     context: int = 1
+    pipe: int = 1
 
     def resolve(self, n_devices: int) -> tuple[int, ...]:
-        sizes = [self.data, self.fsdp, self.model, self.expert, self.context]
+        sizes = [self.data, self.fsdp, self.model, self.expert, self.context,
+                 self.pipe]
         wild = [i for i, s in enumerate(sizes) if s == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one -1 axis allowed, got {sizes}")

@@ -1,0 +1,110 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+Not in the reference (SURVEY.md §2.3 lists PP as a TPU-native capability to
+add; its parallelism ceiling is single-process DataParallel). Design: each
+device along the `pipe` axis holds ONE stage's parameters (stacked arrays
+with a leading stage dimension, sharded over the axis). Microbatches enter
+at stage 0 and hop stage-to-stage via `lax.ppermute` over ICI; the schedule
+runs `n_micro + n_stages - 1` ticks, every device computing each tick
+(bubbles compute garbage that is masked out at collection). The classic
+collective-permute pipelining recipe — compute and neighbor-transfer
+overlap, no host involvement.
+
+Capability scope: stage_fn is any pure function (params_stage, x) -> x with
+matching input/output activation shapes (transformer blocks, MLP stacks).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
+    """Per-device body. stage_params: this stage's params (leading stage
+    axis already stripped to size 1 by shard_map — squeezed here).
+    microbatches: (n_micro, mb, ...) full input, replicated."""
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_id = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_params)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    # shard_map vma typing: carriers and the replicated input must be marked
+    # varying over the pipe axis before mixing with per-device values
+    microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
+    buf = jnp.zeros_like(microbatches[0])  # current activation on this device
+    out = jnp.zeros_like(microbatches)     # collected at the last stage
+
+    def tick(carry, t):
+        buf, out = carry
+        # stage 0 ingests microbatch t (when in range); others use the
+        # activation received from the previous stage
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        incoming = jnp.where(
+            stage_id == 0,
+            microbatches[mb_idx].astype(buf.dtype),
+            buf,
+        )
+        y = stage_fn(params, incoming)
+        # the microbatch finishing at the last stage this tick is t-(S-1)
+        done_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_valid = (stage_id == n_stages - 1) & (t >= n_stages - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            out, y.astype(out.dtype), done_idx, 0
+        )
+        out = jnp.where(is_valid, updated, out)
+        # rotate activations one stage forward (last->0 wraps; ignored)
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+    # only the last stage holds real outputs; psum broadcasts them (others zero)
+    out = jnp.where(stage_id == n_stages - 1, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def pipeline_apply(
+    stage_params,
+    x: jax.Array,
+    stage_fn,
+    mesh: Mesh,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Run x (batch, ...) through n_stages sequential stages, pipelined.
+
+    stage_params: pytree of stacked arrays with leading dim n_stages
+    (sharded over `axis_name`). stage_fn(params_one_stage, x_mb) -> y_mb
+    must preserve the activation shape. Batch must divide n_microbatches.
+    Semantics: stage_{S-1}(...stage_1(stage_0(x))...) — verified against the
+    sequential loop in tests/test_pipeline.py.
+    """
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+    mb = b // n_microbatches
+    micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+
+    params_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    fn = functools.partial(
+        _pipeline_local, stage_fn=stage_fn, axis_name=axis_name
+    )
+    out = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(params_spec, P()),
+        out_specs=P(),
+    )(stage_params, micro)
+    return out.reshape(b, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params: list) -> object:
+    """[stage0_params, stage1_params, ...] -> stacked pytree with a leading
+    stage axis (shard over the pipe axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,0 +1,76 @@
+"""Pipeline parallelism: GPipe schedule == sequential stage application
+(SURVEY.md §2.3 PP row), including gradient flow through the pipeline.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+from solvingpapers_tpu.sharding.pipeline import pipeline_apply, stack_stage_params
+
+
+def mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def make_stages(key, n_stages, d, h):
+    stages = []
+    for i in range(n_stages):
+        k1, k2, key = jax.random.split(jax.random.fold_in(key, i), 3)
+        stages.append({
+            "w1": jax.random.normal(k1, (d, h)) * 0.3,
+            "b1": jnp.zeros(h),
+            "w2": jax.random.normal(k2, (h, d)) * 0.3,
+            "b2": jnp.zeros(d),
+        })
+    return stages
+
+
+def sequential(stages, x):
+    for p in stages:
+        x = mlp_stage(p, x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_matches_sequential(devices, n_micro):
+    n_stages = 4
+    mesh = create_mesh(MeshConfig(data=2, pipe=n_stages), devices)
+    stages = make_stages(jax.random.key(0), n_stages, d=16, h=32)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(1), (16, 16))
+
+    out = pipeline_apply(stacked, x, mlp_stage, mesh, n_microbatches=n_micro)
+    ref = sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_grads_match_sequential(devices):
+    n_stages = 4
+    mesh = create_mesh(MeshConfig(data=1, pipe=n_stages), devices[:4])
+    stages = make_stages(jax.random.key(2), n_stages, d=8, h=16)
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.key(3), (8, 8))
+
+    def loss_pipe(stacked):
+        return jnp.sum(pipeline_apply(stacked, x, mlp_stage, mesh, n_microbatches=4) ** 2)
+
+    def loss_seq(stacked):
+        stages = [jax.tree.map(lambda a: a[i], stacked) for i in range(n_stages)]
+        return jnp.sum(sequential(stages, x) ** 2)
+
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_rejects_bad_microbatching(devices):
+    mesh = create_mesh(MeshConfig(data=2, pipe=4), devices)
+    stages = make_stages(jax.random.key(0), 4, d=8, h=8)
+    x = jnp.zeros((10, 8))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(stack_stage_params(stages), x, mlp_stage, mesh, n_microbatches=4)
