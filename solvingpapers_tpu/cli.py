@@ -79,7 +79,7 @@ def cmd_train(args) -> int:
         writer = MultiWriter(writer, JSONLWriter(args.jsonl))
 
     kind = cfg.data.get("kind", "char")
-    if kind in ("char", "bpe"):
+    if kind in ("char", "bpe", "tokens"):
         cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
             cfg, sharding=batch_sharding(mesh)
         )

@@ -5,6 +5,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Iterator
 
+import numpy as np
+
 from solvingpapers_tpu.data import load_char_corpus
 from solvingpapers_tpu.data.batches import lm_batch_iterator
 from solvingpapers_tpu.configs.registry import RunConfig
@@ -134,6 +136,33 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
                 text, cfg.data.get("bpe_vocab_size", 1024)
             )
         train_toks, val_toks = split_train_val(tok.encode(text))
+    elif cfg.data.get("kind") == "tokens":
+        # pre-tokenized stream (deepseekv3 cells 8-14: tokenize once, train
+        # from saved tokens); model.vocab_size must match the tokenizer that
+        # wrote the file; decode-side tokenizer is not reconstructable here
+        from solvingpapers_tpu.data.char import split_train_val
+        from solvingpapers_tpu.data.tokens import load_token_file
+
+        toks = load_token_file(cfg.data["path"])
+
+        class _IdTok:  # ids-only passthrough for code paths expecting .decode
+            vocab_size = cfg.model.vocab_size
+
+            def encode(self, s):
+                raise RuntimeError("token-file runs carry no text tokenizer")
+
+            def decode(self, ids):
+                return " ".join(str(int(i)) for i in ids)
+
+        max_id = int(np.max(toks))  # one pass; catches tokenizer mismatch
+        if max_id >= cfg.model.vocab_size:
+            raise ValueError(
+                f"token file {cfg.data['path']} holds id {max_id} but "
+                f"model.vocab_size is {cfg.model.vocab_size}; XLA gathers "
+                "clamp silently, so this must match the writing tokenizer"
+            )
+        tok = _IdTok()
+        train_toks, val_toks = split_train_val(toks)
     else:
         tok, train_toks, val_toks = load_char_corpus(path=cfg.data.get("path"))
     block = cfg.data.get("block_size", 256)

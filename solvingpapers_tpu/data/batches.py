@@ -37,11 +37,29 @@ def lm_batch_iterator(
     seed: int = 0,
     sharding=None,
 ):
-    """Infinite iterator of {'x','y'} LM batches via jitted device-side crops.
+    """Infinite iterator of {'x','y'} LM batches.
 
     Deterministic in `seed`; if `sharding` is given, batches are placed with
-    it (data/fsdp mesh axes) before being yielded.
+    it (data/fsdp mesh axes) before being yielded. In-memory corpora crop
+    device-side under jit (llama3 cell 13's vmap(dynamic_slice) pattern);
+    memory-mapped token files crop host-side so corpora larger than HBM
+    stream from disk (only the cropped windows are copied to device).
     """
+    if isinstance(tokens, np.memmap):
+        rng = np.random.default_rng(seed)
+        max_start = len(tokens) - block_size - 1
+        dtype = np.int32
+        while True:
+            starts = rng.integers(0, max_start, size=batch_size)
+            x = np.stack([tokens[s : s + block_size] for s in starts]).astype(dtype)
+            y = np.stack(
+                [tokens[s + 1 : s + block_size + 1] for s in starts]
+            ).astype(dtype)
+            batch = {"x": x, "y": y}
+            if sharding is not None:
+                batch = jax.device_put(batch, sharding)
+            yield batch
+
     toks = jnp.asarray(tokens)
     crop = jax.jit(random_crop_batch, static_argnames=("batch_size", "block_size"))
     key = jax.random.key(seed)

@@ -1,0 +1,53 @@
+"""Pre-tokenized LM streams.
+
+Capability target: deepseekv3/deepseekv3.ipynb cells 8-14 — the reference
+tokenizes TinyStories once, saves tensors to disk, and trains from the
+saved tokens (with a commented-out tokenize-to-disk pipeline). Here the
+on-disk format is a flat uint16/uint32 `.bin` (memory-mapped, so corpora
+larger than RAM stream from disk) or `.npy`.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def tokenize_to_file(
+    text: str, tokenizer, path: str, *, dtype=None
+) -> np.ndarray:
+    """Encode `text` and write a flat token file next to a .meta sidecar.
+
+    dtype defaults to uint16 when the vocab fits (gpt2's 50257 does), else
+    uint32. Returns the in-memory tokens.
+    """
+    ids = np.asarray(tokenizer.encode(text))
+    if dtype is None:
+        dtype = np.uint16 if tokenizer.vocab_size <= np.iinfo(np.uint16).max + 1 else np.uint32
+    ids = ids.astype(dtype)
+    if path.endswith(".npy"):
+        np.save(path, ids)
+    else:
+        ids.tofile(path)
+        with open(path + ".meta", "w") as f:
+            f.write(np.dtype(dtype).name)
+    return ids
+
+
+def load_token_file(path: str, *, dtype=None) -> np.ndarray:
+    """Memory-map a token file written by tokenize_to_file (or any flat
+    binary of the given dtype; .npy loads with mmap_mode)."""
+    if path.endswith(".npy"):
+        return np.load(path, mmap_mode="r")
+    if dtype is None:
+        meta = path + ".meta"
+        if not os.path.exists(meta):
+            raise ValueError(
+                f"{path} has no .meta sidecar recording its dtype; pass "
+                "dtype= explicitly (guessing would silently misparse uint32 "
+                "token files as uint16 garbage)"
+            )
+        with open(meta) as f:
+            dtype = np.dtype(f.read().strip())
+    return np.memmap(path, dtype=dtype, mode="r")

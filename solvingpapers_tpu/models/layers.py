@@ -68,7 +68,12 @@ class Attention(nn.Module):
     dropout: float = 0.0
     use_bias: bool = False
     dtype: jnp.dtype = jnp.float32
-    use_flash: bool = False  # Pallas kernel for the uncached path
+    # Pallas kernel for the uncached path. Note: a pallas_call is opaque to
+    # GSPMD, so under a sharded mesh its operands are gathered rather than
+    # partitioned — use_flash is for single-device / replicated-attention
+    # runs today (a shard_map-wrapped variant is the planned mesh path);
+    # the dense path partitions under any mesh.
+    use_flash: bool = False
 
     @nn.compact
     def __call__(

@@ -128,3 +128,20 @@ def test_tensorboard_writer(tmp_path):
     w.write(1, {"loss": 2.0})
     w.close()
     assert any(f.startswith("events") for f in os.listdir(tmp_path / "tb"))
+
+
+def test_token_file_roundtrip_and_mmap(tmp_path):
+    from solvingpapers_tpu.data import load_token_file, tokenize_to_file
+
+    text = synthetic_text(5_000, seed=2)
+    tok = ByteBPETokenizer.train(text, vocab_size=300)
+    path = str(tmp_path / "toks.bin")
+    ids = tokenize_to_file(text, tok, path)
+    assert ids.dtype == np.uint16  # vocab 300 fits
+    loaded = load_token_file(path)
+    assert isinstance(loaded, np.memmap)
+    np.testing.assert_array_equal(ids, loaded)
+    # npy variant
+    npy = str(tmp_path / "toks.npy")
+    tokenize_to_file(text, tok, npy)
+    np.testing.assert_array_equal(ids, load_token_file(npy))

@@ -1,0 +1,82 @@
+"""Golden-value forward tests (SURVEY.md §4: 'golden-value tests for each
+model's forward on fixed PRNG keys'). Values were generated on the CPU
+backend with threefry keys; any unintended change to init, layer math, or
+layer wiring shifts them. Regenerate deliberately if architecture changes
+are intended (see git history of this file).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+GOLDEN = {
+    "gpt": [-0.113971, -0.417388, 1.489783, -0.145843],
+    "llama3": [1.271275, 0.720245, 1.602395, -0.731151],
+    "gemma": [-0.569685, 0.46484, 1.035346, -1.359757],
+    "deepseekv3": [0.136766, 0.103721, -0.037179, 0.024156],
+    "vit": [-1.796156, -0.709384, -0.028966, 0.347098],
+}
+
+
+@pytest.fixture()
+def fixed_key():
+    # goldens were generated under threefry; pin it regardless of defaults
+    return jax.random.key(0, impl="threefry2x32")
+
+
+def toks():
+    return jnp.arange(16, dtype=jnp.int32)[None, :] % 7
+
+
+def check(name, logits_tail):
+    np.testing.assert_allclose(
+        np.asarray(logits_tail, np.float32), GOLDEN[name], rtol=2e-4, atol=2e-5
+    )
+
+
+def test_gpt_golden(fixed_key):
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    m = GPT(GPTConfig(vocab_size=32, block_size=16, dim=16, n_layers=2,
+                      n_heads=2, dropout=0.0))
+    p = m.init({"params": fixed_key}, toks())["params"]
+    check("gpt", m.apply({"params": p}, toks())[0][0, -1, :4])
+
+
+def test_llama3_golden(fixed_key):
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    m = Llama(LlamaConfig(vocab_size=32, max_seq_len=16, dim=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2, dropout=0.0))
+    p = m.init({"params": fixed_key}, toks())["params"]
+    check("llama3", m.apply({"params": p}, toks())[0][0, -1, :4])
+
+
+def test_gemma_golden(fixed_key):
+    from solvingpapers_tpu.models.gemma import Gemma, GemmaConfig
+
+    m = Gemma(GemmaConfig(vocab_size=32, max_seq_len=16, dim=16, n_layers=2,
+                          n_heads=4, n_kv_heads=2, dropout=0.0))
+    p = m.init({"params": fixed_key}, toks())["params"]
+    check("gemma", m.apply({"params": p}, toks())[0][0, -1, :4])
+
+
+def test_deepseekv3_golden(fixed_key):
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3, DeepSeekV3Config
+
+    m = DeepSeekV3(DeepSeekV3Config(
+        vocab_size=32, block_size=16, dim=16, n_layers=2, n_heads=2,
+        latent_dim=4, n_experts=4, top_experts=2, dropout=0.0, attn_dropout=0.0,
+    ))
+    v = m.init({"params": fixed_key}, toks())
+    check("deepseekv3", m.apply(v, toks())[0][0, -1, :4])
+
+
+def test_vit_golden(fixed_key):
+    from solvingpapers_tpu.models.vit import ViT, ViTConfig
+
+    m = ViT(ViTConfig(dim=16, n_layers=2, n_heads=2))
+    img = jnp.linspace(0, 1, 28 * 28).reshape(1, 28, 28, 1)
+    p = m.init({"params": fixed_key}, img)["params"]
+    check("vit", m.apply({"params": p}, img)[0, :4])
