@@ -88,7 +88,14 @@ def cmd_train(args) -> int:
             init_fn=init_fn_for(cfg), mesh=mesh,
         )
         callbacks = None
+        can_sample = True
         if args.artifacts_dir:
+            try:  # token-file runs have no text tokenizer to build prompts
+                tok.encode("\n")
+            except Exception as e:
+                print(f"[sample] disabled: {e}", file=sys.stderr)
+                can_sample = False
+        if args.artifacts_dir and can_sample:
             # deepseekv3 cell 54: sample + save generated_{step}.txt each eval
             from solvingpapers_tpu import ops
             from solvingpapers_tpu.infer import generate

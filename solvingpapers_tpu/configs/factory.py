@@ -145,16 +145,27 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
 
         toks = load_token_file(cfg.data["path"])
 
-        class _IdTok:  # ids-only passthrough for code paths expecting .decode
+        class _IdTok:
+            """Ids-only tokenizer: prompts are space-separated integer ids
+            (the text tokenizer that wrote the file is not reconstructable)."""
+
             vocab_size = cfg.model.vocab_size
 
             def encode(self, s):
-                raise RuntimeError("token-file runs carry no text tokenizer")
+                try:
+                    return np.asarray([int(t) for t in s.split()], np.int32)
+                except ValueError:
+                    raise RuntimeError(
+                        "token-file runs carry no text tokenizer; prompts "
+                        f"must be space-separated integer ids, got {s!r}"
+                    ) from None
 
             def decode(self, ids):
                 return " ".join(str(int(i)) for i in ids)
 
-        max_id = int(np.max(toks))  # one pass; catches tokenizer mismatch
+        from solvingpapers_tpu.data.tokens import token_file_max_id
+
+        max_id = token_file_max_id(cfg.data["path"], toks)
         if max_id >= cfg.model.vocab_size:
             raise ValueError(
                 f"token file {cfg.data['path']} holds id {max_id} but "

@@ -45,6 +45,11 @@ def lm_batch_iterator(
     memory-mapped token files crop host-side so corpora larger than HBM
     stream from disk (only the cropped windows are copied to device).
     """
+    if len(tokens) < block_size + 2:
+        raise ValueError(
+            f"corpus of {len(tokens)} tokens is too short for "
+            f"block_size {block_size} (need >= block_size + 2)"
+        )
     if isinstance(tokens, np.memmap):
         rng = np.random.default_rng(seed)
         max_start = len(tokens) - block_size - 1

@@ -31,8 +31,22 @@ def tokenize_to_file(
     else:
         ids.tofile(path)
         with open(path + ".meta", "w") as f:
-            f.write(np.dtype(dtype).name)
+            # line 1: dtype; then key=value lines (max_id recorded at write
+            # time so loads need not rescan multi-GB files)
+            f.write(f"{np.dtype(dtype).name}\nmax_id={int(ids.max())}\n")
     return ids
+
+
+def token_file_max_id(path: str, tokens: np.ndarray) -> int:
+    """Largest token id: from the .meta sidecar when recorded, else one
+    full pass over `tokens` (O(file size) for memmaps)."""
+    meta = path + ".meta"
+    if os.path.exists(meta):
+        with open(meta) as f:
+            for line in f.read().splitlines()[1:]:
+                if line.startswith("max_id="):
+                    return int(line.split("=", 1)[1])
+    return int(np.max(tokens))
 
 
 def load_token_file(path: str, *, dtype=None) -> np.ndarray:
@@ -49,5 +63,5 @@ def load_token_file(path: str, *, dtype=None) -> np.ndarray:
                 "token files as uint16 garbage)"
             )
         with open(meta) as f:
-            dtype = np.dtype(f.read().strip())
+            dtype = np.dtype(f.read().splitlines()[0].strip())
     return np.memmap(path, dtype=dtype, mode="r")

@@ -36,7 +36,7 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import LatentCache, update_latent_cache
-from solvingpapers_tpu.models.layers import GLUFFN, RMSNorm, LayerNorm, swiglu_hidden_dim
+from solvingpapers_tpu.models.layers import GLUFFN, RMSNorm, LayerNorm, swiglu_hidden_dim, maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -59,6 +59,7 @@ class DeepSeekV3Config:
     mtp_loss_weight: float = 0.3
     dropout: float = 0.1
     attn_dropout: float = 0.1
+    remat: bool = False  # jax.checkpoint each decoder layer
     norm_eps: float = 1e-6
     dtype: str = "float32"
 
@@ -86,7 +87,7 @@ class MLA(nn.Module):
     cfg: DeepSeekV3Config
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True):
         cfg = self.cfg
         b, s, _ = x.shape
         n, hd, lat = cfg.n_heads, cfg.head_dim, cfg.latent_dim
@@ -231,7 +232,7 @@ class DSV3DecoderLayer(nn.Module):
     cfg: DeepSeekV3Config
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True):
         cfg = self.cfg
         h, cache = MLA(cfg, name="mla")(
             RMSNorm(eps=cfg.norm_eps, name="norm1")(x),
@@ -277,12 +278,13 @@ class DeepSeekV3(nn.Module):
         x = embed(tokens) + jnp.take(pe, positions, axis=0).astype(cfg.compute_dtype)
 
         new_caches = [] if caches is not None else None
+        layer_cls = maybe_remat(DSV3DecoderLayer, cfg.remat, caches)
         for i in range(cfg.n_layers):
-            x, c = DSV3DecoderLayer(cfg, name=f"layer_{i}")(
+            x, c = layer_cls(cfg, name=f"layer_{i}")(
                 x,
-                positions=positions,
-                cache=None if caches is None else caches[i],
-                deterministic=deterministic,
+                positions,
+                None if caches is None else caches[i],
+                deterministic,
             )
             if new_caches is not None:
                 new_caches.append(c)

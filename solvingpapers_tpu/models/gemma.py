@@ -29,7 +29,7 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm
+from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,6 +46,7 @@ class GemmaConfig:
     dropout: float = 0.1
     dtype: str = "float32"
     use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block: recompute activations in backward
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -57,10 +58,12 @@ class GemmaConfig:
 
 
 class GemmaBlock(nn.Module):
+    # __call__ args are positional so nn.remat can mark `deterministic`
+    # static (static_argnums counts self=0, x=1, positions=2, cache=3)
     cfg: GemmaConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -115,12 +118,13 @@ class Gemma(nn.Module):
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         new_caches = [] if caches is not None else None
+        block_cls = maybe_remat(GemmaBlock, cfg.remat, caches)
         for i in range(cfg.n_layers):
-            x, c = GemmaBlock(cfg, name=f"block_{i}")(
+            x, c = block_cls(cfg, name=f"block_{i}")(
                 x,
-                positions=positions,
-                cache=None if caches is None else caches[i],
-                deterministic=deterministic,
+                positions,
+                None if caches is None else caches[i],
+                deterministic,
             )
             if new_caches is not None:
                 new_caches.append(c)

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, LayerNorm, MLP
+from solvingpapers_tpu.models.layers import Attention, LayerNorm, MLP, maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +35,7 @@ class GPTConfig:
     dropout: float = 0.1
     dtype: str = "float32"
     use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block: recompute activations in backward
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -42,10 +43,12 @@ class GPTConfig:
 
 
 class GPTBlock(nn.Module):
+    # __call__ args are positional so nn.remat can mark `deterministic`
+    # static (static_argnums counts self=0, x=1, positions=2, cache=3)
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -95,12 +98,13 @@ class GPT(nn.Module):
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         new_caches = [] if caches is not None else None
+        block_cls = maybe_remat(GPTBlock, cfg.remat, caches)
         for i in range(cfg.n_layers):
-            x, c = GPTBlock(cfg, name=f"block_{i}")(
+            x, c = block_cls(cfg, name=f"block_{i}")(
                 x,
-                positions=positions,
-                cache=None if caches is None else caches[i],
-                deterministic=deterministic,
+                positions,
+                None if caches is None else caches[i],
+                deterministic,
             )
             if new_caches is not None:
                 new_caches.append(c)

@@ -201,3 +201,17 @@ class GLUFFN(nn.Module):
 def swiglu_hidden_dim(dim: int, multiplier: int = 4) -> int:
     """The (2/3)·4·dim sizing convention (deepseekv3 cell 21: ((2D)*4)//3)."""
     return (2 * dim * multiplier) // 3
+
+
+def maybe_remat(block_cls, remat: bool, caches) -> type:
+    """Wrap a decoder-block class in jax.checkpoint for training (trades
+    recompute for HBM — dense attention at dim/seq 1024 OOMs one v5e
+    without it). Requires the block's __call__ signature to be
+    (self, x, positions, cache, deterministic): static_argnums=(4,) marks
+    the python-bool `deterministic` static (self counts as 0). Decode
+    (caches present) has no backward pass, so remat is skipped there.
+    Numerical equivalence: tests/test_llama3.py::test_remat_matches_noremat.
+    """
+    if remat and caches is None:
+        return nn.remat(block_cls, prevent_cse=False, static_argnums=(4,))
+    return block_cls

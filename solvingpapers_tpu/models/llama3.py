@@ -27,7 +27,7 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, swiglu_hidden_dim
+from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, swiglu_hidden_dim, maybe_remat
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +44,7 @@ class LlamaConfig:
     dropout: float = 0.0
     dtype: str = "float32"
     use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block: recompute activations in backward
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -55,10 +56,12 @@ class LlamaConfig:
 
 
 class LlamaBlock(nn.Module):
+    # __call__ args are positional so nn.remat can mark `deterministic`
+    # static (static_argnums counts self=0, x=1, positions=2, cache=3)
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -111,12 +114,13 @@ class Llama(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(tokens)
 
         new_caches = [] if caches is not None else None
+        block_cls = maybe_remat(LlamaBlock, cfg.remat, caches)
         for i in range(cfg.n_layers):
-            x, c = LlamaBlock(cfg, name=f"block_{i}")(
+            x, c = block_cls(cfg, name=f"block_{i}")(
                 x,
-                positions=positions,
-                cache=None if caches is None else caches[i],
-                deterministic=deterministic,
+                positions,
+                None if caches is None else caches[i],
+                deterministic,
             )
             if new_caches is not None:
                 new_caches.append(c)

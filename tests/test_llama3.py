@@ -93,3 +93,24 @@ def test_sharded_train_matches_single_device(devices):
     single = run(MeshConfig(data=1, fsdp=1, model=1), devices[:1])
     sharded = run(MeshConfig(data=2, fsdp=2, model=2), devices)
     np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-5)
+
+
+def test_remat_matches_noremat():
+    """remat=True must be numerically identical (it only trades recompute
+    for memory) for both forward and gradients."""
+    import dataclasses
+
+    base = dataclasses.replace(TINY, remat=False)
+    rmt = dataclasses.replace(TINY, remat=True)
+    toks = jax.random.randint(jax.random.key(5), (2, 16), 0, TINY.vocab_size)
+    params = Llama(base).init({"params": jax.random.key(0)}, toks)["params"]
+
+    def loss(cfg, params):
+        logits, _ = Llama(cfg).apply({"params": params}, toks)
+        return jnp.sum(logits.astype(jnp.float32) ** 2)
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(rmt, p))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
