@@ -88,14 +88,16 @@ def cmd_train(args) -> int:
             init_fn=init_fn_for(cfg), mesh=mesh,
         )
         callbacks = None
-        can_sample = True
+        can_sample = False
         if args.artifacts_dir:
             try:  # token-file runs have no text tokenizer to build prompts
-                tok.encode("\n")
+                can_sample = len(tok.encode("\n")) > 0
+                if not can_sample:
+                    print("[sample] disabled: tokenizer yields an empty "
+                          "prompt", file=sys.stderr)
             except Exception as e:
                 print(f"[sample] disabled: {e}", file=sys.stderr)
-                can_sample = False
-        if args.artifacts_dir and can_sample:
+        if can_sample:
             # deepseekv3 cell 54: sample + save generated_{step}.txt each eval
             from solvingpapers_tpu import ops
             from solvingpapers_tpu.infer import generate

@@ -30,10 +30,11 @@ def tokenize_to_file(
         np.save(path, ids)
     else:
         ids.tofile(path)
+        max_id = int(ids.max()) if ids.size else -1
         with open(path + ".meta", "w") as f:
             # line 1: dtype; then key=value lines (max_id recorded at write
             # time so loads need not rescan multi-GB files)
-            f.write(f"{np.dtype(dtype).name}\nmax_id={int(ids.max())}\n")
+            f.write(f"{np.dtype(dtype).name}\nmax_id={max_id}\n")
     return ids
 
 

@@ -10,6 +10,11 @@ has no custom kernels to port (SURVEY.md §0). This kernel family provides:
     folds the q-head -> kv-head mapping, replacing ops.repeat_kv)
   * backward: custom VJP with separate dq and dk/dv kernels recomputing
     probabilities from the saved log-sum-exp (FlashAttention-2 style)
+  * in-kernel attention-prob dropout: masks generated from
+    (seed, block id) by the TPU PRNG and regenerated identically in the
+    backward kernels — no (S, S) mask tensor ever exists (validated by the
+    linearity identity in tests/test_flash_dropout_tpu.py; interpret-mode
+    prng is a zero stub, so dropout tests are hardware-gated)
 
 Numerics reference: ops.dot_product_attention (tests/test_flash_attention.py
 asserts forward and gradient equality in interpret mode).
@@ -32,6 +37,19 @@ BIG_NEG = -2.0**30
 DEFAULT_BLOCK = 128
 
 
+def _dropout_keep(shape, seed_val, block_uid, rate):
+    """Regenerable dropout keep-mask for one (q-block, k-block) score tile.
+
+    Seeded by (seed, flat block id) so the forward and both backward kernels
+    reproduce the identical mask regardless of their loop order. Returns a
+    bool keep array; caller scales kept probs by 1/(1-rate).
+    """
+    pltpu.prng_seed(seed_val + block_uid)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    threshold = jnp.uint32(min(int((1.0 - rate) * 4294967296.0), 4294967295))
+    return bits < threshold
+
+
 def _pick_block(seq: int, requested: int) -> int:
     block = min(requested, seq)
     while seq % block:
@@ -42,8 +60,8 @@ def _pick_block(seq: int, requested: int) -> int:
 # --------------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
-                offset):
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *, scale,
+                causal, block_k, offset, dropout_rate, num_kb_total):
     # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D). `offset` end-aligns the
     # causal mask when seq_q != seq_k (ops.attention.causal_mask semantics:
     # query i attends to kv positions <= i + (seq_k - seq_q)).
@@ -58,6 +76,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
         hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q + offset, block_k))
     else:
         hi = num_kb
+    # loop-invariant; also, pl.program_id inside a fori_loop body does not
+    # lower in interpret mode
+    prog_i = pl.program_id(0)
+    num_j = pl.num_programs(1)
 
     def body(kb, carry):
         m_i, l_i, acc = carry
@@ -78,9 +100,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_i - m_new)
+        # l accumulates the UNdropped mass (the softmax denominator);
+        # dropout applies to the normalized probs, i.e. to acc only
         l_new = alpha * l_i + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            uid = (prog_i * num_j + j) * num_kb_total + kb
+            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            p_use = p
         acc = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p_use, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc
@@ -94,7 +124,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k,
     lse_ref[0, 0, :] = (m_i + jnp.log(l_i))[:, 0]
 
 
-def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
+def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
+         dropout_rate, interpret):
     """q3: (B*N, S, D); k3/v3: (B*Nkv, Skv, D). Returns (o, lse)."""
     bn, seq_q, d = q3.shape
     seq_k = k3.shape[1]
@@ -108,7 +139,8 @@ def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
     grid = (bn, seq_q // block_q)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        offset=seq_k - seq_q,
+        offset=seq_k - seq_q, dropout_rate=dropout_rate,
+        num_kb_total=seq_k // block_k,
     )
     return pl.pallas_call(
         kernel,
@@ -117,6 +149,7 @@ def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
             pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -127,14 +160,15 @@ def _fwd(q3, k3, v3, n_heads, n_kv, scale, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bn, 1, seq_q), jnp.float32),
         ],
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, seed)
 
 
 # -------------------------------------------------------------------- backward
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k, offset):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+                   dq_ref, *, scale, causal, block_k, offset, dropout_rate,
+                   num_kb_total):
     block_q = q_ref.shape[1]
     seq_k = k_ref.shape[1]
     j = pl.program_id(1)
@@ -149,6 +183,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal
         else num_kb
     )
+    prog_i = pl.program_id(0)
+    num_j = pl.num_programs(1)
 
     def body(kb, dq):
         k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
@@ -166,6 +202,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if dropout_rate > 0.0:
+            uid = (prog_i * num_j + j) * num_kb_total + kb
+            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta)
         return dq + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -177,8 +217,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, offset):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, offset,
+                    dropout_rate, num_kb_total):
     block_k = k_ref.shape[1]
     seq_q = q_ref.shape[1]
     kb = pl.program_id(1)
@@ -187,6 +228,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_blk = k_ref[0, :, :].astype(jnp.float32)
     v_blk = v_ref[0, :, :].astype(jnp.float32)
     num_qb = seq_q // block_q
+    prog_i = pl.program_id(0)
     # first q block whose last row (jb+1)*bq - 1 + offset can reach col kb*bk
     lo = jnp.maximum(kb * block_k - offset, 0) // block_q if causal else 0
 
@@ -204,11 +246,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         p = jnp.exp(s - lse)  # (bq, bk)
-        dv = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if dropout_rate > 0.0:
+            uid = (prog_i * num_qb + jb) * num_kb_total + kb
+            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        else:
+            p_v = p
+        dv = dv + jax.lax.dot_general(
+            p_v, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
         dk = dk + jax.lax.dot_general(
@@ -228,22 +277,24 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
 )
-def _flash(q3, k3, v3, heads, scale, causal, blocks, interpret):
-    o, _ = _fwd(q3, k3, v3, heads[0], heads[1], scale, causal,
-                blocks[0], blocks[1], interpret)
+def _flash(q3, k3, v3, seed, heads, scale, causal, blocks, dropout_rate,
+           interpret):
+    o, _ = _fwd(q3, k3, v3, seed, heads[0], heads[1], scale, causal,
+                blocks[0], blocks[1], dropout_rate, interpret)
     return o
 
 
-def _flash_fwd(q3, k3, v3, heads, scale, causal, blocks, interpret):
-    o, lse = _fwd(q3, k3, v3, heads[0], heads[1], scale, causal,
-                  blocks[0], blocks[1], interpret)
-    return o, (q3, k3, v3, o, lse)
+def _flash_fwd(q3, k3, v3, seed, heads, scale, causal, blocks, dropout_rate,
+               interpret):
+    o, lse = _fwd(q3, k3, v3, seed, heads[0], heads[1], scale, causal,
+                  blocks[0], blocks[1], dropout_rate, interpret)
+    return o, (q3, k3, v3, seed, o, lse)
 
 
-def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
-    q3, k3, v3, o, lse = res
+def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
+    q3, k3, v3, seed, o, lse = res
     n_heads, n_kv = heads
     block_q, block_k = blocks
     bn, seq_q, d = q3.shape
@@ -263,7 +314,9 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, offset=seq_k - seq_q),
+                          block_k=block_k, offset=seq_k - seq_q,
+                          dropout_rate=dropout_rate,
+                          num_kb_total=seq_k // block_k),
         grid=(bn, seq_q // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -272,15 +325,18 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
             pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
         interpret=interpret,
-    )(q3, k3r, v3r, do, lse, delta)
+    )(q3, k3r, v3r, do, lse, delta, seed)
 
     dk_r, dv_r = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, offset=seq_k - seq_q),
+                          block_q=block_q, offset=seq_k - seq_q,
+                          dropout_rate=dropout_rate,
+                          num_kb_total=seq_k // block_k),
         grid=(bn, seq_k // block_k),
         in_specs=[
             pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
@@ -289,6 +345,7 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
             pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -299,7 +356,7 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
             jax.ShapeDtypeStruct((bn, seq_k, d), v3.dtype),
         ],
         interpret=interpret,
-    )(q3, k3r, v3r, do, lse, delta)
+    )(q3, k3r, v3r, do, lse, delta, seed)
 
     if group > 1:  # reduce repeated-head grads back to kv heads
         b = bn // n_heads
@@ -307,7 +364,8 @@ def _flash_bwd(heads, scale, causal, blocks, interpret, res, do):
             b * n_kv, seq_k, d
         )
         dk_r, dv_r = fold(dk_r), fold(dv_r)
-    return dq, dk_r.astype(k3.dtype), dv_r.astype(v3.dtype)
+    # seed is integer-typed: no cotangent
+    return dq, dk_r.astype(k3.dtype), dv_r.astype(v3.dtype), None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -322,17 +380,29 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
+    dropout_rate: float = 0.0,
+    dropout_seed: jax.Array | int = 0,
     interpret: bool = False,
 ) -> jax.Array:
     """Flash attention over BSNH tensors (drop-in for ops.dot_product_attention
-    when there is no cache/explicit mask and dropout is inactive).
+    when there is no cache/explicit mask).
 
     q: (B, Sq, N, D); k, v: (B, Skv, Nkv, D) with N % Nkv == 0.
+    dropout_rate > 0 applies attention-prob dropout INSIDE the kernel
+    (masks regenerated from (dropout_seed, block id) in the backward — no
+    (S, S) mask tensor ever exists); same Bernoulli semantics as the dense
+    reference, different random stream.
     """
     b, seq_q, n_heads, d = q.shape
     seq_k, n_kv = k.shape[1], k.shape[2]
     if n_heads % n_kv:
         raise ValueError(f"q heads {n_heads} not a multiple of kv heads {n_kv}")
+    if interpret and dropout_rate > 0.0:
+        raise ValueError(
+            "in-kernel dropout requires the hardware PRNG: interpret-mode "
+            "pltpu.prng_random_bits is a zero stub, which would silently "
+            "keep every element scaled by 1/(1-rate)"
+        )
     if scale is None:
         scale = d**-0.5
     block_q = _pick_block(seq_q, block_q)
@@ -341,8 +411,9 @@ def flash_attention(
     q3 = q.transpose(0, 2, 1, 3).reshape(b * n_heads, seq_q, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, seq_k, d)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * n_kv, seq_k, d)
+    seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
     o3 = _flash(
-        q3, k3, v3, (n_heads, n_kv), float(scale), bool(causal),
-        (block_q, block_k), interpret,
+        q3, k3, v3, seed, (n_heads, n_kv), float(scale), bool(causal),
+        (block_q, block_k), float(dropout_rate), interpret,
     )
     return o3.reshape(b, n_heads, seq_q, d).transpose(0, 2, 1, 3)

@@ -68,11 +68,11 @@ class Attention(nn.Module):
     dropout: float = 0.0
     use_bias: bool = False
     dtype: jnp.dtype = jnp.float32
-    # Pallas kernel for the uncached path. Note: a pallas_call is opaque to
-    # GSPMD, so under a sharded mesh its operands are gathered rather than
-    # partitioned — use_flash is for single-device / replicated-attention
-    # runs today (a shard_map-wrapped variant is the planned mesh path);
-    # the dense path partitions under any mesh.
+    # Pallas kernel for the uncached path (supports attention-prob dropout
+    # in-kernel). Note: a pallas_call is opaque to GSPMD, so under a sharded
+    # mesh its operands are gathered rather than partitioned — use_flash is
+    # for single-device / replicated-attention runs today (a shard_map
+    # wrapper is the planned mesh path); the dense path partitions anywhere.
     use_flash: bool = False
 
     @nn.compact
@@ -119,23 +119,23 @@ class Attention(nn.Module):
             mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
             out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
         else:
-            # flash path has no attention-prob dropout; keep the dense path
-            # when that regularizer is active so training semantics hold
             dropout_active = self.dropout > 0.0 and not deterministic
-            if self.use_flash and dropout_active:
-                import warnings
-
-                warnings.warn(
-                    "use_flash=True is ignored while attention dropout is "
-                    f"active (dropout={self.dropout}, train mode): the flash "
-                    "kernel has no prob-dropout. Set dropout=0.0 to train "
-                    "with the flash kernel.",
-                    stacklevel=2,
-                )
-            if self.use_flash and not dropout_active:
+            if self.use_flash:
                 from solvingpapers_tpu.kernels import flash_attention
 
-                out = flash_attention(q, k, v, causal=self.causal)
+                if dropout_active:
+                    # in-kernel prob dropout: same Bernoulli semantics as the
+                    # dense path, mask regenerated in the backward from the
+                    # seed (never materialized)
+                    seed = jax.random.randint(
+                        self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
+                    )
+                    out = flash_attention(
+                        q, k, v, causal=self.causal,
+                        dropout_rate=self.dropout, dropout_seed=seed,
+                    )
+                else:
+                    out = flash_attention(q, k, v, causal=self.causal)
             else:
                 out = ops.dot_product_attention(
                     q,

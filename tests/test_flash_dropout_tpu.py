@@ -1,0 +1,118 @@
+"""In-kernel flash-attention dropout — REAL TPU ONLY.
+
+interpret-mode pltpu.prng_random_bits is a zero stub (every mask would be
+all-keep, silently scaling probs by 1/(1-rate)), so these tests require the
+hardware PRNG:
+
+    SPTPU_TEST_PLATFORM=axon python -m pytest tests/test_flash_dropout_tpu.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.kernels import flash_attention
+
+if jax.devices()[0].platform not in ("tpu",) and "TPU" not in str(
+    getattr(jax.devices()[0], "device_kind", "")
+):
+    pytest.skip("requires a real TPU (in-kernel PRNG)", allow_module_level=True)
+
+
+def make_qkv(key, b, sq, skv, n, n_kv, d, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, n, d), dtype)
+    k = jax.random.normal(kk, (b, skv, n_kv, d), dtype)
+    v = jax.random.normal(kv, (b, skv, n_kv, d), dtype)
+    return q, k, v
+
+
+class TestInKernelDropout:
+    """In-kernel attention-prob dropout: deterministic in seed, unbiased,
+    and gradient-consistent (the backward kernels must regenerate the exact
+    forward masks from (seed, block id) despite different loop orders)."""
+
+    def setup_method(self):
+        self.q, self.k, self.v = make_qkv(jax.random.key(7), 1, 256, 256, 2, 2, 32)
+
+    def flash(self, rate, seed, q=None):
+        return flash_attention(
+            self.q if q is None else q, self.k, self.v, causal=True,
+            dropout_rate=rate, dropout_seed=seed,
+        )
+
+    def test_deterministic_in_seed(self):
+        a = self.flash(0.3, 5)
+        b = self.flash(0.3, 5)
+        c = self.flash(0.3, 6)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    def test_unbiased_and_zero_rate_matches_dense(self):
+        # TPU f32 matmuls pass through the MXU at bf16-level precision, so
+        # hardware comparisons use bf16 tolerances (exact f32 equality is
+        # covered by the interpret-mode suite)
+        base = ops.dot_product_attention(self.q, self.k, self.v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(self.flash(0.0, 0)), np.asarray(base), rtol=3e-2, atol=3e-2
+        )
+        # mean over many seeds approaches the no-dropout output (unbiased):
+        # single-seed mean |diff| is ~0.08; averaging n seeds shrinks it by
+        # ~1/sqrt(n). Assert the mean absolute deviation, not the max (the
+        # max over 16k elements is dominated by sampling noise).
+        acc = np.zeros_like(np.asarray(base))
+        n = 24
+        for s in range(n):
+            acc += np.asarray(self.flash(0.25, 100 + s))
+        mad = np.abs(acc / n - np.asarray(base)).mean()
+        assert mad < 0.05, mad
+
+    def test_dv_mask_consistency_via_linearity(self):
+        """out is exactly linear in v: out(v+U) - out(v) = P_dropped @ U with
+        a fixed seed. Then <dOut, W> must equal <U, grad_v sum(out*W)> — an
+        identity that only holds if the dk/dv backward kernel regenerates
+        the forward's exact dropout mask (no finite-difference noise)."""
+        key = jax.random.key(3)
+        w = jax.random.normal(key, self.q.shape)
+        u = jax.random.normal(jax.random.fold_in(key, 1), self.v.shape)
+
+        def loss(v):
+            return jnp.sum(
+                flash_attention(self.q, self.k, v, causal=True,
+                                dropout_rate=0.3, dropout_seed=11) * w
+            )
+
+        gv = jax.grad(loss)(self.v)
+        lhs = float(loss(self.v + u) - loss(self.v))
+        rhs = float(jnp.sum(u * gv))
+        np.testing.assert_allclose(lhs, rhs, rtol=5e-3)
+
+    def test_trains_with_dropout(self):
+        """End-to-end: GPT with use_flash + in-kernel dropout must train."""
+        import numpy as onp
+
+        from solvingpapers_tpu.data.batches import lm_batch_iterator
+        from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+        from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+        cfg = GPTConfig(vocab_size=64, block_size=128, dim=64, n_layers=2,
+                        n_heads=2, dropout=0.1, dtype="bfloat16",
+                        use_flash=True)
+        tcfg = TrainConfig(steps=0, batch_size=16, log_every=10**9,
+                           eval_every=0,
+                           optimizer=OptimizerConfig(max_lr=3e-3,
+                                                     total_steps=40))
+        tr = Trainer(GPT(cfg), tcfg)
+        toks = onp.random.default_rng(0).integers(0, 20, size=100_000)
+        it = lm_batch_iterator(toks, 16, 128, seed=0)
+        b0 = next(it)
+        state = tr.init_state(b0)
+        tr._build_steps()
+        state, m = tr._train_step(state, b0)
+        first = float(jax.device_get(m["train_loss"]))
+        for _ in range(40):
+            state, m = tr._train_step(state, next(it))
+        last = float(jax.device_get(m["train_loss"]))
+        assert last < first - 0.5, (first, last)
