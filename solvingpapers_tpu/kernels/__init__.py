@@ -7,3 +7,4 @@ pure-jnp reference in ops/ and interpret-mode equality tests.
 """
 
 from solvingpapers_tpu.kernels.flash_attention import flash_attention
+from solvingpapers_tpu.kernels.sharded_flash import sharded_flash_attention

@@ -1,0 +1,67 @@
+"""Mesh-sharded flash attention.
+
+A pallas_call is opaque to GSPMD: under pjit its operands get all-gathered
+instead of partitioned. This wrapper runs the kernel inside `shard_map`
+with batch sharded over (data, fsdp) and heads over the TP axis — attention
+is embarrassingly parallel across both, so no collectives are needed inside
+(context parallelism is sharding/ring_attention.py's job).
+
+GQA constraint under TP: kv heads must divide evenly over the model axis
+(each device needs its query heads' kv group locally).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from solvingpapers_tpu.kernels.flash_attention import flash_attention
+
+
+def sharded_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = False,
+    scale: float | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: jax.Array | int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """flash_attention with BSNH operands partitioned over `mesh`:
+    batch over ('data','fsdp'), heads over 'model'. Seq stays unsharded
+    (use ring_attention for context parallelism)."""
+    n_heads, n_kv = q.shape[2], k.shape[2]
+    tp = mesh.shape.get("model", 1)
+    if n_heads % tp or n_kv % tp:
+        raise ValueError(
+            f"heads ({n_heads} q / {n_kv} kv) must divide the model axis ({tp})"
+        )
+
+    spec = P(("data", "fsdp"), None, "model", None)
+    seed = jax.numpy.asarray(dropout_seed, jax.numpy.int32)
+
+    def local(q, k, v, seed):
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale,
+            dropout_rate=dropout_rate,
+            # decorrelate dropout across devices deterministically
+            dropout_seed=seed
+            + jax.lax.axis_index("model")
+            + 131 * jax.lax.axis_index("data")
+            + 17 * jax.lax.axis_index("fsdp"),
+            interpret=interpret,
+        )
+
+    # check_vma=False: pallas_call's out_shape carries no varying-axes
+    # metadata, which the vma checker (jax 0.9) rejects; the computation is
+    # embarrassingly parallel over every sharded axis so the check adds
+    # nothing here
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec, P()), out_specs=spec,
+        check_vma=False,
+    )(q, k, v, seed)

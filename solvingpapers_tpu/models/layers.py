@@ -70,9 +70,9 @@ class Attention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     # Pallas kernel for the uncached path (supports attention-prob dropout
     # in-kernel). Note: a pallas_call is opaque to GSPMD, so under a sharded
-    # mesh its operands are gathered rather than partitioned — use_flash is
-    # for single-device / replicated-attention runs today (a shard_map
-    # wrapper is the planned mesh path); the dense path partitions anywhere.
+    # mesh this module's direct call would gather its operands — mesh runs
+    # should use kernels.sharded_flash_attention (shard_map-wrapped: batch
+    # over data/fsdp, heads over model); the dense path partitions anywhere.
     use_flash: bool = False
 
     @nn.compact

@@ -278,6 +278,11 @@ class Trainer:
                 if first_used:
                     first = None
                 state, metrics = self._train_step(state, batch)
+                if step == start_step:
+                    # fence the first step so compile time never pollutes
+                    # step_time/tokens_per_sec/MFU metrics
+                    jax.device_get(metrics["train_loss"])
+                    t_prev = time.perf_counter()
 
                 if cfg.eval_every > 0 and eval_iter_fn and (step + 1) % cfg.eval_every == 0:
                     t_eval = time.perf_counter()

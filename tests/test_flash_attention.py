@@ -89,3 +89,46 @@ def test_rejects_bad_head_ratio():
     q, k, v = make_qkv(jax.random.key(4), 1, 64, 64, 3, 2, 32)
     with pytest.raises(ValueError, match="not a multiple"):
         flash_attention(q, k, v, interpret=True)
+
+
+def test_sharded_flash_matches_dense(devices):
+    """shard_map-wrapped kernel under dp/fsdp/tp == single-device dense
+    (interpret mode inside shard_map on the virtual CPU mesh)."""
+    from solvingpapers_tpu.kernels import sharded_flash_attention
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, model=2), devices)
+    q, k, v = make_qkv(jax.random.key(9), 4, 128, 128, 4, 2, 32)
+    out = sharded_flash_attention(q, k, v, mesh, causal=True, interpret=True)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_sharded_flash_grads_match(devices):
+    from solvingpapers_tpu.kernels import sharded_flash_attention
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(data=2, model=4), devices)
+    q, k, v = make_qkv(jax.random.key(10), 2, 64, 64, 4, 4, 16)
+
+    def loss_sharded(q, k, v):
+        o = sharded_flash_attention(q, k, v, mesh, causal=True, interpret=True)
+        return jnp.sum(o**2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(ops.dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gs = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_flash_rejects_bad_head_split(devices):
+    from solvingpapers_tpu.kernels import sharded_flash_attention
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(data=2, model=4), devices)
+    q, k, v = make_qkv(jax.random.key(11), 2, 64, 64, 4, 2, 16)  # kv 2 < tp 4
+    with pytest.raises(ValueError, match="divide the model axis"):
+        sharded_flash_attention(q, k, v, mesh, interpret=True)
