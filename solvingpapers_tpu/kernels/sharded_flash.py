@@ -12,8 +12,6 @@ GQA constraint under TP: kv heads must divide evenly over the model axis
 
 from __future__ import annotations
 
-import functools
-
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -46,14 +44,19 @@ def sharded_flash_attention(
     seed = jax.numpy.asarray(dropout_seed, jax.numpy.int32)
 
     def local(q, k, v, seed):
+        # deterministic per-device stream: the kernel seeds its PRNG with
+        # seed + block_uid (uid range ~ local_bn * n_qblocks * n_kblocks),
+        # so small per-device offsets would just shift overlapping streams.
+        # A Knuth multiplicative stride pushes devices far apart in seed
+        # space (wraps mod 2^32 — collision needs a uid range beyond that).
+        flat_idx = (
+            jax.lax.axis_index("data") * mesh.shape.get("fsdp", 1)
+            + jax.lax.axis_index("fsdp")
+        ) * mesh.shape.get("model", 1) + jax.lax.axis_index("model")
         return flash_attention(
             q, k, v, causal=causal, scale=scale,
             dropout_rate=dropout_rate,
-            # decorrelate dropout across devices deterministically
-            dropout_seed=seed
-            + jax.lax.axis_index("model")
-            + 131 * jax.lax.axis_index("data")
-            + 17 * jax.lax.axis_index("fsdp"),
+            dropout_seed=seed + flat_idx * jax.numpy.int32(-1640531527),
             interpret=interpret,
         )
 

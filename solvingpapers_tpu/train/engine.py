@@ -280,9 +280,11 @@ class Trainer:
                 state, metrics = self._train_step(state, batch)
                 if step == start_step:
                     # fence the first step so compile time never pollutes
-                    # step_time/tokens_per_sec/MFU metrics
+                    # step_time/tokens_per_sec/MFU metrics; the timed window
+                    # therefore starts at the NEXT step
                     jax.device_get(metrics["train_loss"])
                     t_prev = time.perf_counter()
+                    last_log_step = start_step + 1
 
                 if cfg.eval_every > 0 and eval_iter_fn and (step + 1) % cfg.eval_every == 0:
                     t_eval = time.perf_counter()
@@ -302,22 +304,27 @@ class Trainer:
 
                 if (step + 1) % max(cfg.log_every, 1) == 0 or step == cfg.steps - 1:
                     metrics = jax.device_get(metrics)  # blocks; also fences timing
-                    now = time.perf_counter()
-                    dt = (now - t_prev) / max(step + 1 - last_log_step, 1)
-                    t_prev = now
-                    last_log_step = step + 1
-                    metrics["step_time_s"] = dt
-                    if cfg.tokens_per_step:
-                        metrics["tokens_per_sec"] = cfg.tokens_per_step / dt
-                        metrics["tokens"] = (step + 1) * cfg.tokens_per_step
-                        if cfg.flops_per_token:
-                            from solvingpapers_tpu.metrics.mfu import chip_peak_flops
+                    if step == start_step:
+                        # the compile step is excluded from the timed window;
+                        # report its metrics without timing-derived fields
+                        pass
+                    else:
+                        now = time.perf_counter()
+                        dt = (now - t_prev) / max(step + 1 - last_log_step, 1)
+                        t_prev = now
+                        last_log_step = step + 1
+                        metrics["step_time_s"] = dt
+                        if cfg.tokens_per_step:
+                            metrics["tokens_per_sec"] = cfg.tokens_per_step / dt
+                            metrics["tokens"] = (step + 1) * cfg.tokens_per_step
+                            if cfg.flops_per_token:
+                                from solvingpapers_tpu.metrics.mfu import chip_peak_flops
 
-                            n_chips = self.mesh.devices.size
-                            metrics["mfu"] = (
-                                metrics["tokens_per_sec"] * cfg.flops_per_token
-                                / (chip_peak_flops() * n_chips)
-                            )
+                                n_chips = self.mesh.devices.size
+                                metrics["mfu"] = (
+                                    metrics["tokens_per_sec"] * cfg.flops_per_token
+                                    / (chip_peak_flops() * n_chips)
+                                )
                     writer.write(step + 1, {k: float(v) for k, v in metrics.items()})
 
                 if ckpt is not None:
