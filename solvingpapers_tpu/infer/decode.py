@@ -33,13 +33,17 @@ def generate(
     sampler: Callable = ops.sample_greedy,
     max_len: int | None = None,
     extra_variables: dict | None = None,
+    eos_id: int | None = None,
 ) -> jax.Array:
     """Generate `max_new_tokens` continuations of `prompt` (B, S0) int32.
 
     Returns (B, S0 + max_new_tokens). The whole function is one XLA program:
     a prefill pass filling the caches, then a scan of single-token steps.
     `extra_variables` carries non-param collections (e.g. DeepSeekV3's
-    'moe_state' routing bias).
+    'moe_state' routing bias). `eos_id` gives deepseekv3 cell 40's
+    stop-on-EOS semantics in static-shape form: once a sequence samples
+    EOS, all its later positions are EOS (the scan itself always runs
+    max_new_tokens steps — XLA needs static shapes).
     """
     b, s0 = prompt.shape
     total = s0 + max_new_tokens
@@ -61,11 +65,14 @@ def generate(
     )
     rng, sub = jax.random.split(rng)
     first_tok = sampler(logits[:, -1], sub).astype(prompt.dtype)
+    done0 = (
+        first_tok == eos_id if eos_id is not None else jnp.zeros((b,), jnp.bool_)
+    )
     if max_new_tokens == 1:
         return jnp.concatenate([prompt, first_tok[:, None]], axis=1)
 
     def body(carry, _):
-        tok, pos, caches, rng = carry
+        tok, pos, caches, rng, done = carry
         logits, caches = model.apply(
             variables,
             tok[:, None],
@@ -75,11 +82,14 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         new_tok = sampler(logits[:, -1], sub).astype(tok.dtype)
-        return (new_tok, pos + 1, caches, rng), new_tok
+        if eos_id is not None:
+            new_tok = jnp.where(done, jnp.asarray(eos_id, tok.dtype), new_tok)
+            done = done | (new_tok == eos_id)
+        return (new_tok, pos + 1, caches, rng, done), new_tok
 
     # one forward per emitted token: t0 from prefill, t1..t_{n-1} from the scan
     _, toks = jax.lax.scan(
-        body, (first_tok, jnp.asarray(s0), caches, rng), None,
+        body, (first_tok, jnp.asarray(s0), caches, rng, done0), None,
         length=max_new_tokens - 1,
     )
     generated = jnp.concatenate([first_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)

@@ -57,6 +57,40 @@ def save_reconstruction_grid(
     return path
 
 
+def save_activation_curves(path: str) -> str:
+    """Reference curves for the activation-function family — the plotting
+    capability of activation functions/ReLU.ipynb cells 7-10 and GELU.ipynb,
+    drawn from the shared ops (one implementation, not per-notebook)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import jax.numpy as jnp
+
+    from solvingpapers_tpu import ops
+
+    x = jnp.linspace(-4, 4, 401)
+    curves = [
+        ("relu", ops.relu(x)),
+        ("leaky_relu", ops.leaky_relu(x)),
+        ("prelu(0.25)", ops.prelu(x, 0.25)),
+        ("elu", ops.elu(x)),
+        ("gelu_tanh", ops.gelu_tanh(x)),
+        ("silu/swish", ops.silu(x)),
+    ]
+    fig, axes = plt.subplots(2, 3, figsize=(10, 5.5), sharex=True)
+    for ax, (name, y) in zip(axes.flat, curves):
+        ax.plot(np.asarray(x), np.asarray(y))
+        ax.axhline(0, lw=0.5, color="gray")
+        ax.axvline(0, lw=0.5, color="gray")
+        ax.set_title(name, fontsize=9)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def save_text_sample(text: str, directory: str, step: int) -> str:
     """deepseekv3 cell 51's `generated_{step}.txt` artifact."""
     os.makedirs(directory, exist_ok=True)

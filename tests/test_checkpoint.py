@@ -78,3 +78,54 @@ def test_reconstruction_grid_renders(tmp_path):
     tpath = save_text_sample("hello", str(tmp_path / "arts"), 500)
     assert tpath.endswith("generated_500.txt")
     assert open(tpath).read() == "hello"
+
+
+def test_activation_curves_render(tmp_path):
+    from solvingpapers_tpu.metrics.viz import save_activation_curves
+
+    path = save_activation_curves(str(tmp_path / "act.png"))
+    assert os.path.getsize(path) > 5000
+
+
+def test_grad_accumulation_matches_big_batch():
+    """optax.MultiSteps accumulation: 2 micro-steps of batch 4 must equal
+    one step of batch 8 (the functional replacement for deepseekv3
+    cell 54's accumulate-then-step inner loop)."""
+    import jax.numpy as jnp
+
+    from solvingpapers_tpu.data import load_char_corpus
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+
+    _, toks, _ = load_char_corpus(synthetic_chars=5_000)
+    it = lm_batch_iterator(toks, 8, TINY.block_size, seed=0)
+    big = next(it)
+    micro1 = {k: v[:4] for k, v in big.items()}
+    micro2 = {k: v[4:] for k, v in big.items()}
+
+    mesh = create_mesh(MeshConfig(data=1), jax.devices()[:1])
+
+    def make(accum):
+        # sgd without clipping: the update is linear in the gradient, so
+        # mean-of-micro-grads == big-batch grad exactly (adamw's g/|g|
+        # first step amplifies float summation-order noise unboundedly)
+        cfg = TrainConfig(
+            steps=2, batch_size=8, log_every=1000, eval_every=0,
+            optimizer=OptimizerConfig(name="sgd", max_lr=1e-2, warmup_steps=0,
+                                      total_steps=4, accum_steps=accum,
+                                      grad_clip=0.0, weight_decay=0.0),
+        )
+        return Trainer(GPT(TINY), cfg, mesh=mesh)
+
+    t_big = make(1)
+    s_big = t_big.init_state(big)
+    t_big._build_steps()
+    s_big, _ = t_big._train_step(s_big, big)
+
+    t_acc = make(2)
+    s_acc = t_acc.init_state(micro1)
+    t_acc._build_steps()
+    s_acc, _ = t_acc._train_step(s_acc, micro1)
+    s_acc, _ = t_acc._train_step(s_acc, micro2)
+
+    for a, b in zip(jax.tree.leaves(s_big.params), jax.tree.leaves(s_acc.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -138,3 +138,38 @@ def test_sliding_window_includes_last_start():
     x, y = sliding_window_split(toks, block_size=10, stride=1)
     assert x[-1][0] == 89 and y[-1][-1] == 99
     np.testing.assert_array_equal(y, x + 1)
+
+
+def test_generate_eos_early_stop():
+    """deepseekv3 cell 40's stop-on-EOS, static-shape form: after a
+    sequence samples EOS every later position is EOS."""
+    model = GPT(TINY)
+    rng = jax.random.key(3)
+    prompt = jnp.zeros((2, 3), jnp.int32)
+    params = model.init({"params": rng}, prompt)["params"]
+
+    # immediate EOS: every generated position must be the EOS id
+    always_eos = lambda logits, key: jnp.full(  # noqa: E731
+        (logits.shape[0],), 7, jnp.int32
+    )
+    out = generate(model, params, prompt, rng, max_new_tokens=6,
+                   sampler=always_eos, eos_id=7)
+    np.testing.assert_array_equal(np.asarray(out[:, 3:]), 7)
+
+    # stochastic mid-sequence EOS (seeded -> deterministic): each step emits
+    # EOS with p=0.4, so rows hit EOS mid-sequence; after the first hit the
+    # done-propagation must pin every later position to EOS
+    def sometimes_eos(logits, key):
+        hit = jax.random.bernoulli(key, 0.4, (logits.shape[0],))
+        return jnp.where(hit, 7, jnp.argmax(logits, -1)).astype(jnp.int32)
+
+    out2 = generate(model, params, prompt, rng, max_new_tokens=10,
+                    sampler=sometimes_eos, eos_id=7)
+    gen = np.asarray(out2[:, 3:])
+    mid_hits = 0
+    for row in gen:
+        hits = np.where(row == 7)[0]
+        if hits.size and hits[0] < len(row) - 1:
+            mid_hits += 1
+            assert np.all(row[hits[0]:] == 7), row
+    assert mid_hits > 0, gen  # the property must actually be exercised
