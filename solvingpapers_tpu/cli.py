@@ -73,6 +73,15 @@ def cmd_train(args) -> int:
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
 
+    if getattr(cfg.model, "context_parallel", False):
+        print(
+            "context-parallel configs are shard_map-composed and not driven "
+            "by the stock Trainer yet; see tests/test_ring_attention.py::"
+            "test_llama_context_parallel_training_matches_dense for the "
+            "training-step pattern",
+            file=sys.stderr,
+        )
+        return 2
     mesh = create_mesh(cfg.train.mesh)
     writer = ConsoleWriter()  # fit() gates cadence by log_every
     if args.jsonl:

@@ -5,6 +5,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable
 
+from solvingpapers_tpu.sharding.mesh import MeshConfig
 from solvingpapers_tpu.train.engine import TrainConfig
 from solvingpapers_tpu.train.optim import OptimizerConfig
 
@@ -213,6 +214,42 @@ def _gemma_char() -> RunConfig:
         ),
         data={"kind": "char", "path": None, "block_size": 128},
         notes="gemma.ipynb cells 1, 17-18; 127.5M params, stopped at 3500 steps",
+    )
+
+
+@register("llama3_long")
+def _llama3_long() -> RunConfig:
+    """Long-context capability demo (nothing comparable in the reference —
+    its max context is 256 tokens): llama with context_parallel=True for
+    ring-attention training over a 'context' mesh axis. The model applies
+    inside shard_map with the sequence sharded; see
+    tests/test_ring_attention.py::test_llama_context_parallel_training_matches_dense
+    for the exact usage pattern (the stock Trainer drives the dense/flash
+    paths; CP steps are shard_map-composed)."""
+    from solvingpapers_tpu.models.llama3 import LlamaConfig
+
+    return RunConfig(
+        name="llama3_long",
+        model_family="llama3",
+        model=LlamaConfig(
+            vocab_size=50257, max_seq_len=32_768, dim=1024, n_layers=16,
+            n_heads=16, n_kv_heads=8, dropout=0.0, dtype="bfloat16",
+            context_parallel=True,
+        ),
+        train=TrainConfig(
+            steps=10_000, batch_size=8, log_every=50, eval_every=500,
+            eval_batches=8,
+            mesh=MeshConfig(data=-1, context=4),
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=3e-4, warmup_steps=200, total_steps=10_000,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=8 * 32_768,
+        ),
+        data={"kind": "bpe", "path": None, "block_size": 32_768,
+              "bpe_vocab_size": 32_000},
+        notes="beyond-reference long-context config; sequence sharded over "
+              "the context axis, ring attention over ICI",
     )
 
 

@@ -74,6 +74,13 @@ class Attention(nn.Module):
     # should use kernels.sharded_flash_attention (shard_map-wrapped: batch
     # over data/fsdp, heads over model); the dense path partitions anywhere.
     use_flash: bool = False
+    # context parallelism: "ring" runs ops via sharding.ring_attention_local
+    # and REQUIRES the module to be applied inside a shard_map whose
+    # `context_axis` shards the sequence dimension (positions must be the
+    # global positions of the local shard). Decode caches are unsupported
+    # under ring (prefill/training path only).
+    context_parallel: bool = False
+    context_axis: str = "context"
 
     @nn.compact
     def __call__(
@@ -92,7 +99,14 @@ class Attention(nn.Module):
         )
 
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            if self.context_parallel:
+                # inside shard_map x is the LOCAL sequence shard; default
+                # positions must be global or RoPE would restart at 0 on
+                # every shard while the ring masks by global position
+                start = jax.lax.axis_index(self.context_axis) * s
+                positions = jnp.broadcast_to(start + jnp.arange(s), (b, s))
+            else:
+                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
 
         if n_kv == self.n_heads:
             qkv = dense(3 * self.n_heads * head_dim, "qkv")(x)
@@ -118,6 +132,21 @@ class Attention(nn.Module):
             # (B, 1, S, max_len): query at position p sees kv slots <= p
             mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
             out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
+        elif self.context_parallel:
+            from solvingpapers_tpu.sharding.ring_attention import (
+                ring_attention_local,
+            )
+
+            if self.dropout > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "attention-prob dropout is not implemented under "
+                    "context_parallel (ring) attention; set dropout=0.0"
+                )
+            # GQA kv heads stay un-repeated: the ring repeats them after
+            # each transfer so ppermute carries only n_kv heads
+            out = ring_attention_local(
+                q, k, v, self.context_axis, causal=self.causal
+            )
         else:
             dropout_active = self.dropout > 0.0 and not deterministic
             if self.use_flash:

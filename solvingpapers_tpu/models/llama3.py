@@ -45,6 +45,10 @@ class LlamaConfig:
     dtype: str = "float32"
     use_flash: bool = False
     remat: bool = False  # jax.checkpoint each block: recompute activations in backward
+    # context parallelism: apply the model inside a shard_map whose
+    # 'context' axis shards the sequence; attention runs the ppermute ring
+    # (sharding/ring_attention.py). Pass GLOBAL positions explicitly.
+    context_parallel: bool = False
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -75,6 +79,7 @@ class LlamaBlock(nn.Module):
             use_bias=False,
             dtype=cfg.compute_dtype,
             use_flash=cfg.use_flash,
+            context_parallel=cfg.context_parallel,
             name="attn",
         )(
             RMSNorm(eps=cfg.norm_eps, name="attn_norm")(x),
@@ -110,7 +115,14 @@ class Llama(nn.Module):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            if cfg.context_parallel:
+                # inside shard_map `tokens` is the local sequence shard;
+                # defaults must be GLOBAL positions or RoPE restarts at 0
+                # on every shard while the ring masks globally
+                start = jax.lax.axis_index("context") * s
+                positions = jnp.broadcast_to(start + jnp.arange(s), (b, s))
+            else:
+                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(tokens)
 
         new_caches = [] if caches is not None else None

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from solvingpapers_tpu.ops.attention import BIG_NEG
+from solvingpapers_tpu.ops.attention import BIG_NEG, repeat_kv
 
 
 def ring_attention_local(
@@ -36,11 +36,17 @@ def ring_attention_local(
 ) -> jax.Array:
     """Per-shard ring attention body; call inside shard_map.
 
-    q, k, v: local (B, S_loc, N, H) sequence shards. Returns the local
-    (B, S_loc, N, H) output shard of exact softmax attention over the full
-    sequence.
+    q: local (B, S_loc, N, H) sequence shard; k, v: (B, S_loc, Nkv, H) with
+    N % Nkv == 0 — GQA kv heads are repeated per ring step AFTER the
+    transfer, so ppermute traffic carries only the Nkv heads. Returns the
+    local (B, S_loc, N, H) output shard of exact softmax attention over the
+    full sequence.
     """
     b, s_loc, n, h = q.shape
+    n_kv = k.shape[2]
+    if n % n_kv:
+        raise ValueError(f"q heads {n} not a multiple of kv heads {n_kv}")
+    group = n // n_kv
     if scale is None:
         scale = h**-0.5
     axis_size = jax.lax.psum(1, axis_name)
@@ -55,7 +61,7 @@ def ring_attention_local(
         # ppermute sends to (j+1): after i steps we hold chunk (my_idx - i)
         src = (my_idx - i) % axis_size
         s_ = jnp.einsum(
-            "bqnh,bknh->bnqk", q32, k_cur.astype(jnp.float32)
+            "bqnh,bknh->bnqk", q32, repeat_kv(k_cur, group).astype(jnp.float32)
         )
         if causal:
             k_pos = src * s_loc + jnp.arange(s_loc)
@@ -66,7 +72,7 @@ def ring_attention_local(
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "bnqk,bknh->bqnh", p, v_cur.astype(jnp.float32)
+            "bnqk,bknh->bqnh", p, repeat_kv(v_cur, group).astype(jnp.float32)
         ).transpose(0, 2, 1, 3)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
