@@ -14,6 +14,7 @@ params; reductions inside ops.* are f32.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -74,13 +75,16 @@ class Attention(nn.Module):
     # should use kernels.sharded_flash_attention (shard_map-wrapped: batch
     # over data/fsdp, heads over model); the dense path partitions anywhere.
     use_flash: bool = False
-    # context parallelism: "ring" runs ops via sharding.ring_attention_local
-    # and REQUIRES the module to be applied inside a shard_map whose
-    # `context_axis` shards the sequence dimension (positions must be the
-    # global positions of the local shard). Decode caches are unsupported
-    # under ring (prefill/training path only).
+    # context parallelism: REQUIRES the module to be applied inside a
+    # shard_map whose `context_axis` shards the sequence dimension
+    # (positions must be global — derived from the axis index when None).
+    # context_impl "ring" rotates K/V chunks via ppermute (memory-optimal,
+    # any head count); "ulysses" all_to_alls to head sharding around a dense
+    # core (needs n_heads and n_kv_heads divisible by the axis size). Decode
+    # caches are unsupported under context parallelism (training/prefill).
     context_parallel: bool = False
     context_axis: str = "context"
+    context_impl: str = "ring"  # ring | ulysses
 
     @nn.compact
     def __call__(
@@ -124,6 +128,12 @@ class Attention(nn.Module):
             q = ops.apply_rope(q, cos, sin, positions=positions)
             k = ops.apply_rope(k, cos, sin, positions=positions)
 
+        if cache is not None and self.context_parallel:
+            raise NotImplementedError(
+                "KV caches are unsupported under context parallelism: a "
+                "per-shard cache would silently attend only local slots. "
+                "Decode with a non-CP model config."
+            )
         if cache is not None:
             # single contiguous segment per step: write at the first position
             cache = update_kv_cache(cache, k, v, positions[0, 0])
@@ -135,18 +145,34 @@ class Attention(nn.Module):
         elif self.context_parallel:
             from solvingpapers_tpu.sharding.ring_attention import (
                 ring_attention_local,
+                ulysses_attention_local,
             )
 
             if self.dropout > 0.0 and not deterministic:
                 raise NotImplementedError(
                     "attention-prob dropout is not implemented under "
-                    "context_parallel (ring) attention; set dropout=0.0"
+                    "context_parallel attention; set dropout=0.0"
                 )
-            # GQA kv heads stay un-repeated: the ring repeats them after
-            # each transfer so ppermute carries only n_kv heads
-            out = ring_attention_local(
-                q, k, v, self.context_axis, causal=self.causal
-            )
+            if self.context_impl == "ring":
+                # GQA kv heads stay un-repeated: the ring repeats them after
+                # each transfer so ppermute carries only n_kv heads
+                out = ring_attention_local(
+                    q, k, v, self.context_axis, causal=self.causal
+                )
+            elif self.context_impl == "ulysses":
+                if self.use_flash:
+                    from solvingpapers_tpu.kernels import flash_attention
+
+                    core = functools.partial(
+                        flash_attention, causal=self.causal
+                    )
+                else:
+                    core = functools.partial(
+                        ops.dot_product_attention, causal=self.causal
+                    )
+                out = ulysses_attention_local(q, k, v, self.context_axis, core)
+            else:
+                raise ValueError(f"unknown context_impl {self.context_impl!r}")
         else:
             dropout_active = self.dropout > 0.0 and not deterministic
             if self.use_flash:

@@ -47,8 +47,10 @@ class LlamaConfig:
     remat: bool = False  # jax.checkpoint each block: recompute activations in backward
     # context parallelism: apply the model inside a shard_map whose
     # 'context' axis shards the sequence; attention runs the ppermute ring
-    # (sharding/ring_attention.py). Pass GLOBAL positions explicitly.
+    # or Ulysses all_to_all (sharding/ring_attention.py). Positions default
+    # to global (derived from the axis index).
     context_parallel: bool = False
+    context_impl: str = "ring"  # ring | ulysses
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -80,6 +82,7 @@ class LlamaBlock(nn.Module):
             dtype=cfg.compute_dtype,
             use_flash=cfg.use_flash,
             context_parallel=cfg.context_parallel,
+            context_impl=cfg.context_impl,
             name="attn",
         )(
             RMSNorm(eps=cfg.norm_eps, name="attn_norm")(x),

@@ -128,10 +128,11 @@ def ulysses_attention_local(
     works (dense, flash kernel).
     """
     axis_size = jax.lax.psum(1, axis_name)
-    if q.shape[2] % axis_size:
+    if q.shape[2] % axis_size or k.shape[2] % axis_size:
         raise ValueError(
-            f"Ulysses needs heads ({q.shape[2]}) divisible by the "
-            f"'{axis_name}' axis size ({axis_size})"
+            f"Ulysses needs q heads ({q.shape[2]}) and kv heads "
+            f"({k.shape[2]}) divisible by the '{axis_name}' axis size "
+            f"({axis_size})"
         )
     # split heads across devices, gather sequence: (B, S, N/axis, H)
     q_g = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)

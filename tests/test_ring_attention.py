@@ -141,21 +141,25 @@ def test_ring_gqa_repeats_inside_ring(devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
-def test_cp_llama_default_positions_are_global(devices):
-    """With positions=None the CP model must derive GLOBAL positions from
-    the context axis index (local arange would silently break RoPE)."""
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_cp_llama_forward_matches_dense(devices, impl):
+    """CP model forward (positions defaulted -> must derive GLOBAL positions
+    from the axis index) == dense, for both context impls, with GQA heads
+    (8 q / 4 kv over a 4-way axis exercises the head-split + repeat_kv
+    composition)."""
     import dataclasses
 
     from jax.sharding import PartitionSpec as P
 
     from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
 
-    base = LlamaConfig(vocab_size=64, max_seq_len=32, dim=16, n_layers=1,
-                       n_heads=2, n_kv_heads=2, dropout=0.0)
-    cp = Llama(dataclasses.replace(base, context_parallel=True))
+    base = LlamaConfig(vocab_size=64, max_seq_len=32, dim=32, n_layers=1,
+                       n_heads=8, n_kv_heads=4, dropout=0.0)
+    cp = Llama(dataclasses.replace(base, context_parallel=True,
+                                   context_impl=impl))
     dense = Llama(base)
-    mesh = create_mesh(MeshConfig(data=1, context=4), devices[:4])
-    toks = jax.random.randint(jax.random.key(2), (1, 32), 0, 64)
+    mesh = create_mesh(MeshConfig(data=2, context=4), devices)
+    toks = jax.random.randint(jax.random.key(2), (2, 32), 0, 64)
     params = dense.init({"params": jax.random.key(3)}, toks)["params"]
     out = jax.shard_map(
         lambda p, x: cp.apply({"params": p}, x)[0],
@@ -164,3 +168,30 @@ def test_cp_llama_default_positions_are_global(devices):
     )(params, toks)
     ref, _ = dense.apply({"params": params}, toks)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_cp_model_rejects_decode_cache(devices):
+    import dataclasses
+
+    from jax.sharding import PartitionSpec as P
+
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=64, max_seq_len=32, dim=16, n_layers=1,
+                      n_heads=2, n_kv_heads=2, dropout=0.0,
+                      context_parallel=True)
+    model = Llama(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    mesh = create_mesh(MeshConfig(data=1, context=4), devices[:4])
+
+    def run(p, x):
+        caches = model.init_caches(1, 32)
+        out, _ = model.apply({"params": p}, x, caches=caches)
+        return out
+
+    base = Llama(dataclasses.replace(cfg, context_parallel=False))
+    params = base.init({"params": jax.random.key(0)}, toks)["params"]
+    with pytest.raises(NotImplementedError, match="unsupported under context"):
+        jax.shard_map(run, mesh=mesh,
+                      in_specs=(P(), P(("data",), "context")),
+                      out_specs=P(("data",), "context", None))(params, toks)
