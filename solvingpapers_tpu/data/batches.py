@@ -51,15 +51,28 @@ def lm_batch_iterator(
             f"block_size {block_size} (need >= block_size + 2)"
         )
     if isinstance(tokens, np.memmap):
+        from solvingpapers_tpu import native
+
         rng = np.random.default_rng(seed)
         max_start = len(tokens) - block_size - 1
-        dtype = np.int32
+        use_native = (
+            native.available()
+            and np.dtype(tokens.dtype) in native._DTYPE_CODES
+            and tokens.flags["C_CONTIGUOUS"]
+        )
         while True:
             starts = rng.integers(0, max_start, size=batch_size)
-            x = np.stack([tokens[s : s + block_size] for s in starts]).astype(dtype)
-            y = np.stack(
-                [tokens[s + 1 : s + block_size + 1] for s in starts]
-            ).astype(dtype)
+            if use_native:
+                # parallel C++ gather+widen (GIL released -> overlaps the
+                # device step when wrapped in prefetch_batches)
+                x, y = native.gather_windows_native(tokens, starts, block_size)
+            else:
+                x = np.stack(
+                    [tokens[s : s + block_size] for s in starts]
+                ).astype(np.int32)
+                y = np.stack(
+                    [tokens[s + 1 : s + block_size + 1] for s in starts]
+                ).astype(np.int32)
             batch = {"x": x, "y": y}
             if sharding is not None:
                 batch = jax.device_put(batch, sharding)
@@ -76,6 +89,53 @@ def lm_batch_iterator(
             batch = jax.device_put(batch, sharding)
         yield batch
         i += 1
+
+
+def prefetch_batches(iterator, depth: int = 2):
+    """Run `iterator` in a background thread, keeping up to `depth` batches
+    ready — the TPU-native stand-in for the reference's 2-worker pinned
+    DataLoaders (deepseekv3.ipynb cell 14). Host-side gathers (the memmap
+    branch above, with its GIL-releasing native path) overlap the device
+    step. Order is preserved, so determinism in `seed` is unchanged.
+    """
+    import queue
+    import threading
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for batch in iterator:
+                if not put(batch):
+                    return
+        except BaseException as e:  # surfaced to the consumer, not swallowed
+            put(e)
+            return
+        put(_END)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            batch = q.get()
+            if batch is _END:
+                return
+            if isinstance(batch, BaseException):
+                raise batch
+            yield batch
+    finally:
+        stop.set()
 
 
 def sliding_window_split(

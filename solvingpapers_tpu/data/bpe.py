@@ -62,12 +62,45 @@ def _get_pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
     return set(zip(word[:-1], word[1:]))
 
 
+def _best_pair(pairs: Counter, vocab: dict[str, int]):
+    """Canonical best pair: max count, tie-break smallest (left, right) id."""
+    best = max(
+        pairs.items(),
+        key=lambda kv: (kv[1], -vocab[kv[0][0]], -vocab[kv[0][1]]),
+    )
+    return best[0], best[1]
+
+
 class ByteBPETokenizer:
     def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]]):
         self.vocab = vocab
         self.inv_vocab = {v: k for k, v in vocab.items()}
         self.ranks = {pair: i for i, pair in enumerate(merges)}
         self._cache: dict[str, list[str]] = {}
+        self._native = None       # lazily-built native encoder (or False)
+
+    def _native_encoder(self):
+        """Native merge-loop encoder in vocab-id space, if buildable."""
+        if self._native is None:
+            try:
+                from solvingpapers_tpu import native
+
+                if not native.available():
+                    raise RuntimeError(native.load_error() or "unavailable")
+                byte_to_id = np.asarray(
+                    [self.vocab[_BYTE_ENC[b]] for b in range(256)], np.int32
+                )
+                merges = np.asarray(
+                    [
+                        (self.vocab[a], self.vocab[b], self.vocab[a + b])
+                        for (a, b) in sorted(self.ranks, key=self.ranks.get)
+                    ],
+                    np.int32,
+                ).reshape(-1, 3)
+                self._native = native.NativeBpeEncoder(byte_to_id, merges)
+            except (RuntimeError, KeyError, OSError):
+                self._native = False
+        return self._native or None
 
     @property
     def vocab_size(self) -> int:
@@ -96,7 +129,14 @@ class ByteBPETokenizer:
     def train(
         cls, text: str, vocab_size: int, *, min_pair_count: int = 2
     ) -> "ByteBPETokenizer":
-        """Learn merges from `text` until `vocab_size` (>= 256) is reached."""
+        """Learn merges from `text` until `vocab_size` (>= 256) is reached.
+
+        Best pair per round: max count, tie-break smallest (left_id,
+        right_id) — the canonical order shared with the native trainer, so
+        both produce identical tables. The native incremental trainer
+        (native/_src/native.cpp) is used when available; this Python loop
+        is the fallback and the parity oracle.
+        """
         if vocab_size < 256:
             raise ValueError("byte-level BPE needs vocab_size >= 256")
         # word frequency over pre-tokenized chunks, as byte-unicode symbols
@@ -105,6 +145,9 @@ class ByteBPETokenizer:
             for tok in _GPT2_SPLIT.findall(text)
         )
         vocab = {c: i for i, c in enumerate(_BYTE_ENC[b] for b in range(256))}
+        native_tok = cls._train_native(words, vocab, vocab_size, min_pair_count)
+        if native_tok is not None:
+            return native_tok
         merges: list[tuple[str, str]] = []
         while len(vocab) < vocab_size:
             pairs: Counter = Counter()
@@ -113,7 +156,7 @@ class ByteBPETokenizer:
                     pairs[pair] += freq
             if not pairs:
                 break
-            best, count = pairs.most_common(1)[0]
+            best, count = _best_pair(pairs, vocab)
             if count < min_pair_count:
                 break
             merges.append(best)
@@ -138,6 +181,39 @@ class ByteBPETokenizer:
             words = Counter(
                 {apply(w): f for w, f in words.items()}
             )
+        return cls(vocab, merges)
+
+    @classmethod
+    def _train_native(cls, words: Counter, base_vocab: dict[str, int],
+                      vocab_size: int, min_pair_count: int):
+        """Run the C++ incremental trainer; None if unavailable. Byte
+        symbols map to ids 0..255 (base_vocab's assignment) and merge i
+        creates id 256+i, matching the Python loop exactly."""
+        try:
+            from solvingpapers_tpu import native
+
+            if not native.available():
+                return None
+        except ImportError:  # pragma: no cover
+            return None
+        items = list(words.items())
+        flat, offsets, freqs = [], [0], []
+        for word, freq in items:
+            flat.extend(_BYTE_DEC[c] for c in word)
+            offsets.append(len(flat))
+            freqs.append(freq)
+        pairs = native.bpe_train_native(
+            np.asarray(flat, np.int32), np.asarray(offsets, np.int64),
+            np.asarray(freqs, np.int64), vocab_size - 256, min_pair_count,
+        )
+        syms = [_BYTE_ENC[b] for b in range(256)]
+        vocab = dict(base_vocab)
+        merges: list[tuple[str, str]] = []
+        for left, right in pairs:
+            a, b = syms[int(left)], syms[int(right)]
+            merges.append((a, b))
+            syms.append(a + b)
+            vocab[a + b] = len(vocab)
         return cls(vocab, merges)
 
     def save(self, vocab_path: str, merges_path: str) -> None:
@@ -173,8 +249,12 @@ class ByteBPETokenizer:
         return result
 
     def encode(self, text: str) -> np.ndarray:
+        chunks = _GPT2_SPLIT.findall(text)
+        enc = self._native_encoder()
+        if enc is not None:
+            return enc.encode_texts(chunks)
         ids: list[int] = []
-        for tok in _GPT2_SPLIT.findall(text):
+        for tok in chunks:
             symbols = "".join(_BYTE_ENC[b] for b in tok.encode("utf-8"))
             ids.extend(self.vocab[s] for s in self._bpe(symbols))
         return np.asarray(ids, dtype=np.int32)

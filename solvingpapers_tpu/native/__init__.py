@@ -1,0 +1,256 @@
+"""Native (C++) runtime hot paths, bound via ctypes.
+
+The compute path of the framework is JAX/XLA/Pallas; this package covers
+the host-side runtime around it — tokenization and the batch gather that
+feeds the device — as compiled code, the way the reference relies on HF's
+native tokenizers (deepseekv3.ipynb cell 6) and pinned DataLoader workers
+(cells 12-14).
+
+The shared library is built on demand from `_src/native.cpp` with g++
+(no pybind11 in this environment; plain C ABI + ctypes). Every consumer
+has a pure-Python fallback, so `available() == False` (no compiler, build
+failure) degrades gracefully and is exercised in CI via
+SOLVINGPAPERS_TPU_NO_NATIVE=1.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "_src", "native.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_src", "_native.so")
+_lock = threading.Lock()
+_lib = None
+_load_error: str | None = None
+
+_DTYPE_CODES = {
+    np.dtype(np.uint16): 0,
+    np.dtype(np.uint32): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int64): 4,
+}
+
+
+def _build() -> str:
+    """Compile _src/native.cpp -> _native.so if missing or stale."""
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    tmp = _LIB_PATH + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", tmp, _SRC,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _LIB_PATH)  # atomic under concurrent builders
+    return _LIB_PATH
+
+
+def _load():
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_error is not None:
+            return _lib
+        if os.environ.get("SOLVINGPAPERS_TPU_NO_NATIVE"):
+            _load_error = "disabled via SOLVINGPAPERS_TPU_NO_NATIVE"
+            return None
+        try:
+            lib = ctypes.CDLL(_build())
+        except (OSError, subprocess.CalledProcessError) as e:
+            _load_error = (
+                e.stderr if isinstance(e, subprocess.CalledProcessError) else str(e)
+            )
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.bpe_ctx_new.restype = ctypes.c_void_p
+        lib.bpe_ctx_new.argtypes = [i32p, i32p, i32p, i32p, ctypes.c_int64]
+        lib.bpe_ctx_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_encode.restype = ctypes.c_int64
+        lib.bpe_encode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), i64p,
+            ctypes.c_int64, i32p, ctypes.c_int64, i32p, ctypes.c_int32,
+        ]
+        lib.bpe_train.restype = ctypes.c_int64
+        lib.bpe_train.argtypes = [
+            i32p, i64p, i64p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, i32p, i32p,
+        ]
+        lib.gather_windows.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, i64p, ctypes.c_int64,
+            ctypes.c_int64, i32p, i32p, ctypes.c_int32,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is (or can be) loaded."""
+    return _load() is not None
+
+
+def load_error() -> str | None:
+    """Why the native library is unavailable (None if it loaded)."""
+    _load()
+    return _load_error
+
+
+def _as_i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeBpeEncoder:
+    """Merge-loop encoder over a fixed merge table (ids, not strings).
+
+    byte_to_id: (256,) initial symbol id per byte; merges: (n, 3) array of
+    (left_id, right_id, merged_id) in rank order.
+    """
+
+    def __init__(self, byte_to_id, merges):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native library unavailable: {_load_error}")
+        self._lib = lib
+        merges = np.asarray(merges, dtype=np.int32).reshape(-1, 3)
+        b2i = _as_i32(byte_to_id)
+        if b2i.shape != (256,):
+            raise ValueError("byte_to_id must have shape (256,)")
+        lefts = np.ascontiguousarray(merges[:, 0])
+        rights = np.ascontiguousarray(merges[:, 1])
+        merged = np.ascontiguousarray(merges[:, 2])
+        self._ctx = lib.bpe_ctx_new(
+            _ptr(b2i, ctypes.c_int32), _ptr(lefts, ctypes.c_int32),
+            _ptr(rights, ctypes.c_int32), _ptr(merged, ctypes.c_int32),
+            len(merges),
+        )
+        self._chunk_cache: dict[str, np.ndarray] = {}
+        self._cache_limit = 1_000_000
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx:
+            self._lib.bpe_ctx_free(ctx)
+            self._ctx = None
+
+    def encode_chunks(self, data: bytes, offsets: np.ndarray,
+                      n_threads: int | None = None,
+                      counts_out: np.ndarray | None = None) -> np.ndarray:
+        """Encode chunks data[offsets[i]:offsets[i+1]] -> flat int32 ids.
+        If counts_out (int32, n_chunks) is given it receives per-chunk
+        token counts."""
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        n_chunks = len(offsets) - 1
+        if n_chunks <= 0:
+            return np.empty(0, np.int32)
+        if n_threads is None:
+            n_threads = min(os.cpu_count() or 1, 16)
+        buf = np.frombuffer(data, dtype=np.uint8)
+        cap = max(int(offsets[-1]), 16)
+        out = np.empty(cap, np.int32)
+        counts_ptr = (
+            _ptr(counts_out, ctypes.c_int32) if counts_out is not None else None
+        )
+        n = self._lib.bpe_encode(
+            self._ctx, _ptr(buf, ctypes.c_uint8), _ptr(offsets, ctypes.c_int64),
+            n_chunks, _ptr(out, ctypes.c_int32), cap, counts_ptr, n_threads,
+        )
+        if n < 0:  # pragma: no cover - cap == total bytes always suffices
+            out = np.empty(-n, np.int32)
+            n = self._lib.bpe_encode(
+                self._ctx, _ptr(buf, ctypes.c_uint8),
+                _ptr(offsets, ctypes.c_int64), n_chunks,
+                _ptr(out, ctypes.c_int32), -n, counts_ptr, n_threads,
+            )
+        return out[:n].copy()
+
+    def encode_texts(self, chunks: list[str]) -> np.ndarray:
+        """Encode pre-split text chunks with per-unique-chunk caching (the
+        native analogue of ByteBPETokenizer._bpe's memo): only novel chunks
+        hit the C++ merge loop; repeats are concatenated from the cache."""
+        cache = self._chunk_cache
+        novel = [c for c in dict.fromkeys(chunks) if c not in cache]
+        if novel:
+            raw = [c.encode("utf-8") for c in novel]
+            offsets = np.zeros(len(raw) + 1, np.int64)
+            np.cumsum([len(r) for r in raw], out=offsets[1:])
+            counts = np.empty(len(raw), np.int32)
+            flat = self.encode_chunks(b"".join(raw), offsets, counts_out=counts)
+            bounds = np.zeros(len(raw) + 1, np.int64)
+            np.cumsum(counts, out=bounds[1:])
+            for i, c in enumerate(novel):
+                cache[c] = flat[bounds[i] : bounds[i + 1]]
+            if len(cache) > self._cache_limit:  # unbounded growth guard
+                cache.clear()
+                for i, c in enumerate(novel):
+                    cache[c] = flat[bounds[i] : bounds[i + 1]]
+        if not chunks:
+            return np.empty(0, np.int32)
+        return np.concatenate([cache[c] for c in chunks])
+
+
+def bpe_train_native(
+    words_flat, offsets, freqs, n_merges_target: int, min_pair_count: int = 2
+) -> np.ndarray:
+    """Run the incremental BPE trainer; returns (n, 2) (left_id, right_id)
+    merges in rank order, merged ids being 256+rank."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    words_flat = _as_i32(words_flat)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    freqs = np.ascontiguousarray(freqs, dtype=np.int64)
+    n_words = len(offsets) - 1
+    out_l = np.empty(max(n_merges_target, 1), np.int32)
+    out_r = np.empty(max(n_merges_target, 1), np.int32)
+    n = lib.bpe_train(
+        _ptr(words_flat, ctypes.c_int32), _ptr(offsets, ctypes.c_int64),
+        _ptr(freqs, ctypes.c_int64), n_words, n_merges_target,
+        min_pair_count, _ptr(out_l, ctypes.c_int32),
+        _ptr(out_r, ctypes.c_int32),
+    )
+    return np.stack([out_l[:n], out_r[:n]], axis=1)
+
+
+def gather_windows_native(
+    tokens: np.ndarray, starts: np.ndarray, block_size: int,
+    x_out: np.ndarray | None = None, y_out: np.ndarray | None = None,
+    n_threads: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """(x, y) int32 windows of `tokens` at `starts` — the native equivalent
+    of the memmap branch in data.batches.lm_batch_iterator."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_load_error}")
+    code = _DTYPE_CODES.get(np.dtype(tokens.dtype))
+    if code is None:
+        raise ValueError(f"unsupported token dtype {tokens.dtype}")
+    if not tokens.flags["C_CONTIGUOUS"]:
+        raise ValueError(
+            "gather_windows_native needs a C-contiguous token array "
+            "(a strided view's base pointer would be misread)"
+        )
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    batch = len(starts)
+    if x_out is None:
+        x_out = np.empty((batch, block_size), np.int32)
+    if y_out is None:
+        y_out = np.empty((batch, block_size), np.int32)
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 8)
+    lib.gather_windows(
+        ctypes.c_void_p(tokens.ctypes.data), code,
+        _ptr(starts, ctypes.c_int64), batch, block_size,
+        _ptr(x_out, ctypes.c_int32), _ptr(y_out, ctypes.c_int32), n_threads,
+    )
+    return x_out, y_out
