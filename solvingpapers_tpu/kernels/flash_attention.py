@@ -34,7 +34,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 BIG_NEG = -2.0**30
-DEFAULT_BLOCK = 128
+# 512 measured best on v5e for the 350M study (tools/scale_350m.py sweep:
+# 128->35.9% MFU, 256->48.2%, 512->52.2%, 1024 q-blocks regress); _pick_block
+# still shrinks to fit shorter sequences.
+DEFAULT_BLOCK = 512
 
 
 def _dropout_keep(shape, seed_val, block_uid, rate):
