@@ -20,8 +20,14 @@ Numerics reference: ops.dot_product_attention (tests/test_flash_attention.py
 asserts forward and gradient equality in interpret mode).
 
 Layout: public API is BSNH (batch, seq, heads, head_dim) to match ops/;
-kernels run on (batch*heads, seq, head_dim) with seq tiled by 128-aligned
-blocks for the MXU.
+kernels run on (batch*heads, seq, head_dim). The grid is 3-D — (batch*heads,
+q-blocks, kv-blocks) with the kv axis 'arbitrary' (sequential) and the
+online-softmax state carried in VMEM scratch — so VMEM holds only
+O(block_q x block_k) tiles regardless of sequence length. (The earlier 2-D
+formulation kept full-length K/V rows in VMEM and hit the 16 MB scoped-vmem
+ceiling at seq 16k; this one trains 350M at 16k on a single v5e chip —
+32k+ is HBM-bound there and is the job of context parallelism, see
+BENCHMARKS.md.)
 """
 
 from __future__ import annotations
@@ -38,6 +44,10 @@ BIG_NEG = -2.0**30
 # 128->35.9% MFU, 256->48.2%, 512->52.2%, 1024 q-blocks regress); _pick_block
 # still shrinks to fit shorter sequences.
 DEFAULT_BLOCK = 512
+
+_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "arbitrary")
+)
 
 
 def _dropout_keep(shape, seed_val, block_uid, rate):
@@ -60,34 +70,50 @@ def _pick_block(seq: int, requested: int) -> int:
     return max(block, 1)
 
 
+def _uid(i, j, kb, num_j, num_kb):
+    """Flat (q-block, kv-block) id shared by fwd and both bwd kernels so
+    dropout masks regenerate identically: (i*num_j + j)*num_kb + kb."""
+    return (i * num_j + j) * num_kb + kb
+
+
+def _live(jb, kb, block_q, block_k, offset, causal):
+    """Whether q-block jb sees any of kv-block kb under the causal mask —
+    one definition shared by fwd/dq/dkv so they can never disagree about
+    which blocks contribute (the dropout-uid lesson, applied to liveness)."""
+    if not causal:
+        return True
+    return kb * block_k <= (jb + 1) * block_q - 1 + offset
+
+
 # --------------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *, scale,
-                causal, block_k, offset, dropout_rate, num_kb_total):
-    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D). `offset` end-aligns the
-    # causal mask when seq_q != seq_k (ops.attention.causal_mask semantics:
-    # query i attends to kv positions <= i + (seq_k - seq_q)).
+def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, offset,
+                dropout_rate, num_qb, num_kb):
+    # q_ref: (1, block_q, D) resident across the kv sweep; k_ref/v_ref:
+    # (1, block_k, D) for this kv step. `offset` end-aligns the causal mask
+    # when seq_q != seq_k (ops.attention.causal_mask semantics: query i
+    # attends to kv positions <= i + (seq_k - seq_q)).
     block_q = q_ref.shape[1]
-    seq_k = k_ref.shape[1]
-    d = q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    i = pl.program_id(0)
     j = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    q = q_ref[0, :, :].astype(jnp.float32) * scale
-    num_kb = seq_k // block_k
-    if causal:
-        hi = jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q + offset, block_k))
-    else:
-        hi = num_kb
-    # loop-invariant; also, pl.program_id inside a fori_loop body does not
-    # lower in interpret mode
-    prog_i = pl.program_id(0)
-    num_j = pl.num_programs(1)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, BIG_NEG, m_scr.dtype)
+        l_scr[...] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
 
-    def body(kb, carry):
-        m_i, l_i, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    live = _live(j, kb, block_q, block_k, offset, causal)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, :].astype(jnp.float32) * scale
+        k_blk = k_ref[0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -100,6 +126,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *, scale,
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(cols <= rows + offset, s, BIG_NEG)
+        m_i, l_i, acc = m_scr[...], l_scr[...], acc_scr[...]
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_i - m_new)
@@ -107,24 +134,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref, *, scale,
         # dropout applies to the normalized probs, i.e. to acc only
         l_new = alpha * l_i + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
-            uid = (prog_i * num_j + j) * num_kb_total + kb
-            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            keep = _dropout_keep(
+                p.shape, seed_ref[0], _uid(i, j, kb, num_qb, num_kb),
+                dropout_rate,
+            )
             p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         else:
             p_use = p
-        acc = acc * alpha + jax.lax.dot_general(
+        acc_scr[...] = acc * alpha + jax.lax.dot_general(
             p_use, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
-    m0 = jnp.full((block_q, 1), BIG_NEG, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m_i, l_i, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-
-    o_ref[0, :, :] = (acc / l_i).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m_i + jnp.log(l_i))[:, 0]
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        l_i = l_scr[...]
+        o_ref[0, :, :] = (acc_scr[...] / l_i).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_scr[...] + jnp.log(l_i))[:, 0]
 
 
 def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
@@ -133,35 +161,38 @@ def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
     bn, seq_q, d = q3.shape
     seq_k = k3.shape[1]
     group = n_heads // n_kv
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
 
-    def kv_index(i, j):
-        # flattened q index i = b*n_heads + h -> kv index b*n_kv + h//group,
-        # which is exactly i // group since group divides n_heads
-        return i // group
-
-    grid = (bn, seq_q // block_q)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        offset=seq_k - seq_q, dropout_rate=dropout_rate,
-        num_kb_total=seq_k // block_k,
+        _fwd_kernel, scale=scale, causal=causal, offset=seq_k - seq_q,
+        dropout_rate=dropout_rate, num_qb=num_qb, num_kb=num_kb,
     )
     return pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(bn, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda i, j: (kv_index(i, j), 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            # flattened q index i = b*n_heads + h -> kv index b*n_kv +
+            # h//group, which is exactly i // group since group | n_heads
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i // group, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i // group, kb, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bn, seq_q, d), q3.dtype),
             jax.ShapeDtypeStruct((bn, 1, seq_q), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q3, k3, v3, seed)
 
@@ -170,35 +201,33 @@ def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-                   dq_ref, *, scale, causal, block_k, offset, dropout_rate,
-                   num_kb_total):
+                   dq_ref, dq_scr, *, scale, causal, offset, dropout_rate,
+                   num_qb, num_kb):
     block_q = q_ref.shape[1]
-    seq_k = k_ref.shape[1]
+    block_k = k_ref.shape[1]
+    i = pl.program_id(0)
     j = pl.program_id(1)
+    kb = pl.program_id(2)
 
-    q = q_ref[0, :, :].astype(jnp.float32) * scale
-    do = do_ref[0, :, :].astype(jnp.float32)
-    lse = lse_ref[0, 0, :][:, None]
-    delta = delta_ref[0, 0, :][:, None]
-    num_kb = seq_k // block_k
-    hi = (
-        jnp.minimum(num_kb, pl.cdiv((j + 1) * block_q + offset, block_k))
-        if causal
-        else num_kb
-    )
-    prog_i = pl.program_id(0)
-    num_j = pl.num_programs(1)
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
 
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    live = _live(j, kb, block_q, block_k, offset, causal)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, :].astype(jnp.float32) * scale
+        do = do_ref[0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        k_blk = k_ref[0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
-            rows = j * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0
-            )
+            rows = j * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         p = jnp.exp(s - lse)
@@ -206,41 +235,46 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if dropout_rate > 0.0:
-            uid = (prog_i * num_j + j) * num_kb_total + kb
-            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            keep = _dropout_keep(
+                p.shape, seed_ref[0], _uid(i, j, kb, num_qb, num_kb),
+                dropout_rate,
+            )
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_scr[...] += jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    dq = jax.lax.fori_loop(
-        0, hi, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    )
-    dq_ref[0, :, :] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        dq_ref[0, :, :] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, offset,
-                    dropout_rate, num_kb_total):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal, offset,
+                    dropout_rate, num_qb, num_kb):
+    # grid is (bn, kv-blocks, q-blocks): the q axis is the sequential carry
+    block_q = q_ref.shape[1]
     block_k = k_ref.shape[1]
-    seq_q = q_ref.shape[1]
+    i = pl.program_id(0)
     kb = pl.program_id(1)
-    d = q_ref.shape[2]
+    jb = pl.program_id(2)
 
-    k_blk = k_ref[0, :, :].astype(jnp.float32)
-    v_blk = v_ref[0, :, :].astype(jnp.float32)
-    num_qb = seq_q // block_q
-    prog_i = pl.program_id(0)
-    # first q block whose last row (jb+1)*bq - 1 + offset can reach col kb*bk
-    lo = jnp.maximum(kb * block_k - offset, 0) // block_q if causal else 0
+    @pl.when(jb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
 
-    def body(jb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(jb * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(jb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(jb * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(jb * block_q, block_q)][:, None]
+    live = _live(jb, kb, block_q, block_k, offset, causal)
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0, :, :].astype(jnp.float32) * scale
+        do = do_ref[0, :, :].astype(jnp.float32)
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
+        k_blk = k_ref[0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, :, :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -253,27 +287,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if dropout_rate > 0.0:
-            uid = (prog_i * num_qb + jb) * num_kb_total + kb
-            keep = _dropout_keep(p.shape, seed_ref[0], uid, dropout_rate)
+            keep = _dropout_keep(
+                p.shape, seed_ref[0], _uid(i, jb, kb, num_qb, num_kb),
+                dropout_rate,
+            )
             p_v = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         else:
             p_v = p
-        dv = dv + jax.lax.dot_general(
+        dv_scr[...] += jax.lax.dot_general(
             p_v, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        dk = dk + jax.lax.dot_general(
+        # q was pre-scaled, so ds^T @ q_scaled already carries softmax scale
+        dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk, dv
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lo, num_qb, body, (dk0, dv0))
-    # q was pre-scaled, so ds^T @ q_scaled already carries the softmax scale
-    dk_ref[0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, :, :] = dv.astype(dv_ref.dtype)
+    @pl.when(jb == num_qb - 1)
+    def _finish():
+        dk_ref[0, :, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, :, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ------------------------------------------------------------------ public API
@@ -303,6 +337,8 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
     bn, seq_q, d = q3.shape
     seq_k = k3.shape[1]
     group = n_heads // n_kv
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
 
     if group > 1:  # materialize repeated kv for the backward pass
         bkv = k3.shape[0]
@@ -317,47 +353,52 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k, offset=seq_k - seq_q,
-                          dropout_rate=dropout_rate,
-                          num_kb_total=seq_k // block_k),
-        grid=(bn, seq_q // block_q),
+                          offset=seq_k - seq_q, dropout_rate=dropout_rate,
+                          num_qb=num_qb, num_kb=num_kb),
+        grid=(bn, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
-            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q3, k3r, v3r, do, lse, delta, seed)
 
     dk_r, dv_r = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, offset=seq_k - seq_q,
-                          dropout_rate=dropout_rate,
-                          num_kb_total=seq_k // block_k),
-        grid=(bn, seq_k // block_k),
+                          offset=seq_k - seq_q, dropout_rate=dropout_rate,
+                          num_qb=num_qb, num_kb=num_kb),
+        grid=(bn, num_kb, num_qb),
         in_specs=[
-            pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, kb, jb: (i, jb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, jb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, jb: (i, kb, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, kb, jb: (i, jb, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, kb, jb: (i, 0, jb)),
+            pl.BlockSpec((1, 1, block_q), lambda i, kb, jb: (i, 0, jb)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, jb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, kb, jb: (i, kb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bn, seq_k, d), k3.dtype),
             jax.ShapeDtypeStruct((bn, seq_k, d), v3.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q3, k3r, v3r, do, lse, delta, seed)
 

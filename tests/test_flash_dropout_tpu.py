@@ -87,7 +87,69 @@ class TestInKernelDropout:
         gv = jax.grad(loss)(self.v)
         lhs = float(loss(self.v + u) - loss(self.v))
         rhs = float(jnp.sum(u * gv))
-        np.testing.assert_allclose(lhs, rhs, rtol=5e-3)
+        # bf16-MXU rounding noise; exact-mask grad equality is covered by
+        # test_grads_match_dense_replica_with_extracted_mask
+        np.testing.assert_allclose(lhs, rhs, rtol=2e-2)
+
+    def test_grads_match_dense_replica_with_extracted_mask(self):
+        """Strongest dropout-grad check: extract the kernel's actual keep
+        mask (PRNG bits are reproducible across kernels — verified
+        empirically), rebuild the identical dropped-attention function in
+        dense JAX, and compare autodiff grads. Catches any fwd/bwd mask or
+        formula inconsistency without finite-difference noise (fd at bf16
+        MXU precision is unreliable: input quantization swamps eps-scale
+        perturbations)."""
+        from jax.experimental import pallas as pl
+
+        from solvingpapers_tpu.kernels.flash_attention import _dropout_keep
+
+        S, D, rate, seed = 256, 32, 0.3, 11
+        bq = bk = 128  # 2x2 blocks exercises the uid indexing across blocks
+
+        def mask_kernel(o_ref):
+            for j in range(S // bq):
+                for kb in range(S // bk):
+                    uid = j * (S // bk) + kb  # _uid(i=0, j, kb)
+                    keep = _dropout_keep((bq, bk), seed, uid, rate)
+                    o_ref[j * bq:(j + 1) * bq, kb * bk:(kb + 1) * bk] = (
+                        keep.astype(jnp.float32)
+                    )
+
+        keep = (
+            jnp.asarray(
+                pl.pallas_call(
+                    mask_kernel,
+                    out_shape=jax.ShapeDtypeStruct((S, S), jnp.float32),
+                )()
+            )
+            > 0
+        )
+        assert 0.6 < float(keep.mean()) < 0.8  # actually dropping
+
+        q, k, v = make_qkv(jax.random.key(5), 1, S, S, 1, 1, D)
+
+        def dense(q, k, v):
+            qq = q[0, :, 0, :] * D**-0.5
+            s = qq @ k[0, :, 0, :].T
+            s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return (jnp.where(keep, p / (1 - rate), 0.0) @ v[0, :, 0, :])[
+                None, :, None, :
+            ]
+
+        def flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, dropout_rate=rate, dropout_seed=seed,
+                block_q=bq, block_k=bk,
+            )
+
+        fwd_err = float(jnp.max(jnp.abs(flash(q, k, v) - dense(q, k, v))))
+        assert fwd_err < 2e-2, fwd_err
+        gf = jax.grad(lambda *a: jnp.sum(flash(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda *a: jnp.sum(dense(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            rel = float(jnp.max(jnp.abs(a - b)) / jnp.max(jnp.abs(b)))
+            assert rel < 2e-2, rel
 
     def test_trains_with_dropout(self):
         """End-to-end: GPT with use_flash + in-kernel dropout must train."""

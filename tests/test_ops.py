@@ -141,6 +141,23 @@ def test_cross_entropy_ignore_index():
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_cross_entropy_chunked_matches_unchunked():
+    """chunk_size path (scan + checkpoint) == single pass, values and grads,
+    with and without ignore_index, including non-divisible row counts."""
+    logits = jax.random.normal(jax.random.key(12), (2, 37, 11))
+    clean = jax.random.randint(jax.random.key(13), (2, 37), 0, 11)
+    for ignore in (None, -100):
+        labels = clean if ignore is None else clean.at[0, 5].set(-100)
+        want = ops.cross_entropy(logits, labels, ignore_index=ignore)
+        got = ops.cross_entropy(logits, labels, ignore_index=ignore, chunk_size=8)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        gw = jax.grad(lambda lg: ops.cross_entropy(lg, labels, ignore_index=ignore))(logits)
+        gg = jax.grad(
+            lambda lg: ops.cross_entropy(lg, labels, ignore_index=ignore, chunk_size=8)
+        )(logits)
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gw), rtol=2e-5, atol=1e-7)
+
+
 def test_distillation_loss_limits():
     """alpha=1 reduces to plain CE; identical logits give ~zero KL term."""
     s = jax.random.normal(jax.random.key(10), (6, 10))
