@@ -17,11 +17,17 @@ import jax
 import jax.numpy as jnp
 
 
+# past this many logit elements (f32 log-probs > 512 MB) the loss chunks
+# itself; every CE caller (LM, DSV3, MTP) is covered without opting in
+_AUTO_CHUNK_ELEMENTS = 2**27
+_AUTO_CHUNK_ROWS = 8192
+
+
 def cross_entropy(
     logits: jax.Array,
     labels: jax.Array,
     ignore_index: int | None = None,
-    chunk_size: int | None = None,
+    chunk_size: int | None | str = "auto",
 ) -> jax.Array:
     """Mean cross-entropy of integer labels; optionally masks ignore_index.
 
@@ -34,7 +40,13 @@ def cross_entropy(
     (tools/scale_350m.py --seq 16384) OOMs without this: at seq 16k,
     vocab 32k the unchunked f32 logits + log-probs + cotangent cost ~6G of
     the 15.75G HBM. Same math, summation order differs only across chunks.
+    The default "auto" chunks at 8192 rows once logits exceed 2^27 elements
+    (small models keep the single-pass form); pass None to force one pass.
     """
+    if chunk_size == "auto":
+        chunk_size = (
+            _AUTO_CHUNK_ROWS if logits.size > _AUTO_CHUNK_ELEMENTS else None
+        )
     if chunk_size is not None:
         rows = logits.size // logits.shape[-1]
         # a single whole-size chunk still pays off: jax.checkpoint drops the

@@ -75,11 +75,7 @@ def lm_loss_fn(model, params, batch, rng, model_state, train):
         deterministic=not train,
         rngs={"dropout": rng} if train else None,
     )
-    # chunk the CE once f32 log-probs would exceed ~512 MB (rows x V > 128M):
-    # long-context runs OOM on the loss path otherwise; small models keep
-    # the single-pass form (identical math either way)
-    chunk = 8192 if logits.size > 2**27 else None
-    loss = ops.cross_entropy(logits, batch["y"], chunk_size=chunk)
+    loss = ops.cross_entropy(logits, batch["y"])  # auto-chunks at scale
     return loss, {"perplexity": jnp.exp(loss)}, model_state
 
 

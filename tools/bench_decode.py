@@ -1,0 +1,107 @@
+"""Decode-throughput benchmark: cached scan decode vs reference-style
+full-prefix recompute (BENCHMARKS.md).
+
+All four reference LMs generate by re-running the forward on the whole
+prefix per token with no cache (SURVEY.md §3.4). Here that costs O(T) full
+forwards vs the framework's prefill + lax.scan single-token steps. Both
+arms below run jitted on-chip at static shapes — the recompute arm is the
+most charitable possible rendition of the reference's pattern (its actual
+loops are unjitted python); the gap measured is purely the cache.
+
+Usage: python tools/bench_decode.py [--bs 8] [--prompt 128] [--new 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--bs", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=128)
+    p.add_argument("--new", type=int, default=256)
+    p.add_argument("--dim", type=int, default=1024)
+    p.add_argument("--layers", type=int, default=24)
+    p.add_argument("--skip-recompute", action="store_true",
+                   help="only measure the cached arm")
+    args = p.parse_args()
+
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.infer import generate
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    total = args.prompt + args.new
+    cfg = LlamaConfig(
+        vocab_size=32000, dim=args.dim, n_layers=args.layers,
+        n_heads=args.dim // 64, n_kv_heads=args.dim // 128,
+        max_seq_len=total, dropout=0.0, dtype="bfloat16",
+    )
+    model = Llama(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                          (args.bs, args.prompt)),
+        jnp.int32,
+    )
+    params = model.init({"params": jax.random.key(0)}, prompt)["params"]
+    rng = jax.random.key(1)
+
+    def timed(fn, *a, reps=3):
+        # fence on a device-side scalar: block_until_ready is not a real
+        # fence on axon, and device_get of a full logits tensor would drag
+        # tens of MB through the tunnel per rep (observed as minutes-long
+        # "hangs" — slice BEFORE transferring)
+        fence = lambda out: float(jnp.sum(out[..., -1]))  # noqa: E731
+        out = fn(*a)            # compile
+        fence(out)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.time()
+            out = fn(*a)
+            fence(out)
+            best = min(best, time.time() - t0)
+        return best, out
+
+    # arm 1: cached decode (prefill + scan); generate is already one jitted
+    # XLA program — wrapping it in another jit stalls the axon remote
+    # compiler indefinitely (observed >25 min vs 27 s unwrapped)
+    cached = lambda p_, r: generate(  # noqa: E731
+        model, params, p_, r, max_new_tokens=args.new,
+        sampler=ops.sample_greedy,
+    )
+    t_cached, out = timed(cached, prompt, rng)
+
+    # arm 2: reference-style — a full forward over the final-length prefix
+    # per new token. Measured as one jitted full-length forward x `new`
+    # (a scan of full forwards stalls the axon remote compiler; this is
+    # the charitable rendition anyway: the reference's actual loops are
+    # unjitted python with no batching of compile costs)
+    t_full = None
+    if not args.skip_recompute:
+        toks_full = jnp.pad(prompt, ((0, 0), (0, args.new)))
+        fwd = jax.jit(lambda t: model.apply({"params": params}, t,
+                                            deterministic=True)[0])
+        t_one, _ = timed(fwd, toks_full)
+        t_full = t_one * args.new
+
+    new_toks = args.bs * args.new
+    out = {
+        "model": f"llama3-d{args.dim}-L{args.layers}", "bs": args.bs,
+        "prompt": args.prompt, "new": args.new,
+        "cached_tokens_per_sec": round(new_toks / t_cached),
+        "cached_ms_per_token": round(t_cached / args.new * 1e3, 3),
+    }
+    if t_full is not None:
+        out["recompute_tokens_per_sec"] = round(new_toks / t_full)
+        out["speedup"] = round(t_full / t_cached, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
