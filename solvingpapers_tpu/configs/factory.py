@@ -183,6 +183,12 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
     model = build_model(cfg)
     bsz = cfg.train.batch_size
     train_iter = lm_batch_iterator(train_toks, bsz, block, seed=cfg.train.seed, sharding=sharding)
+    if isinstance(train_toks, np.memmap):
+        # host-side gathers (native, GIL-releasing) overlap the device step;
+        # in-memory corpora crop device-side so there is nothing to overlap
+        from solvingpapers_tpu.data.batches import prefetch_batches
+
+        train_iter = prefetch_batches(train_iter, depth=2)
 
     def eval_iter_fn() -> Iterator[dict]:
         return lm_batch_iterator(val_toks, bsz, block, seed=10_000, sharding=sharding)

@@ -85,6 +85,18 @@ def _live(jb, kb, block_q, block_k, offset, causal):
     return kb * block_k <= (jb + 1) * block_q - 1 + offset
 
 
+def _last_live_kb(jb, block_q, block_k, offset):
+    """Largest kv block _live for q-block jb (the same diagonal as _live,
+    solved for kb), floored at 0: with seq_q > seq_k the first q rows see
+    no kv at all and an unfloored clamp would index before the array."""
+    return jnp.maximum(((jb + 1) * block_q - 1 + offset) // block_k, 0)
+
+
+def _first_live_jb(kb, block_q, block_k, offset):
+    """Smallest q block _live for kv-block kb (_live solved for jb)."""
+    return jnp.maximum(kb * block_k - offset, 0) // block_q
+
+
 # --------------------------------------------------------------------- forward
 
 
@@ -178,7 +190,7 @@ def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
         # in the kernel would otherwise fetch-and-ignore (~2x bandwidth on
         # the causal sweep).
         if causal:
-            kb = jnp.minimum(kb, ((j + 1) * block_q - 1 + offset) // block_k)
+            kb = jnp.minimum(kb, _last_live_kb(j, block_q, block_k, offset))
         return (i // group, kb, 0)
 
     return pl.pallas_call(
@@ -368,7 +380,7 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
         # clamp dead causal steps to the last live kv block (repeated block
         # index -> Mosaic skips the DMA); kv here is pre-repeated per q-head
         if causal:
-            kb = jnp.minimum(kb, ((j + 1) * block_q - 1 + offset) // block_k)
+            kb = jnp.minimum(kb, _last_live_kb(j, block_q, block_k, offset))
         return (i, kb, 0)
 
     dq = pl.pallas_call(
@@ -396,12 +408,12 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
         # mirror clamp for the dkv sweep: q blocks before the diagonal are
         # dead — pin them to the first live q block so the DMA is elided
         if causal:
-            jb = jnp.maximum(jb, jnp.maximum(kb * block_k - offset, 0) // block_q)
+            jb = jnp.maximum(jb, _first_live_jb(kb, block_q, block_k, offset))
         return (i, jb, 0)
 
     def q_row_index(i, kb, jb):
         if causal:
-            jb = jnp.maximum(jb, jnp.maximum(kb * block_k - offset, 0) // block_q)
+            jb = jnp.maximum(jb, _first_live_jb(kb, block_q, block_k, offset))
         return (i, 0, jb)
 
     dk_r, dv_r = pl.pallas_call(

@@ -11,6 +11,7 @@ the same channel.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 from typing import IO, Mapping
@@ -42,6 +43,9 @@ class ConsoleWriter(MetricsWriter):
 
 class JSONLWriter(MetricsWriter):
     def __init__(self, path: str):
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         self.f = open(path, "a", buffering=1)
 
     def write(self, step: int, metrics: Mapping[str, float]) -> None:

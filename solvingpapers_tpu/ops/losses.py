@@ -17,9 +17,12 @@ import jax
 import jax.numpy as jnp
 
 
-# past this many logit elements (f32 log-probs > 512 MB) the loss chunks
-# itself; every CE caller (LM, DSV3, MTP) is covered without opting in
-_AUTO_CHUNK_ELEMENTS = 2**27
+# past this many logit elements (f32 log-probs > 1 GB) the loss chunks
+# itself; every CE caller (LM, DSV3, MTP) is covered without opting in.
+# Threshold sized so the reference-scale dsv3 config (4096 rows x 50257 =
+# 206M elements) stays single-pass (chunking costs it ~7% throughput for
+# memory it does not need) while 16k-context LM runs (524M+) chunk.
+_AUTO_CHUNK_ELEMENTS = 2**28
 _AUTO_CHUNK_ROWS = 8192
 
 
@@ -40,7 +43,7 @@ def cross_entropy(
     (tools/scale_350m.py --seq 16384) OOMs without this: at seq 16k,
     vocab 32k the unchunked f32 logits + log-probs + cotangent cost ~6G of
     the 15.75G HBM. Same math, summation order differs only across chunks.
-    The default "auto" chunks at 8192 rows once logits exceed 2^27 elements
+    The default "auto" chunks at 8192 rows once logits exceed 2^28 elements
     (small models keep the single-pass form); pass None to force one pass.
     """
     if chunk_size == "auto":

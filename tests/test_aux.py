@@ -130,6 +130,38 @@ def test_tensorboard_writer(tmp_path):
     assert any(f.startswith("events") for f in os.listdir(tmp_path / "tb"))
 
 
+def test_token_file_run_uses_prefetched_batches(tmp_path):
+    """kind=tokens runs stream from the mmap'd file through the prefetch
+    wrapper (host gathers overlap the device step) with seed-deterministic
+    batches."""
+    import dataclasses
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_char_lm_run
+    from solvingpapers_tpu.data import tokenize_to_file
+
+    text = synthetic_text(20_000, seed=6)
+    tok = ByteBPETokenizer.train(text, vocab_size=300)
+    path = str(tmp_path / "toks.bin")
+    tokenize_to_file(text, tok, path)
+    cfg = get_config("gpt_tiny")
+    cfg = dataclasses.replace(
+        cfg,
+        model=dataclasses.replace(cfg.model, vocab_size=tok.vocab_size),
+        data={"kind": "tokens", "path": path, "block_size": 32},
+    )
+    _, _, _, train_iter, _ = build_char_lm_run(cfg)
+    a = next(train_iter)
+    b = next(train_iter)
+    assert a["x"].shape == (cfg.train.batch_size, 32)
+    assert not np.array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+    # re-building with the same seed yields the same stream (prefetch wrap
+    # preserves order/determinism)
+    _, _, _, train_iter2, _ = build_char_lm_run(cfg)
+    np.testing.assert_array_equal(np.asarray(a["x"]),
+                                  np.asarray(next(train_iter2)["x"]))
+
+
 def test_token_file_roundtrip_and_mmap(tmp_path):
     from solvingpapers_tpu.data import load_token_file, tokenize_to_file
 

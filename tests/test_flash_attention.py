@@ -68,6 +68,49 @@ def test_grads_match_dense(b, sq, skv, n, n_kv, d, causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4)
 
 
+def test_causal_seq_q_longer_than_seq_k():
+    """seq_q > seq_k causal: the end-aligned mask leaves the earliest q rows
+    with no visible kv (NaN rows in the dense reference too). Regression for
+    the DMA-elision clamp, whose unfloored form indexed before the kv array
+    here; rows that do see kv must still match."""
+    q, k, v = make_qkv(jax.random.key(6), 1, 192, 64, 2, 2, 32)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          block_q=64, block_k=64)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    ref_np, out_np = np.asarray(ref), np.asarray(out)
+    # offset = 64 - 192 = -128: q rows < 128 see nothing. The kernel yields
+    # NaN there (0/0 — no live kv block ever runs); the dense path's big-neg
+    # fill degenerates to a uniform average instead. Both are arbitrary for
+    # an all-masked row; what matters is that the kernel neither crashes nor
+    # reads out of bounds (the unfloored clamp did) and that visible rows
+    # agree exactly.
+    assert np.isnan(out_np[:, :128]).all()
+    assert np.isfinite(out_np[:, 128:]).all()
+    np.testing.assert_allclose(out_np[:, 128:], ref_np[:, 128:],
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("bq,bk", [(32, 64), (64, 32), (128, 32)])
+def test_asymmetric_blocks_match_dense(bq, bk):
+    """Non-square (block_q, block_k) exercise the clamped causal index maps
+    (dead-step DMA elision) with q/kv block boundaries out of phase."""
+    q, k, v = make_qkv(jax.random.key(5), 1, 128, 192, 4, 2, 32)
+    ref = ops.dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=True, interpret=True, block_q=bq, block_k=bk) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.sum(
+        ops.dot_product_attention(*a, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
 def test_odd_seq_falls_back_to_smaller_blocks():
     # 96 = 64 + 32; _pick_block must find a divisor block (32)
     q, k, v = make_qkv(jax.random.key(2), 1, 96, 96, 2, 2, 32)
