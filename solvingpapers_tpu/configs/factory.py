@@ -8,7 +8,7 @@ from typing import Iterator
 import numpy as np
 
 from solvingpapers_tpu.data import load_char_corpus
-from solvingpapers_tpu.data.batches import lm_batch_iterator
+from solvingpapers_tpu.data.batches import lm_batch_iterator, prefetch_batches
 from solvingpapers_tpu.configs.registry import RunConfig
 
 
@@ -186,8 +186,6 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
     if isinstance(train_toks, np.memmap):
         # host-side gathers (native, GIL-releasing) overlap the device step;
         # in-memory corpora crop device-side so there is nothing to overlap
-        from solvingpapers_tpu.data.batches import prefetch_batches
-
         train_iter = prefetch_batches(train_iter, depth=2)
 
     def eval_iter_fn() -> Iterator[dict]:

@@ -151,6 +151,9 @@ def test_token_file_run_uses_prefetched_batches(tmp_path):
         data={"kind": "tokens", "path": path, "block_size": 32},
     )
     _, _, _, train_iter, _ = build_char_lm_run(cfg)
+    # the factory must actually wrap memmap streams in the prefetcher (a
+    # plain lm_batch_iterator would satisfy every other assertion here)
+    assert train_iter.gi_code.co_name == "prefetch_batches"
     a = next(train_iter)
     b = next(train_iter)
     assert a["x"].shape == (cfg.train.batch_size, 32)
