@@ -470,7 +470,7 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK,
     dropout_rate: float = 0.0,
     dropout_seed: jax.Array | int = 0,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Flash attention over BSNH tensors (drop-in for ops.dot_product_attention
     when there is no cache/explicit mask).
@@ -485,6 +485,12 @@ def flash_attention(
     seq_k, n_kv = k.shape[1], k.shape[2]
     if n_heads % n_kv:
         raise ValueError(f"q heads {n_heads} not a multiple of kv heads {n_kv}")
+    if interpret is None:
+        # interpret only on CPU (the test platform), so use_flash configs
+        # are testable there; any other non-TPU backend still fails loudly
+        # at Mosaic lowering rather than silently crawling through the
+        # interpreter
+        interpret = jax.devices()[0].platform == "cpu"
     if interpret and dropout_rate > 0.0:
         raise ValueError(
             "in-kernel dropout requires the hardware PRNG: interpret-mode "

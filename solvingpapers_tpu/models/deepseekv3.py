@@ -60,6 +60,7 @@ class DeepSeekV3Config:
     dropout: float = 0.1
     attn_dropout: float = 0.1
     remat: bool = False  # jax.checkpoint each decoder layer
+    use_flash: bool = False  # MLA scores via the Pallas flash kernel (train path)
     norm_eps: float = 1e-6
     dtype: str = "float32"
 
@@ -107,32 +108,56 @@ class MLA(nn.Module):
         # absorbed query: project q into latent space once, score vs latents
         q_lat = jnp.einsum("bsnh,lnh->bsnl", q, w_k.astype(dt))
 
-        if cache is not None:
-            cache = update_latent_cache(cache, latent, positions[0, 0])
-            c_full = cache.c
-            kv_idx = jnp.arange(cache.max_len)
-            mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
+        if cache is None and cfg.use_flash:
+            # absorbed-query MLA *is* MQA over the latent stream: scores are
+            # q_lat . c and the context is probs @ c, i.e. attention with
+            # k = v = c and one shared kv head — so the Pallas flash kernel
+            # serves MLA directly (head_dim = latent_dim), giving the
+            # flagship family the same long-context memory profile as the
+            # GQA models (no (S, S) probs in HBM). Cached decode keeps the
+            # dense einsum path (per-step scores are (1, t), already small).
+            from solvingpapers_tpu.kernels import flash_attention
+
+            c_kv = latent.astype(dt)[:, :, None, :]  # (B, S, 1, L)
+            if cfg.attn_dropout > 0.0 and not deterministic:
+                seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
+                )
+                ctx = flash_attention(
+                    q_lat, c_kv, c_kv, causal=True, scale=hd**-0.5,
+                    dropout_rate=cfg.attn_dropout, dropout_seed=seed,
+                ).astype(dt)
+            else:
+                ctx = flash_attention(
+                    q_lat, c_kv, c_kv, causal=True, scale=hd**-0.5
+                ).astype(dt)
         else:
-            c_full = latent
-            q_idx = jnp.arange(s)
-            mask = (q_idx[None, :, None] >= q_idx[None, None, :])[:, None]
+            if cache is not None:
+                cache = update_latent_cache(cache, latent, positions[0, 0])
+                c_full = cache.c
+                kv_idx = jnp.arange(cache.max_len)
+                mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
+            else:
+                c_full = latent
+                q_idx = jnp.arange(s)
+                mask = (q_idx[None, :, None] >= q_idx[None, None, :])[:, None]
 
-        scores = (
-            jnp.einsum("bsnl,btl->bnst", q_lat, c_full.astype(dt)).astype(
-                jnp.float32
+            scores = (
+                jnp.einsum("bsnl,btl->bnst", q_lat, c_full.astype(dt)).astype(
+                    jnp.float32
+                )
+                * hd**-0.5
             )
-            * hd**-0.5
-        )
-        scores = jnp.where(mask, scores, ops.attention.BIG_NEG)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if cfg.attn_dropout > 0.0 and not deterministic:
-            keep = jax.random.bernoulli(
-                self.make_rng("dropout"), 1.0 - cfg.attn_dropout, probs.shape
-            )
-            probs = probs * keep / (1.0 - cfg.attn_dropout)
-        probs = probs.astype(dt)
+            scores = jnp.where(mask, scores, ops.attention.BIG_NEG)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if cfg.attn_dropout > 0.0 and not deterministic:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - cfg.attn_dropout, probs.shape
+                )
+                probs = probs * keep / (1.0 - cfg.attn_dropout)
+            probs = probs.astype(dt)
+            ctx = jnp.einsum("bnst,btl->bsnl", probs, c_full.astype(dt))
 
-        ctx = jnp.einsum("bnst,btl->bsnl", probs, c_full.astype(dt))
         out = jnp.einsum("bsnl,lnh->bsnh", ctx, w_v.astype(dt))
         out = out.reshape(b, s, n * hd)
         out = nn.Dense(cfg.dim, use_bias=False, dtype=dt, name="out")(out)

@@ -120,6 +120,40 @@ def test_cached_decode_equals_full_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(toks))
 
 
+def test_flash_mla_matches_dense_mla():
+    """use_flash MLA (absorbed-query attention == MQA over the latent
+    stream, served by the Pallas kernel) must match the dense einsum path —
+    same params, values, and grads."""
+    import dataclasses
+
+    model_d, variables = init_model()
+    cfg_f = dataclasses.replace(TINY, use_flash=True)
+    model_f = DeepSeekV3(cfg_f)
+    toks = jax.random.randint(jax.random.key(3), (2, 16), 0, TINY.vocab_size)
+
+    out_d, _ = model_d.apply(variables, toks)
+    out_f, _ = model_f.apply(variables, toks)  # same param structure
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_d), rtol=2e-4, atol=2e-4
+    )
+
+    def loss(m):
+        def f(p):
+            logits, _ = m.apply({**variables, "params": p}, toks)
+            return ops.cross_entropy(logits, toks)
+        return jax.grad(f)(variables["params"])
+
+    gd, gf = loss(model_d), loss(model_f)
+    flat_d = jax.tree_util.tree_flatten_with_path(gd)[0]
+    flat_f = jax.tree_util.tree_flatten_with_path(gf)[0]
+    assert [str(p) for p, _ in flat_d] == [str(p) for p, _ in flat_f]
+    for (pa, a), (_, bv) in zip(flat_d, flat_f):
+        np.testing.assert_allclose(
+            np.asarray(bv), np.asarray(a), rtol=5e-3, atol=5e-4,
+            err_msg=str(pa),
+        )
+
+
 def test_mtp_shapes_and_loss():
     import dataclasses
 
