@@ -24,15 +24,16 @@ def main() -> None:
 
     BASELINE_TOK_S = 16_100.0  # gpt-jax.ipynb cell 18 tqdm, 1x T4
 
+    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+
     # the framework's fast path: Pallas flash attention with in-kernel
     # dropout (same Bernoulli semantics as the reference's prob dropout;
     # measured ~22% faster than the dense path on this workload). Off-TPU
-    # (smoke runs) fall back to dense — interpret-mode flash has no
-    # hardware PRNG for the in-kernel dropout.
-    on_tpu = jax.devices()[0].platform != "cpu"
+    # smoke runs use the dense path (apply_flash_attention would fall back
+    # per-call anyway; this keeps the measured graph uniform).
     cfg = GPTConfig(
         vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=1,
-        dropout=0.1, dtype="bfloat16", use_flash=on_tpu,
+        dropout=0.1, dtype="bfloat16", use_flash=is_tpu_backend(),
     )
     batch = 128
     tcfg = TrainConfig(

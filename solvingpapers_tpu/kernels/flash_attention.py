@@ -63,6 +63,14 @@ def _dropout_keep(shape, seed_val, block_uid, rate):
     return bits < threshold
 
 
+def is_tpu_backend() -> bool:
+    """True on real TPU hardware (incl. tunnelled platforms like 'axon'
+    whose device_kind names a TPU) — where the Mosaic kernel and its
+    hardware PRNG run; False on the CPU test platform / other backends."""
+    dev = jax.devices()[0]
+    return dev.platform == "tpu" or "TPU" in str(getattr(dev, "device_kind", ""))
+
+
 def _pick_block(seq: int, requested: int) -> int:
     block = min(requested, seq)
     while seq % block:

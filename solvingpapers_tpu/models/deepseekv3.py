@@ -116,21 +116,13 @@ class MLA(nn.Module):
             # flagship family the same long-context memory profile as the
             # GQA models (no (S, S) probs in HBM). Cached decode keeps the
             # dense einsum path (per-step scores are (1, t), already small).
-            from solvingpapers_tpu.kernels import flash_attention
+            from solvingpapers_tpu.models.layers import apply_flash_attention
 
             c_kv = latent.astype(dt)[:, :, None, :]  # (B, S, 1, L)
-            if cfg.attn_dropout > 0.0 and not deterministic:
-                seed = jax.random.randint(
-                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
-                )
-                ctx = flash_attention(
-                    q_lat, c_kv, c_kv, causal=True, scale=hd**-0.5,
-                    dropout_rate=cfg.attn_dropout, dropout_seed=seed,
-                ).astype(dt)
-            else:
-                ctx = flash_attention(
-                    q_lat, c_kv, c_kv, causal=True, scale=hd**-0.5
-                ).astype(dt)
+            ctx = apply_flash_attention(
+                self, q_lat, c_kv, c_kv, causal=True, scale=hd**-0.5,
+                dropout_rate=cfg.attn_dropout, deterministic=deterministic,
+            ).astype(dt)
         else:
             if cache is not None:
                 cache = update_latent_cache(cache, latent, positions[0, 0])

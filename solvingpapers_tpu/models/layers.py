@@ -174,23 +174,11 @@ class Attention(nn.Module):
             else:
                 raise ValueError(f"unknown context_impl {self.context_impl!r}")
         else:
-            dropout_active = self.dropout > 0.0 and not deterministic
             if self.use_flash:
-                from solvingpapers_tpu.kernels import flash_attention
-
-                if dropout_active:
-                    # in-kernel prob dropout: same Bernoulli semantics as the
-                    # dense path, mask regenerated in the backward from the
-                    # seed (never materialized)
-                    seed = jax.random.randint(
-                        self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
-                    )
-                    out = flash_attention(
-                        q, k, v, causal=self.causal,
-                        dropout_rate=self.dropout, dropout_seed=seed,
-                    )
-                else:
-                    out = flash_attention(q, k, v, causal=self.causal)
+                out = apply_flash_attention(
+                    self, q, k, v, causal=self.causal,
+                    dropout_rate=self.dropout, deterministic=deterministic,
+                )
             else:
                 out = ops.dot_product_attention(
                     q,
@@ -256,6 +244,33 @@ class GLUFFN(nn.Module):
 def swiglu_hidden_dim(dim: int, multiplier: int = 4) -> int:
     """The (2/3)·4·dim sizing convention (deepseekv3 cell 21: ((2D)*4)//3)."""
     return (2 * dim * multiplier) // 3
+
+
+def apply_flash_attention(module, q, k, v, *, causal, scale=None,
+                          dropout_rate=0.0, deterministic=True):
+    """Flash attention with the framework's dropout policy, shared by every
+    use_flash model (Attention here, DeepSeekV3's MLA): in-kernel prob
+    dropout on real TPU (same Bernoulli semantics as the dense path; mask
+    regenerated in the backward from the seed, never materialized); when
+    dropout is active OFF-TPU the dense path runs instead — interpret-mode
+    pltpu PRNG is a zero stub, so in-kernel dropout cannot run there."""
+    from solvingpapers_tpu.kernels import flash_attention
+    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+
+    if dropout_rate > 0.0 and not deterministic:
+        if is_tpu_backend():
+            seed = jax.random.randint(
+                module.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
+            )
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale,
+                dropout_rate=dropout_rate, dropout_seed=seed,
+            )
+        return ops.dot_product_attention(
+            q, k, v, causal=causal, scale=scale, dropout_rate=dropout_rate,
+            dropout_rng=module.make_rng("dropout"), deterministic=False,
+        )
+    return flash_attention(q, k, v, causal=causal, scale=scale)
 
 
 def maybe_remat(block_cls, remat: bool, caches) -> type:
