@@ -170,9 +170,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
 
     @pl.when(kb == num_kb - 1)
     def _finish():
+        # Rows that saw no kv (causal with seq_q > seq_k under the
+        # end-aligned mask) have l == 0: emit o = 0 instead of 0/0 = NaN so a
+        # caller summing over all rows isn't gradient-poisoned, and lse = 0
+        # (not m = BIG_NEG) so the backward's exp(s - lse) = exp(BIG_NEG)
+        # underflows to 0 for those rows instead of exp(0) = 1.
         l_i = l_scr[...]
-        o_ref[0, :, :] = (acc_scr[...] / l_i).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m_scr[...] + jnp.log(l_i))[:, 0]
+        empty = l_i <= 0.0
+        safe_l = jnp.where(empty, 1.0, l_i)
+        o_ref[0, :, :] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = jnp.where(
+            empty, 0.0, m_scr[...] + jnp.log(safe_l)
+        )[:, 0]
 
 
 def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,

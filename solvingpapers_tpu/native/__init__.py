@@ -190,13 +190,16 @@ class NativeBpeEncoder:
             np.cumsum(counts, out=bounds[1:])
             for i, c in enumerate(novel):
                 cache[c] = flat[bounds[i] : bounds[i + 1]]
-            if len(cache) > self._cache_limit:  # unbounded growth guard
-                cache.clear()
-                for i, c in enumerate(novel):
-                    cache[c] = flat[bounds[i] : bounds[i + 1]]
         if not chunks:
             return np.empty(0, np.int32)
-        return np.concatenate([cache[c] for c in chunks])
+        # Resolve before any eviction: this call may reference chunks cached
+        # by earlier calls, which the growth guard below is free to drop.
+        out = np.concatenate([cache[c] for c in chunks])
+        if len(cache) > self._cache_limit:  # unbounded growth guard
+            cache.clear()
+            for i, c in enumerate(novel):
+                cache[c] = flat[bounds[i] : bounds[i + 1]]
+        return out
 
 
 def bpe_train_native(

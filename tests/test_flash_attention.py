@@ -70,24 +70,36 @@ def test_grads_match_dense(b, sq, skv, n, n_kv, d, causal):
 
 def test_causal_seq_q_longer_than_seq_k():
     """seq_q > seq_k causal: the end-aligned mask leaves the earliest q rows
-    with no visible kv (NaN rows in the dense reference too). Regression for
-    the DMA-elision clamp, whose unfloored form indexed before the kv array
-    here; rows that do see kv must still match."""
+    with no visible kv. The kernel emits 0 for those rows (guarded softmax
+    denominator) — not NaN — so a caller summing over all rows keeps finite
+    values and gradients; visible rows must match dense exactly. Also a
+    regression for the DMA-elision clamp, whose unfloored form indexed
+    before the kv array here."""
     q, k, v = make_qkv(jax.random.key(6), 1, 192, 64, 2, 2, 32)
     out = flash_attention(q, k, v, causal=True, interpret=True,
                           block_q=64, block_k=64)
     ref = ops.dot_product_attention(q, k, v, causal=True)
     ref_np, out_np = np.asarray(ref), np.asarray(out)
-    # offset = 64 - 192 = -128: q rows < 128 see nothing. The kernel yields
-    # NaN there (0/0 — no live kv block ever runs); the dense path's big-neg
-    # fill degenerates to a uniform average instead. Both are arbitrary for
-    # an all-masked row; what matters is that the kernel neither crashes nor
-    # reads out of bounds (the unfloored clamp did) and that visible rows
-    # agree exactly.
-    assert np.isnan(out_np[:, :128]).all()
-    assert np.isfinite(out_np[:, 128:]).all()
+    # offset = 64 - 192 = -128: q rows < 128 see nothing -> 0 output (the
+    # dense path's big-neg fill degenerates to a uniform average there; both
+    # are arbitrary for an all-masked row, but 0 is finite and grad-safe).
+    assert (out_np[:, :128] == 0.0).all()
+    assert np.isfinite(out_np).all()
     np.testing.assert_allclose(out_np[:, 128:], ref_np[:, 128:],
                                rtol=2e-5, atol=2e-5)
+    # A sum over ALL rows (empty ones included) must give finite grads, and
+    # grads w.r.t. the visible region must match dense.
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(
+        *a, causal=True, interpret=True, block_q=64, block_k=64) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda *a: jnp.sum(jnp.where(
+        jnp.arange(192)[None, :, None, None] >= 128,
+        ops.dot_product_attention(*a, causal=True), 0.0) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
 
 
 @pytest.mark.parametrize("bq,bk", [(32, 64), (64, 32), (128, 32)])

@@ -156,3 +156,26 @@ def test_native_disabled_env(monkeypatch):
     r = subprocess.run([sys.executable, "-c", code], env=env,
                        capture_output=True, text=True, cwd="/root/repo")
     assert r.returncode == 0, r.stderr
+
+
+def test_encoder_cache_eviction_keeps_current_call_resolvable():
+    """Regression: when the chunk cache crosses its growth limit, eviction
+    must not drop chunks the *current* call still needs (previously cached
+    by earlier calls) before the output is assembled — that raised KeyError
+    once unique chunks exceeded the limit."""
+    text = synthetic_text(20_000, seed=7)
+    tok = ByteBPETokenizer.train(text, vocab_size=400)
+    enc = tok._native_encoder()
+    assert enc is not None
+
+    a = ["alpha ", "beta ", "gamma ", "delta ", "epsilon ", "zeta "]
+    b = ["alpha ", "eta ", "theta ", "iota ", "kappa ", "beta "]  # mixes old+new
+    want_a = enc.encode_texts(a)  # default limit: no eviction
+    want_b = enc.encode_texts(b)
+
+    enc._cache_limit = 4  # force eviction on nearly every call
+    enc._chunk_cache.clear()
+    got_a = enc.encode_texts(a)
+    got_b = enc.encode_texts(b)  # KeyError before the fix
+    np.testing.assert_array_equal(got_a, want_a)
+    np.testing.assert_array_equal(got_b, want_b)
