@@ -34,9 +34,30 @@ def _add_common(p: argparse.ArgumentParser) -> None:
         help="force a JAX platform (the env pins the axon TPU; 'cpu' enables "
         "local debugging and virtual multi-device meshes)",
     )
+    p.add_argument(
+        "--virtual-devices",
+        type=int,
+        default=None,
+        help="with --platform cpu: number of virtual host devices to "
+        "provision (xla_force_host_platform_device_count), so multi-axis "
+        "meshes run without hardware; must be set before any JAX "
+        "computation, i.e. only works as a process entry flag",
+    )
 
 
 def _apply_platform(args) -> None:
+    n = getattr(args, "virtual_devices", None)
+    if n:
+        import os
+        import re
+
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
     if getattr(args, "platform", None):
         jax.config.update("jax_platforms", args.platform)
 
@@ -73,15 +94,12 @@ def cmd_train(args) -> int:
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
 
-    if getattr(cfg.model, "context_parallel", False):
-        print(
-            "context-parallel configs are shard_map-composed and not driven "
-            "by the stock Trainer yet; see tests/test_ring_attention.py::"
-            "test_llama_context_parallel_training_matches_dense for the "
-            "training-step pattern",
-            file=sys.stderr,
+    cp = getattr(cfg.model, "context_parallel", False)
+    if cp and not cfg.train.context_parallel:
+        # a CP model demands the CP train step; keep the two flags in sync
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, context_parallel=True)
         )
-        return 2
     mesh = create_mesh(cfg.train.mesh)
     writer = ConsoleWriter()  # fit() gates cadence by log_every
     if args.jsonl:
@@ -90,7 +108,7 @@ def cmd_train(args) -> int:
     kind = cfg.data.get("kind", "char")
     if kind in ("char", "bpe", "tokens"):
         cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
-            cfg, sharding=batch_sharding(mesh)
+            cfg, sharding=batch_sharding(mesh, context=cp)
         )
         trainer = Trainer(
             model, cfg.train, loss_fn=loss_fn_for(cfg),
@@ -98,7 +116,10 @@ def cmd_train(args) -> int:
         )
         callbacks = None
         can_sample = False
-        if args.artifacts_dir:
+        if args.artifacts_dir and cp:
+            print("[sample] disabled: decode caches are unsupported under "
+                  "context parallelism", file=sys.stderr)
+        elif args.artifacts_dir:
             try:  # token-file runs have no text tokenizer to build prompts
                 can_sample = len(tok.encode("\n")) > 0
                 if not can_sample:

@@ -221,11 +221,9 @@ def _gemma_char() -> RunConfig:
 def _llama3_long() -> RunConfig:
     """Long-context capability demo (nothing comparable in the reference —
     its max context is 256 tokens): llama with context_parallel=True for
-    ring-attention training over a 'context' mesh axis. The model applies
-    inside shard_map with the sequence sharded; see
-    tests/test_ring_attention.py::test_llama_context_parallel_training_matches_dense
-    for the exact usage pattern (the stock Trainer drives the dense/flash
-    paths; CP steps are shard_map-composed)."""
+    ring-attention training over a 'context' mesh axis. Driven end-to-end
+    by the stock Trainer/CLI: the train step runs the whole loss inside
+    shard_map with the sequence sharded (TrainConfig.context_parallel)."""
     from solvingpapers_tpu.models.llama3 import LlamaConfig
 
     return RunConfig(
@@ -240,6 +238,7 @@ def _llama3_long() -> RunConfig:
             steps=10_000, batch_size=8, log_every=50, eval_every=500,
             eval_batches=8,
             mesh=MeshConfig(data=-1, context=4),
+            context_parallel=True,
             optimizer=OptimizerConfig(
                 name="adamw", max_lr=3e-4, warmup_steps=200, total_steps=10_000,
                 weight_decay=0.1, grad_clip=1.0,
@@ -250,6 +249,38 @@ def _llama3_long() -> RunConfig:
               "bpe_vocab_size": 32_000},
         notes="beyond-reference long-context config; sequence sharded over "
               "the context axis, ring attention over ICI",
+    )
+
+
+@register("llama3_long_smoke")
+def _llama3_long_smoke() -> RunConfig:
+    """CPU-mesh-sized llama3_long: the same context-parallel Trainer/CLI
+    path (ring attention inside shard_map over data=2 x context=4) at toy
+    dims, runnable on the virtual 8-device mesh in seconds. Release smoke
+    test for the CP front door."""
+    from solvingpapers_tpu.models.llama3 import LlamaConfig
+
+    return RunConfig(
+        name="llama3_long_smoke",
+        model_family="llama3",
+        model=LlamaConfig(
+            vocab_size=256, max_seq_len=256, dim=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, dropout=0.0, dtype="float32",
+            context_parallel=True,
+        ),
+        train=TrainConfig(
+            steps=20, batch_size=4, log_every=5, eval_every=10,
+            eval_batches=2,
+            mesh=MeshConfig(data=-1, context=4),
+            context_parallel=True,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=5, total_steps=20,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=4 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="llama3_long at smoke scale for the virtual CPU mesh",
     )
 
 

@@ -62,10 +62,14 @@ def create_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
-def batch_spec(extra_dims: int = 1) -> P:
-    """PartitionSpec for a batch-leading array: batch over (data, fsdp)."""
+def batch_spec(extra_dims: int = 1, context: bool = False) -> P:
+    """PartitionSpec for a batch-leading array: batch over (data, fsdp);
+    with `context`, the next (sequence) dim over the 'context' axis — the
+    layout context-parallel training steps shard_map over."""
+    if context and extra_dims >= 1:
+        return P(("data", "fsdp"), "context", *([None] * (extra_dims - 1)))
     return P(("data", "fsdp"), *([None] * extra_dims))
 
 
-def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec(extra_dims))
+def batch_sharding(mesh: Mesh, extra_dims: int = 1, context: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(extra_dims, context=context))

@@ -65,6 +65,12 @@ class TrainConfig:
     debug_nans: bool = False  # jax_debug_nans: fail fast at the faulting op
     profile_dir: str | None = None  # jax.profiler trace output (TensorBoard)
     profile_steps: tuple[int, int] = (10, 15)  # [start, stop) steps to trace
+    # context parallelism: shard the sequence dim of (B, S) token batches
+    # over the mesh 'context' axis and run the whole loss inside shard_map
+    # (the model must be built with context_parallel=True so its attention
+    # runs the ppermute ring / Ulysses all_to_all). Params are replicated
+    # across 'context'; composes with the data axes.
+    context_parallel: bool = False
 
 
 def lm_loss_fn(model, params, batch, rng, model_state, train):
@@ -114,9 +120,18 @@ class Trainer:
 
         def make(rng):
             p_rng, d_rng, s_rng = jax.random.split(rng, 3)
-            out = self.init_fn(
-                self.model, {"params": p_rng, "dropout": d_rng}, example_batch
-            )
+            rngs = {"params": p_rng, "dropout": d_rng}
+            if cfg.context_parallel:
+                # a CP model's forward calls axis collectives, so init must
+                # also run inside shard_map; identical rngs/shapes on every
+                # shard make the params replicated (out_specs P())
+                out = jax.shard_map(
+                    lambda r, b: self.init_fn(self.model, r, b),
+                    mesh=self.mesh, in_specs=(P(), self._batch_specs()),
+                    out_specs=P(),
+                )(rngs, example_batch)
+            else:
+                out = self.init_fn(self.model, rngs, example_batch)
             # init_fn may return params alone or (params, model_state)
             params, model_state = out if isinstance(out, tuple) else (out, None)
             return TrainState.create(
@@ -144,22 +159,101 @@ class Trainer:
 
     def _set_batch_shardings(self, example_batch: dict) -> None:
         """Record rank-appropriate batch shardings (x may be 2-D tokens or
-        4-D images; y may be 2-D targets or 1-D labels)."""
+        4-D images; y may be 2-D targets or 1-D labels). Under context
+        parallelism, the sequence dim of rank-2 token arrays is sharded over
+        'context' in addition to the batch dim over (data, fsdp)."""
+        cp = self.config.context_parallel
         self._batch_shardings = jax.tree.map(
-            lambda a: batch_sharding(self.mesh, jnp.ndim(a) - 1), example_batch
+            lambda a: batch_sharding(
+                self.mesh, jnp.ndim(a) - 1, context=cp and jnp.ndim(a) == 2
+            ),
+            example_batch,
+        )
+
+    def _batch_specs(self):
+        """PartitionSpec pytree of the recorded batch shardings."""
+        return jax.tree.map(
+            lambda s: s.spec, self._batch_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
         )
 
     # ------------------------------------------------------------------ steps
 
+    def _cp_loss_call(self):
+        """Build the context-parallel loss: the model applies inside
+        shard_map with the sequence sharded over 'context' (its attention
+        runs the ppermute ring / Ulysses all_to_all), params replicated
+        across the batch/context axes, and the per-shard loss pmean'd back
+        to the global mean (equal shard sizes make that exact). Gradients
+        through shard_map psum across shards automatically."""
+        axes = ("data", "fsdp", "context")
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        # fsdp is rejected too: in_specs=P() would re-gather the full params
+        # and grads on every device each step — a silent memory regression
+        # at exactly the scale CP targets
+        bad = {a: sizes[a] for a in ("fsdp", "model", "expert", "pipe")
+               if sizes.get(a, 1) > 1}
+        if bad:
+            raise NotImplementedError(
+                f"context_parallel replicates params inside shard_map and "
+                f"does not compose with {bad} axes yet"
+            )
+        if not getattr(getattr(self.model, "cfg", None), "context_parallel", False):
+            raise ValueError(
+                "TrainConfig.context_parallel=True but the model was not "
+                "built with context_parallel=True: it would attend only "
+                "within each local sequence shard (no ring collectives, "
+                "positions restarting at 0) and train a silently wrong "
+                "objective"
+            )
+        batch_specs = self._batch_specs()
+
+        def call(params, model_state, batch, rng, train):
+            if model_state is not None:
+                raise NotImplementedError(
+                    "context_parallel with model_state (e.g. MoE routing "
+                    "bias): per-shard state updates would silently diverge; "
+                    "psum the state update inside the loss_fn first"
+                )
+
+            def local(params, batch, rng):
+                # decorrelate dropout across shards; loss_fn sees the local
+                # (B/data, S/context) shard and computes its local mean
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(axes))
+                loss, aux, _ = self.loss_fn(
+                    self.model, params, batch, rng, None, train
+                )
+                loss = jax.lax.pmean(loss, axes)
+                aux = jax.tree.map(lambda a: jax.lax.pmean(a, axes), aux)
+                if "perplexity" in aux:
+                    # exp of the global mean, not the pmean of local exps
+                    aux["perplexity"] = jnp.exp(loss)
+                return loss, aux
+
+            loss, aux = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(), batch_specs, P()),
+                out_specs=(P(), P()),
+            )(params, batch, rng)
+            return loss, aux, None
+
+        return call
+
     def _build_steps(self):
         replicated = NamedSharding(self.mesh, P())
+        if self.config.context_parallel:
+            loss_call = self._cp_loss_call()
+        else:
+            loss_call = lambda params, ms, batch, rng, train: self.loss_fn(  # noqa: E731
+                self.model, params, batch, rng, ms, train
+            )
 
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
             def loss_wrap(params):
-                loss, aux, new_ms = self.loss_fn(
-                    self.model, params, batch, step_rng, state.model_state, True
+                loss, aux, new_ms = loss_call(
+                    params, state.model_state, batch, step_rng, True
                 )
                 return loss, (aux, new_ms)
 
@@ -177,8 +271,8 @@ class Trainer:
             return new_state, metrics
 
         def eval_step(state: TrainState, batch: dict):
-            loss, aux, _ = self.loss_fn(
-                self.model, state.params, batch, state.rng, state.model_state, False
+            loss, aux, _ = loss_call(
+                state.params, state.model_state, batch, state.rng, False
             )
             return {"val_loss": loss, **{f"val_{k}": v for k, v in aux.items()}}
 
@@ -286,21 +380,34 @@ class Trainer:
                     t_prev = time.perf_counter()
                     last_log_step = start_step + 1
 
-                if cfg.eval_every > 0 and eval_iter_fn and (step + 1) % cfg.eval_every == 0:
+                run_eval = (
+                    cfg.eval_every > 0 and eval_iter_fn
+                    and (step + 1) % cfg.eval_every == 0
+                )
+                run_cbs = callbacks and any(
+                    every > 0 and (step + 1) % every == 0 for every, _ in callbacks
+                )
+                if run_eval or run_cbs:
+                    # fence queued async train steps BEFORE starting the
+                    # excluded-time window: evaluate()/callbacks force them
+                    # to completion via their data dependency on `state`,
+                    # and without the fence that train time would be
+                    # misattributed to eval and subtracted from the step
+                    # timing (the source of impossible tokens/sec spikes on
+                    # eval-aligned log rows)
+                    jax.device_get(metrics["train_loss"])
+                if run_eval:
                     t_eval = time.perf_counter()
                     val = self.evaluate(state, eval_iter_fn())
                     writer.write(step + 1, {k: float(v) for k, v in val.items()})
                     t_prev += time.perf_counter() - t_eval  # keep eval out of step timing
 
-                if callbacks:
+                if run_cbs:
                     t_cb = time.perf_counter()
-                    ran = False
                     for every, fn in callbacks:
                         if every > 0 and (step + 1) % every == 0:
                             fn(state, step + 1)
-                            ran = True
-                    if ran:
-                        t_prev += time.perf_counter() - t_cb
+                    t_prev += time.perf_counter() - t_cb
 
                 if (step + 1) % max(cfg.log_every, 1) == 0 or step == cfg.steps - 1:
                     metrics = jax.device_get(metrics)  # blocks; also fences timing
