@@ -107,18 +107,21 @@ def cmd_train(args) -> int:
 
     kind = cfg.data.get("kind", "char")
     if kind in ("char", "bpe", "tokens"):
+        from solvingpapers_tpu.configs.factory import rules_for
+
         cfg, model, tok, train_iter, eval_iter_fn = build_char_lm_run(
             cfg, sharding=batch_sharding(mesh, context=cp)
         )
         trainer = Trainer(
             model, cfg.train, loss_fn=loss_fn_for(cfg),
-            init_fn=init_fn_for(cfg), mesh=mesh,
+            init_fn=init_fn_for(cfg), mesh=mesh, rules=rules_for(cfg),
         )
         callbacks = None
         can_sample = False
-        if args.artifacts_dir and cp:
+        no_decode = cp or cfg.train.pipeline_parallel
+        if args.artifacts_dir and no_decode:
             print("[sample] disabled: decode caches are unsupported under "
-                  "context parallelism", file=sys.stderr)
+                  "context/pipeline parallelism", file=sys.stderr)
         elif args.artifacts_dir:
             try:  # token-file runs have no text tokenizer to build prompts
                 can_sample = len(tok.encode("\n")) > 0
@@ -219,6 +222,14 @@ def cmd_sample(args) -> int:
     from solvingpapers_tpu.infer import generate
 
     cfg = get_config(args.config)
+    if getattr(cfg.model, "context_parallel", False) or cfg.train.pipeline_parallel:
+        print(
+            "sampling is unsupported for context/pipeline-parallel configs "
+            "(decode caches don't compose with the sharded forward); export "
+            "the params and decode with the dense model family",
+            file=sys.stderr,
+        )
+        return 2
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
     cfg, model, tok, _, _ = build_char_lm_run(cfg)
@@ -258,12 +269,13 @@ def _restore_for_inference(cfg, model, checkpoint_dir, example_batch, trainer=No
     """Shared restore path: returns (state, params, extra_variables) from
     the newest checkpoint, or None if the directory is empty."""
     from solvingpapers_tpu.checkpoint import CheckpointManager
-    from solvingpapers_tpu.configs.factory import init_fn_for
+    from solvingpapers_tpu.configs.factory import init_fn_for, rules_for
     from solvingpapers_tpu.train import Trainer
     from solvingpapers_tpu.train.engine import _apply_pure, _pure_state
 
     if trainer is None:
-        trainer = Trainer(model, cfg.train, init_fn=init_fn_for(cfg))
+        trainer = Trainer(model, cfg.train, init_fn=init_fn_for(cfg),
+                          rules=rules_for(cfg))
     state = trainer.init_state(example_batch)
     mgr = CheckpointManager(checkpoint_dir, save_every=0)
     restored = mgr.restore_latest(_pure_state(state))
@@ -284,6 +296,7 @@ def cmd_eval(args) -> int:
         build_image_run,
         init_fn_for,
         loss_fn_for,
+        rules_for,
     )
     from solvingpapers_tpu.sharding import batch_sharding, create_mesh
     from solvingpapers_tpu.train import Trainer
@@ -292,15 +305,16 @@ def cmd_eval(args) -> int:
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
     mesh = create_mesh(cfg.train.mesh)
+    cp = getattr(cfg.model, "context_parallel", False)
     if cfg.data.get("kind", "char") == "images":
         model, _, eval_iter_fn, loss_fn = build_image_run(cfg, mesh=mesh)
     else:
         cfg, model, _, _, eval_iter_fn = build_char_lm_run(
-            cfg, sharding=batch_sharding(mesh)
+            cfg, sharding=batch_sharding(mesh, context=cp)
         )
         loss_fn = loss_fn_for(cfg)
     trainer = Trainer(model, cfg.train, loss_fn=loss_fn,
-                      init_fn=init_fn_for(cfg), mesh=mesh)
+                      init_fn=init_fn_for(cfg), mesh=mesh, rules=rules_for(cfg))
     eval_iter = eval_iter_fn()
     first = next(eval_iter)
     if args.checkpoint_dir:

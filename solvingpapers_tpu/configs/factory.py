@@ -30,6 +30,10 @@ def build_model(cfg: RunConfig):
         from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3
 
         return DeepSeekV3(cfg.model)
+    if fam == "gpt_pipe":
+        from solvingpapers_tpu.models.gpt_pipe import GPTPipe
+
+        return GPTPipe(cfg.model)
     if fam == "vit":
         from solvingpapers_tpu.models.vit import ViT
 
@@ -66,6 +70,7 @@ def loss_fn_for(cfg: RunConfig):
 
     return {
         "gpt": lm_loss_fn,
+        "gpt_pipe": lm_loss_fn,
         "llama3": lm_loss_fn,
         "gemma": lm_loss_fn,
         "deepseekv3": dsv3_loss_fn,
@@ -75,6 +80,15 @@ def loss_fn_for(cfg: RunConfig):
         "ae": reconstruction_loss_fn,
         "vae": vae_loss_fn,
     }[cfg.model_family]
+
+
+def rules_for(cfg: RunConfig):
+    """Partition-rule table for a RunConfig — every Trainer construction
+    site (train/eval/export/sample-restore) must agree on it, or restored
+    states land in a layout that mismatches training."""
+    from solvingpapers_tpu.sharding import LM_RULES, PP_RULES
+
+    return PP_RULES if cfg.train.pipeline_parallel else LM_RULES
 
 
 def init_fn_for(cfg: RunConfig):

@@ -252,6 +252,68 @@ def _llama3_long() -> RunConfig:
     )
 
 
+@register("gpt_pp")
+def _gpt_pp() -> RunConfig:
+    """Pipeline-parallel GPT (SURVEY.md §2.3 PP row; nothing comparable in
+    the reference): the reference GPT-jax architecture with its 8 decoder
+    blocks split into 4 stages over the 'pipe' mesh axis, GPipe microbatch
+    schedule inside shard_map, composed with data parallelism."""
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipeConfig
+
+    return RunConfig(
+        name="gpt_pp",
+        model_family="gpt_pipe",
+        model=GPTPipeConfig(
+            vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=4,
+            dtype="bfloat16", n_stages=4, n_microbatches=8,
+            pipeline_parallel=True,
+        ),
+        train=TrainConfig(
+            steps=1000, batch_size=64, log_every=50, eval_every=200,
+            eval_batches=10,
+            mesh=MeshConfig(data=-1, pipe=4),
+            pipeline_parallel=True,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=100, total_steps=1000,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=64 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="GPipe over 4 stages x data parallel; stage params stored "
+              "sharded over 'pipe' (PP_RULES)",
+    )
+
+
+@register("gpt_pp_smoke")
+def _gpt_pp_smoke() -> RunConfig:
+    """CPU-mesh-sized gpt_pp (virtual 8-device mesh: data=2 x pipe=4)."""
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipeConfig
+
+    return RunConfig(
+        name="gpt_pp_smoke",
+        model_family="gpt_pipe",
+        model=GPTPipeConfig(
+            vocab_size=256, block_size=64, dim=32, n_layers=4, n_heads=2,
+            dtype="float32", n_stages=4, n_microbatches=4,
+            pipeline_parallel=True,
+        ),
+        train=TrainConfig(
+            steps=20, batch_size=8, log_every=5, eval_every=10,
+            eval_batches=2,
+            mesh=MeshConfig(data=-1, pipe=4),
+            pipeline_parallel=True,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=5, total_steps=20,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=8 * 64,
+        ),
+        data={"kind": "char", "path": None, "block_size": 64},
+        notes="gpt_pp at smoke scale for the virtual CPU mesh",
+    )
+
+
 @register("llama3_long_smoke")
 def _llama3_long_smoke() -> RunConfig:
     """CPU-mesh-sized llama3_long: the same context-parallel Trainer/CLI

@@ -1,0 +1,181 @@
+"""Pipeline-parallel GPT: decoder blocks grouped into stages whose params
+are STORED stacked with a leading stage dim sharded over the 'pipe' mesh
+axis, and applied with the GPipe ppermute microbatch schedule
+(sharding/pipeline.py) inside shard_map.
+
+No counterpart in the reference (SURVEY.md §2.3 lists PP as a TPU-native
+capability to add; the reference's ceiling is single-process DataParallel,
+deepseekv3.ipynb cell 37). The embedding, final norm and head are small and
+run replicated on every pipe device; only the decoder stack — where the
+params and FLOPs are — is staged. With pipeline_parallel=False the same
+stacked params are applied by a sequential scan over stages, which is the
+dense oracle the PP schedule is tested against.
+
+Functional-style module (init/apply duck-typing the Flax surface the
+Trainer uses): stacked per-stage params cannot be expressed as ordinary
+Flax submodules, so the stage stack is built by initializing each
+GPTBlock per layer and stacking — the blocks themselves are the shared
+models/layers.py modules.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu.models.gpt import GPTBlock, GPTConfig
+from solvingpapers_tpu.models.layers import LayerNorm
+from solvingpapers_tpu.sharding.pipeline import pipeline_local_apply
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTPipeConfig:
+    vocab_size: int = 65
+    block_size: int = 256
+    dim: int = 256
+    n_layers: int = 8
+    n_heads: int = 4
+    mlp_mult: int = 4
+    dtype: str = "float32"
+    use_flash: bool = False
+    n_stages: int = 4
+    n_microbatches: int = 4
+    # True: apply inside shard_map over the 'pipe' axis with the GPipe
+    # schedule; False: sequential scan over stages (dense oracle)
+    pipeline_parallel: bool = False
+
+    def __post_init__(self):
+        if self.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {self.n_layers} not divisible by n_stages "
+                f"{self.n_stages}"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.n_layers // self.n_stages
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    def block_cfg(self) -> GPTConfig:
+        # dropout is structurally 0: the GPipe stage_fn is pure (params, x)
+        # and re-runs across schedule ticks, so per-tick rng threading would
+        # be required for well-defined masks
+        return GPTConfig(
+            vocab_size=self.vocab_size, block_size=self.block_size,
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            mlp_mult=self.mlp_mult, dropout=0.0, dtype=self.dtype,
+            use_flash=self.use_flash,
+        )
+
+
+class GPTPipe:
+    """init/apply surface compatible with Trainer + lm_loss_fn."""
+
+    def __init__(self, cfg: GPTPipeConfig):
+        self.cfg = cfg
+        self._block = GPTBlock(cfg.block_cfg())
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, rngs: dict, tokens: jax.Array) -> dict:
+        cfg = self.cfg
+        rng = rngs["params"] if isinstance(rngs, dict) else rngs
+        k_emb, k_pos, k_blocks, k_ln, k_head = jax.random.split(rng, 5)
+        dummy = jnp.zeros((1, min(tokens.shape[1], cfg.block_size), cfg.dim),
+                          cfg.compute_dtype)
+
+        def stage_init(key):
+            blocks = {}
+            for j in range(cfg.layers_per_stage):
+                blocks[f"block_{j}"] = self._block.init(
+                    jax.random.fold_in(key, j), dummy
+                )["params"]
+            return blocks
+
+        stage_list = [
+            stage_init(jax.random.fold_in(k_blocks, s))
+            for s in range(cfg.n_stages)
+        ]
+        stages = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_list)
+
+        params = {
+            "tok_emb": {
+                "embedding": nn.initializers.normal(0.02)(
+                    k_emb, (cfg.vocab_size, cfg.dim), jnp.float32
+                )
+            },
+            "pos_emb": nn.initializers.normal(0.02)(
+                k_pos, (cfg.block_size, cfg.dim), jnp.float32
+            ),
+            "stages": stages,
+            "ln_f": LayerNorm().init(k_ln, dummy)["params"],
+            "lm_head": {
+                "kernel": nn.initializers.lecun_normal()(
+                    k_head, (cfg.dim, cfg.vocab_size), jnp.float32
+                )
+            },
+        }
+        return {"params": params}
+
+    # ----------------------------------------------------------------- apply
+
+    def _stage_fn(self, stage_params, x):
+        for j in range(self.cfg.layers_per_stage):
+            x, _ = self._block.apply(
+                {"params": stage_params[f"block_{j}"]}, x, None, None, True
+            )
+        return x
+
+    def apply(
+        self,
+        variables: dict,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches=None,
+        deterministic: bool = True,
+        rngs=None,
+    ):
+        if caches is not None:
+            raise NotImplementedError(
+                "decode caches are unsupported under pipeline parallelism; "
+                "export the params and restack for the dense GPT to decode"
+            )
+        cfg = self.cfg
+        p = variables["params"]
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
+        x = x + jnp.take(p["pos_emb"], positions[0], axis=0)
+        x = x.astype(cfg.compute_dtype)
+
+        if cfg.pipeline_parallel:
+            # local stage slice has leading dim n_stages/pipe_size == 1
+            # (shard_map over in_specs P('pipe'))
+            x = pipeline_local_apply(
+                p["stages"], x, self._stage_fn,
+                n_microbatches=cfg.n_microbatches,
+            )
+        else:
+            for st in range(cfg.n_stages):
+                x = self._stage_fn(
+                    jax.tree.map(lambda a: a[st], p["stages"]), x
+                )
+
+        x = LayerNorm().apply({"params": p["ln_f"]}, x)
+        logits = (
+            x.astype(cfg.compute_dtype)
+            @ p["lm_head"]["kernel"].astype(cfg.compute_dtype)
+        )
+        return logits, None
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.block_size

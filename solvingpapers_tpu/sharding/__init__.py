@@ -19,6 +19,7 @@ from solvingpapers_tpu.sharding.mesh import (
 from solvingpapers_tpu.sharding.rules import (
     GPT_RULES,
     LM_RULES,
+    PP_RULES,
     param_specs,
     param_shardings,
 )

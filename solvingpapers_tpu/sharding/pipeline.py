@@ -68,6 +68,26 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
     return jax.lax.psum(out, axis_name)
 
 
+def pipeline_local_apply(
+    stage_params,
+    x: jax.Array,
+    stage_fn,
+    *,
+    n_microbatches: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Per-device GPipe entry for callers already inside shard_map (e.g. a
+    pipeline-parallel model's forward): splits x (batch, ...) into
+    microbatches, runs the schedule, and restores the batch shape.
+    stage_params is this device's stage slice (leading stage dim 1)."""
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+    micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+    out = _pipeline_local(stage_params, micro, stage_fn, axis_name)
+    return out.reshape(b, *x.shape[1:])
+
+
 def pipeline_apply(
     stage_params,
     x: jax.Array,

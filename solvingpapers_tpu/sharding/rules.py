@@ -39,6 +39,15 @@ LM_RULES: list[tuple[str, P]] = [
 
 GPT_RULES = LM_RULES  # shared naming makes the generic table sufficient
 
+# Pipeline-parallel models (models/gpt_pipe.py): stage-stacked decoder
+# params live under a top-level 'stages' key whose leading dim is the stage
+# axis — sharded over 'pipe' so each device stores only its stage. The
+# rest of the table applies to the replicated embedding/norm/head.
+# (^|/) rather than ^: rules are applied to whole TrainState trees, where
+# the same leaves appear under params/stages/... and opt_state/.../stages/...
+# — the optimizer moments shard per stage exactly like the params.
+PP_RULES: list[tuple[str, P]] = [(r"(^|/)stages/", P("pipe"))] + LM_RULES
+
 
 def _path_str(path) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)

@@ -71,6 +71,11 @@ class TrainConfig:
     # runs the ppermute ring / Ulysses all_to_all). Params are replicated
     # across 'context'; composes with the data axes.
     context_parallel: bool = False
+    # pipeline parallelism: the model's stage-stacked decoder params (under
+    # a top-level 'stages' key, models/gpt_pipe.py) are sharded over the
+    # mesh 'pipe' axis and the loss runs inside shard_map with the GPipe
+    # microbatch schedule. Composes with the data axis; use rules=PP_RULES.
+    pipeline_parallel: bool = False
 
 
 def lm_loss_fn(model, params, batch, rng, model_state, train):
@@ -186,18 +191,10 @@ class Trainer:
         across the batch/context axes, and the per-shard loss pmean'd back
         to the global mean (equal shard sizes make that exact). Gradients
         through shard_map psum across shards automatically."""
-        axes = ("data", "fsdp", "context")
-        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-        # fsdp is rejected too: in_specs=P() would re-gather the full params
-        # and grads on every device each step — a silent memory regression
-        # at exactly the scale CP targets
-        bad = {a: sizes[a] for a in ("fsdp", "model", "expert", "pipe")
-               if sizes.get(a, 1) > 1}
-        if bad:
-            raise NotImplementedError(
-                f"context_parallel replicates params inside shard_map and "
-                f"does not compose with {bad} axes yet"
-            )
+        self._reject_axes(
+            "context_parallel", ("fsdp", "model", "expert", "pipe"),
+            "replicates params inside shard_map",
+        )
         if not getattr(getattr(self.model, "cfg", None), "context_parallel", False):
             raise ValueError(
                 "TrainConfig.context_parallel=True but the model was not "
@@ -206,20 +203,78 @@ class Trainer:
                 "positions restarting at 0) and train a silently wrong "
                 "objective"
             )
+        # decorrelate dropout across every shard: each holds a different
+        # (batch, sequence) slice
+        return self._shard_map_loss_call(
+            ("data", "fsdp", "context"), P(), rng_axes=("data", "fsdp", "context")
+        )
+
+    def _pp_loss_call(self):
+        """Build the pipeline-parallel loss: stage-stacked params (leading
+        stage dim under 'stages') are sharded over 'pipe'; inside shard_map
+        the model runs the GPipe ppermute schedule (models/gpt_pipe.py).
+        Every pipe device computes the identical global loss (the pipeline
+        output is psum-broadcast), so the pmean over 'pipe' is exact."""
+        self._reject_axes(
+            "pipeline_parallel", ("fsdp", "model", "expert", "context"),
+            "replicates non-stage params inside shard_map",
+        )
+        mcfg = getattr(self.model, "cfg", None)
+        if not getattr(mcfg, "pipeline_parallel", False):
+            raise ValueError(
+                "TrainConfig.pipeline_parallel=True but the model was not "
+                "built with pipeline_parallel=True: it would scan stages "
+                "sequentially on every pipe device"
+            )
+        pipe = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("pipe", 1)
+        if getattr(mcfg, "n_stages", None) != pipe:
+            raise ValueError(
+                f"model n_stages ({getattr(mcfg, 'n_stages', None)}) must "
+                f"equal the mesh 'pipe' axis size ({pipe}): the GPipe body "
+                "holds exactly one stage per device"
+            )
+
+        def param_spec(path, _leaf):
+            key = getattr(path[0], "key", None) if path else None
+            return P("pipe") if key == "stages" else P()
+
+        # identical rng on every pipe device (they compute the same loss);
+        # decorrelate only across data shards. The loss is already
+        # invariant over 'pipe' (the pipeline output is psum-broadcast),
+        # so only the data axes are reduced.
+        return self._shard_map_loss_call(
+            ("data", "fsdp"), param_spec, rng_axes=("data", "fsdp")
+        )
+
+    def _reject_axes(self, mode: str, axes: tuple, why: str) -> None:
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        bad = {a: sizes[a] for a in axes if sizes.get(a, 1) > 1}
+        if bad:
+            raise NotImplementedError(
+                f"{mode} {why} and does not compose with {bad} axes yet"
+            )
+
+    def _shard_map_loss_call(self, axes, param_in_specs, rng_axes):
+        """Common shard_map loss wrapper for CP/PP. `param_in_specs` is a
+        spec pytree/prefix, or a (path, leaf) -> P function evaluated
+        against the abstract params at call time."""
         batch_specs = self._batch_specs()
 
         def call(params, model_state, batch, rng, train):
             if model_state is not None:
                 raise NotImplementedError(
-                    "context_parallel with model_state (e.g. MoE routing "
-                    "bias): per-shard state updates would silently diverge; "
-                    "psum the state update inside the loss_fn first"
+                    "shard_map-composed training with model_state (e.g. MoE "
+                    "routing bias): per-shard state updates would silently "
+                    "diverge; psum the state update inside the loss_fn first"
                 )
+            p_specs = (
+                jax.tree_util.tree_map_with_path(param_in_specs, params)
+                if callable(param_in_specs)
+                else param_in_specs
+            )
 
             def local(params, batch, rng):
-                # decorrelate dropout across shards; loss_fn sees the local
-                # (B/data, S/context) shard and computes its local mean
-                rng = jax.random.fold_in(rng, jax.lax.axis_index(axes))
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(rng_axes))
                 loss, aux, _ = self.loss_fn(
                     self.model, params, batch, rng, None, train
                 )
@@ -232,7 +287,7 @@ class Trainer:
 
             loss, aux = jax.shard_map(
                 local, mesh=self.mesh,
-                in_specs=(P(), batch_specs, P()),
+                in_specs=(p_specs, batch_specs, P()),
                 out_specs=(P(), P()),
             )(params, batch, rng)
             return loss, aux, None
@@ -241,8 +296,15 @@ class Trainer:
 
     def _build_steps(self):
         replicated = NamedSharding(self.mesh, P())
+        if self.config.context_parallel and self.config.pipeline_parallel:
+            raise NotImplementedError(
+                "context_parallel + pipeline_parallel composition is not "
+                "supported yet"
+            )
         if self.config.context_parallel:
             loss_call = self._cp_loss_call()
+        elif self.config.pipeline_parallel:
+            loss_call = self._pp_loss_call()
         else:
             loss_call = lambda params, ms, batch, rng, train: self.loss_fn(  # noqa: E731
                 self.model, params, batch, rng, ms, train
