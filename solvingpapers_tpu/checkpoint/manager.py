@@ -19,24 +19,36 @@ import orbax.checkpoint as ocp
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, keep_n: int = 3, save_every: int = 1000):
+    def __init__(self, directory: str, keep_n: int = 3, save_every: int = 1000,
+                 async_saves: bool = True):
+        """`async_saves`: periodic saves return as soon as the on-device
+        state is snapshotted and serialize to disk in a background thread
+        (SURVEY.md §5 "Orbax async checkpointing" — the step loop keeps
+        running instead of stalling for the full write). Forced saves
+        (final / preemption) always block until durable."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.save_every = save_every
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=keep_n, create=True, enable_async_checkpointing=False
+                max_to_keep=keep_n, create=True,
+                enable_async_checkpointing=async_saves,
             ),
         )
 
     def maybe_save(self, step: int, state: Any, force: bool = False) -> bool:
         if not force and (self.save_every <= 0 or step % self.save_every):
             return False
+        if force:
+            # settle in-flight async saves so the dedupe check below sees
+            # them, then block until this save is durable
+            self._mgr.wait_until_finished()
         if step in self._mgr.all_steps():
             return False  # already saved (e.g. preemption save after periodic)
         self._mgr.save(step, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
+        if force:
+            self._mgr.wait_until_finished()
         return True
 
     def latest_step(self) -> int | None:

@@ -8,6 +8,8 @@ shared + non-expert) parameter count, not the total.
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 
 # bf16 peak TFLOP/s per chip by TPU generation (public spec sheets).
@@ -21,6 +23,8 @@ _PEAK_TFLOPS = {
     "v6e": 918e12,
 }
 
+_warned_kinds: set[str] = set()
+
 
 def chip_peak_flops(device=None) -> float:
     device = device or jax.devices()[0]
@@ -28,6 +32,16 @@ def chip_peak_flops(device=None) -> float:
     for key, val in _PEAK_TFLOPS.items():
         if key in kind:
             return val
+    # unknown device: a silent wrong peak would silently mis-scale every
+    # MFU number, so say which kind fell through and what was assumed
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        warnings.warn(
+            f"chip_peak_flops: unrecognized device_kind {kind!r}; assuming "
+            "v5e peak (197 TFLOP/s bf16) — MFU numbers will be mis-scaled "
+            "if this is a different chip",
+            stacklevel=2,
+        )
     return 197e12  # conservative default: v5e
 
 

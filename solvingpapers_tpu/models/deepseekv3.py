@@ -240,6 +240,17 @@ class MoELayer(nn.Module):
             bias.value = ops.moe.aux_free_bias_update(
                 probs, bias.value, cfg.aux_free_bias_update_rate
             )
+
+        if self.is_mutable_collection("moe_metrics"):
+            # load-balance observability (SURVEY.md hard part #1): sown per
+            # layer, aggregated into train metrics by dsv3_loss_fn
+            stats = ops.moe.load_balance_stats(probs)
+            stats["drop_fraction"] = (
+                jnp.zeros(()) if cfg.moe_impl == "dense"
+                else ops.moe.dispatch_drop_fraction(probs, cap)
+            )
+            stats["bias_norm"] = jnp.linalg.norm(bias.value)
+            self.sow("moe_metrics", "stats", stats)
         return out.reshape(b, s, d).astype(x.dtype)
 
 

@@ -50,6 +50,11 @@ class TrainConfig:
     ckpt_every: int = 0  # 0 = disabled
     checkpoint_dir: str | None = None
     keep_n: int = 3
+    # periodic saves return after the device->host snapshot and write to
+    # disk in a background thread (final/preemption saves always block);
+    # safe with donated step buffers because Orbax completes the D2H copy
+    # before save() returns
+    async_checkpointing: bool = True
     seed: int = 0
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
@@ -387,7 +392,8 @@ class Trainer:
         ckpt = None
         start_step = int(jax.device_get(state.step))
         if cfg.checkpoint_dir and cfg.ckpt_every > 0:
-            ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.keep_n, cfg.ckpt_every)
+            ckpt = CheckpointManager(cfg.checkpoint_dir, cfg.keep_n, cfg.ckpt_every,
+                                     async_saves=cfg.async_checkpointing)
             restored = ckpt.restore_latest(_pure_state(state))
             if restored is not None:
                 pure, start_step = restored
@@ -496,8 +502,14 @@ class Trainer:
                                 )
                     writer.write(step + 1, {k: float(v) for k, v in metrics.items()})
 
-                if ckpt is not None:
+                if ckpt is not None and ckpt.save_every > 0 \
+                        and (step + 1) % ckpt.save_every == 0:
+                    # keep the save (fence + D2H snapshot; the disk write is
+                    # already async) out of step timing, like eval/callbacks
+                    jax.device_get(metrics["train_loss"])
+                    t_save = time.perf_counter()
                     ckpt.maybe_save(step + 1, _pure_state(state))
+                    t_prev += time.perf_counter() - t_save
 
             # unconditional: maybe_save dedupes existing steps, and a signal
             # landing during the final iteration must not lose the run

@@ -63,6 +63,24 @@ def dsv3_init_fn(model, rngs, batch):
     return variables["params"], {"moe_state": variables["moe_state"]}
 
 
+def _aggregate_moe_metrics(collection) -> dict:
+    """Mean each sown per-layer MoE stat (models/deepseekv3.py MoELayer)
+    into one train-metric scalar: moe_load_entropy, moe_load_max_fraction,
+    moe_drop_fraction, moe_bias_norm."""
+    layer_stats = jax.tree.leaves(
+        collection,
+        is_leaf=lambda x: isinstance(x, dict) and "load_entropy" in x,
+    )
+    layer_stats = [s for s in layer_stats if isinstance(s, dict)]
+    if not layer_stats:
+        return {}
+    keys = layer_stats[0].keys()
+    return {
+        f"moe_{k}": jnp.mean(jnp.stack([s[k] for s in layer_stats]))
+        for k in keys
+    }
+
+
 def dsv3_loss_fn(model, params, batch, rng, model_state, train):
     """DeepSeekV3 objective: next-token CE (+ weighted MTP loss when
     mtp_heads > 0), threading the mutable MoE routing bias through the step
@@ -72,15 +90,17 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
     use_mtp = cfg.mtp_heads > 0
     variables = {"params": params, **(model_state or {})}
     kwargs = dict(deterministic=not train, return_mtp=use_mtp)
+    moe_metrics = {}
     if train:
         (out, _), mutated = model.apply(
             variables,
             batch["x"],
             rngs={"dropout": rng},
-            mutable=["moe_state"],
+            mutable=["moe_state", "moe_metrics"],
             **kwargs,
         )
         new_ms = {"moe_state": mutated["moe_state"]}
+        moe_metrics = _aggregate_moe_metrics(mutated.get("moe_metrics", {}))
     else:
         out, _ = model.apply(variables, batch["x"], **kwargs)
         new_ms = model_state
@@ -90,7 +110,7 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
         logits, mtp_logits = out, None
 
     main = ops.cross_entropy(logits, batch["y"])
-    aux = {"perplexity": jnp.exp(main)}
+    aux = {"perplexity": jnp.exp(main), **moe_metrics}
     loss = main
     if mtp_logits is not None:
         # mtp_loss wants the stream shifted so head j's target is token
