@@ -232,7 +232,7 @@ def _llama3_long() -> RunConfig:
         model=LlamaConfig(
             vocab_size=50257, max_seq_len=32_768, dim=1024, n_layers=16,
             n_heads=16, n_kv_heads=8, dropout=0.0, dtype="bfloat16",
-            context_parallel=True,
+            context_parallel=True, use_flash=True,
         ),
         train=TrainConfig(
             steps=10_000, batch_size=8, log_every=50, eval_every=500,
@@ -328,7 +328,9 @@ def _llama3_long_smoke() -> RunConfig:
         model=LlamaConfig(
             vocab_size=256, max_seq_len=256, dim=64, n_layers=2,
             n_heads=4, n_kv_heads=2, dropout=0.0, dtype="float32",
-            context_parallel=True,
+            # flash on: the smoke exercises the same ring-flash core as
+            # llama3_long (interpret-mode kernel on the CPU mesh)
+            context_parallel=True, use_flash=True,
         ),
         train=TrainConfig(
             steps=20, batch_size=4, log_every=5, eval_every=10,

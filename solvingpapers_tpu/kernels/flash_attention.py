@@ -78,6 +78,14 @@ def _pick_block(seq: int, requested: int) -> int:
     return max(block, 1)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-axes metadata, so the
+    pallas_calls here are usable directly inside shard_map under the vma
+    checker (jax 0.9) — e.g. as the per-chunk core of ring attention."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 def _uid(i, j, kb, num_j, num_kb):
     """Flat (q-block, kv-block) id shared by fwd and both bwd kernels so
     dropout masks regenerate identically: (i*num_j + j)*num_kb + kb."""
@@ -224,8 +232,8 @@ def _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal, block_q, block_k,
             pl.BlockSpec((1, 1, block_q), lambda i, j, kb: (i, 0, j)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bn, seq_q, d), q3.dtype),
-            jax.ShapeDtypeStruct((bn, 1, seq_q), jnp.float32),
+            _sds((bn, seq_q, d), q3.dtype, q3),
+            _sds((bn, 1, seq_q), jnp.float32, q3),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -373,12 +381,9 @@ def _flash_fwd(q3, k3, v3, seed, heads, scale, causal, blocks, dropout_rate,
 def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
     q3, k3, v3, seed, o, lse = res
     n_heads, n_kv = heads
-    block_q, block_k = blocks
     bn, seq_q, d = q3.shape
     seq_k = k3.shape[1]
     group = n_heads // n_kv
-    num_qb = seq_q // block_q
-    num_kb = seq_k // block_k
 
     if group > 1:  # materialize repeated kv for the backward pass
         bkv = k3.shape[0]
@@ -391,6 +396,32 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)[:, None, :]
 
+    dq, dk_r, dv_r = _bwd_chunk(
+        q3, k3r, v3r, do, lse, delta, seed, scale=scale, causal=causal,
+        block_q=blocks[0], block_k=blocks[1], dropout_rate=dropout_rate,
+        interpret=interpret,
+    )
+
+    if group > 1:  # reduce repeated-head grads back to kv heads
+        b = bn // n_heads
+        fold = lambda x: x.reshape(b, n_kv, group, seq_k, d).sum(axis=2).reshape(  # noqa: E731
+            b * n_kv, seq_k, d
+        )
+        dk_r, dv_r = fold(dk_r), fold(dv_r)
+    # seed is integer-typed: no cotangent
+    return dq, dk_r.astype(k3.dtype), dv_r.astype(v3.dtype), None
+
+
+def _bwd_chunk(q3, k3r, v3r, do, lse, delta, seed, *, scale, causal,
+               block_q, block_k, dropout_rate, interpret):
+    """dq/dk/dv pallas sweeps for one (q, kv) pair with kv already repeated
+    to q heads. Shared by the full backward above and the ring-flash
+    backward (sharding/ring_attention.py), which runs it once per rotating
+    kv chunk with the GLOBAL lse/delta."""
+    bn, seq_q, d = q3.shape
+    seq_k = k3r.shape[1]
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
     offset = seq_k - seq_q
 
     def kv_index_rep(i, j, kb):
@@ -402,7 +433,7 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          offset=seq_k - seq_q, dropout_rate=dropout_rate,
+                          offset=offset, dropout_rate=dropout_rate,
                           num_qb=num_qb, num_kb=num_kb),
         grid=(bn, num_qb, num_kb),
         in_specs=[
@@ -415,7 +446,7 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct(q3.shape, q3.dtype),
+        out_shape=_sds(q3.shape, q3.dtype, q3),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
@@ -435,7 +466,7 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
 
     dk_r, dv_r = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          offset=seq_k - seq_q, dropout_rate=dropout_rate,
+                          offset=offset, dropout_rate=dropout_rate,
                           num_qb=num_qb, num_kb=num_kb),
         grid=(bn, num_kb, num_qb),
         in_specs=[
@@ -452,8 +483,8 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda i, kb, jb: (i, kb, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bn, seq_k, d), k3.dtype),
-            jax.ShapeDtypeStruct((bn, seq_k, d), v3.dtype),
+            _sds((bn, seq_k, d), k3r.dtype, k3r),
+            _sds((bn, seq_k, d), v3r.dtype, k3r),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -463,14 +494,7 @@ def _flash_bwd(heads, scale, causal, blocks, dropout_rate, interpret, res, do):
         interpret=interpret,
     )(q3, k3r, v3r, do, lse, delta, seed)
 
-    if group > 1:  # reduce repeated-head grads back to kv heads
-        b = bn // n_heads
-        fold = lambda x: x.reshape(b, n_kv, group, seq_k, d).sum(axis=2).reshape(  # noqa: E731
-            b * n_kv, seq_k, d
-        )
-        dk_r, dv_r = fold(dk_r), fold(dv_r)
-    # seed is integer-typed: no cotangent
-    return dq, dk_r.astype(k3.dtype), dv_r.astype(v3.dtype), None
+    return dq, dk_r, dv_r
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -145,6 +145,7 @@ class Attention(nn.Module):
         elif self.context_parallel:
             from solvingpapers_tpu.sharding.ring_attention import (
                 ring_attention_local,
+                ring_flash_attention_local,
                 ulysses_attention_local,
             )
 
@@ -155,10 +156,14 @@ class Attention(nn.Module):
                 )
             if self.context_impl == "ring":
                 # GQA kv heads stay un-repeated: the ring repeats them after
-                # each transfer so ppermute carries only n_kv heads
-                out = ring_attention_local(
-                    q, k, v, self.context_axis, causal=self.causal
+                # each transfer so ppermute carries only n_kv heads.
+                # use_flash swaps the per-chunk jnp einsum core for the
+                # Pallas kernel (custom-VJP ring backward).
+                ring = (
+                    ring_flash_attention_local if self.use_flash
+                    else ring_attention_local
                 )
+                out = ring(q, k, v, self.context_axis, causal=self.causal)
             elif self.context_impl == "ulysses":
                 if self.use_flash:
                     from solvingpapers_tpu.kernels import flash_attention

@@ -113,6 +113,254 @@ def ring_attention(
     )(q, k, v)
 
 
+def _ring_merge(m, l, acc, o_c, lse_c):
+    """Online-softmax merge of one chunk's flash output into the running
+    (m, l, acc): o_c is the chunk-normalized output, lse_c its per-row
+    logsumexp, so o_c * exp(lse_c - m_new) recovers the unnormalized
+    accumulator exactly."""
+    m_new = jnp.maximum(m, lse_c)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(lse_c - m_new)
+    l_new = l * alpha + beta
+    acc_new = acc * alpha[..., None] + o_c * beta[..., None]
+    return m_new, l_new, acc_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q3, k3, v3, axis_name, heads, scale, causal, blocks,
+                interpret):
+    out, _ = _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale,
+                                  causal, blocks, interpret)
+    return out
+
+
+def _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale, causal, blocks,
+                         interpret):
+    """Forward ring: rotate kv chunks via ppermute, run the Pallas flash
+    kernel per chunk, merge with the online softmax. The schedule is
+    branch-free (a traced branch over pallas calls trips XLA's closed_call
+    lowering cache): step 0 is statically the diagonal (causal kernel);
+    all later steps run the non-causal kernel unconditionally and
+    causally-invisible chunks are masked out of the merge — the same
+    uniform schedule the jnp ring uses. Returns the normalized local
+    output and its GLOBAL per-row lse (what the backward kernels need)."""
+    from solvingpapers_tpu.kernels.flash_attention import _fwd
+
+    n_heads, n_kv = heads
+    block_q, block_k = blocks
+    bn, s_loc, d = q3.shape
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    seed = jnp.zeros((1,), jnp.int32)
+
+    m0 = jnp.full_like(q3[..., 0], BIG_NEG, dtype=jnp.float32)  # (bn, s)
+    l0 = jnp.zeros_like(m0)
+    acc0 = jnp.zeros_like(q3, dtype=jnp.float32)
+
+    # step 0: every device holds its own (diagonal) chunk
+    o0, lse0 = _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal,
+                    block_q, block_k, 0.0, interpret)
+    m, l, acc = _ring_merge(m0, l0, acc0, o0.astype(jnp.float32),
+                            lse0[:, 0, :])
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        o_c, lse_c = _fwd(q3, k_cur, v_cur, seed, n_heads, n_kv, scale,
+                          False, block_q, block_k, 0.0, interpret)
+        lse_c = lse_c[:, 0, :]
+        if causal:
+            # chunk src = (my - i) % size is visible iff it is globally
+            # earlier; invisible chunks contribute zero mass via lse
+            src = (my_idx - i) % axis_size
+            lse_c = jnp.where(src < my_idx, lse_c, BIG_NEG)
+        m, l, acc = _ring_merge(m, l, acc, o_c.astype(jnp.float32), lse_c)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m, l, acc, k_nxt, v_nxt), None
+
+    k1 = jax.lax.ppermute(k3, axis_name, perm)
+    v1 = jax.lax.ppermute(v3, axis_name, perm)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m, l, acc, k1, v1), jnp.arange(1, axis_size)
+    )
+    # guard fully-masked rows (no visible kv anywhere) like the kernel does
+    safe_l = jnp.where(l > 0.0, l, 1.0)
+    out = (acc / safe_l[..., None]).astype(q3.dtype)
+    lse_g = jnp.where(l > 0.0, m + jnp.log(safe_l), 0.0)[:, None, :]  # (bn,1,s)
+    return out, lse_g
+
+
+def _ring_flash_vjp_fwd(q3, k3, v3, axis_name, heads, scale, causal, blocks,
+                        interpret):
+    out, lse_g = _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale,
+                                      causal, blocks, interpret)
+    return out, (q3, k3, v3, out, lse_g)
+
+
+def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks, interpret,
+                        res, do):
+    """Backward ring: rotate (k, v, dk, dv) together; each step runs the
+    shared _bwd_chunk pallas sweeps against the resident chunk with the
+    GLOBAL lse/delta, accumulating dq locally and dk/dv onto the traveling
+    chunk. After a full cycle the dk/dv land back on their home device."""
+    from solvingpapers_tpu.kernels.flash_attention import _bwd_chunk
+
+    q3, k3, v3, out, lse_g = res
+    n_heads, n_kv = heads
+    group = n_heads // n_kv
+    block_q, block_k = blocks
+    bn, s_loc, d = q3.shape
+    bkv = k3.shape[0]
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    seed = jnp.zeros((1,), jnp.int32)
+
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)[:, None, :]
+
+    def rep(x):
+        if group == 1:
+            return x
+        return jnp.repeat(
+            x.reshape(bkv // n_kv, n_kv, s_loc, d), group, axis=1
+        ).reshape(bn, s_loc, d)
+
+    def fold(x):
+        if group == 1:
+            return x
+        b = bn // n_heads
+        return x.reshape(b, n_kv, group, s_loc, d).sum(axis=2).reshape(
+            bkv, s_loc, d
+        )
+
+    def chunk_bwd(k_cur, v_cur, is_causal, lse_in):
+        dq, dk_r, dv_r = _bwd_chunk(
+            q3, rep(k_cur), rep(v_cur), do, lse_in, delta, seed,
+            scale=scale, causal=is_causal, block_q=block_q,
+            block_k=block_k, dropout_rate=0.0, interpret=interpret,
+        )
+        return (dq.astype(jnp.float32), fold(dk_r).astype(jnp.float32),
+                fold(dv_r).astype(jnp.float32))
+
+    # step 0: the diagonal chunk, statically causal — no masking needed
+    dq_acc, dk_cur, dv_cur = chunk_bwd(k3, v3, causal, lse_g)
+
+    def step(carry, i):
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        lse_in = lse_g
+        if causal:
+            # invisible chunks (globally later than this q shard) must
+            # contribute nothing. Mask BEFORE the kernel's exp(s - lse)
+            # (push lse to +huge so p underflows to exactly 0): a post-hoc
+            # grad * 0.0 would turn an exp overflow from unmasked outlier
+            # scores into inf * 0 = NaN
+            src = (my_idx - i) % axis_size
+            lse_in = jnp.where(src < my_idx, lse_g,
+                               jnp.full_like(lse_g, -BIG_NEG))
+        dq_c, dk_c, dv_c = chunk_bwd(k_cur, v_cur, False, lse_in)
+        dq_acc = dq_acc + dq_c
+        dk_cur = dk_cur + dk_c
+        dv_cur = dv_cur + dv_c
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq_acc, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    # rotate (k, v) once so the scan sees chunks src = my-1, my-2, ...;
+    # (dk, dv) ride along so each lands home after the full cycle
+    k1 = jax.lax.ppermute(k3, axis_name, perm)
+    v1 = jax.lax.ppermute(v3, axis_name, perm)
+    dk1 = jax.lax.ppermute(dk_cur, axis_name, perm)
+    dv1 = jax.lax.ppermute(dv_cur, axis_name, perm)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq_acc, k1, v1, dk1, dv1), jnp.arange(1, axis_size)
+    )
+    # rotation count check: 1 pre-rotation + (size-1) end-of-step rotations
+    # = size total, so every dk/dv chunk is back on its home device, with
+    # the last contribution added before the final rotation
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-chunk core
+    (VERDICT r1 item 7): call inside shard_map with the sequence sharded
+    over `axis_name`. Same layout contract as ring_attention_local —
+    q: (B, S_loc, N, H), k/v: (B, S_loc, Nkv, H), GQA kv heads travel
+    un-repeated (ppermute carries only Nkv heads; repetition happens per
+    chunk inside the kernels). The (S, S) score matrix never exists on any
+    device, and each chunk's inner loop is the MXU-tiled kernel instead of
+    a jnp einsum."""
+    from solvingpapers_tpu.kernels.flash_attention import (
+        DEFAULT_BLOCK,
+        _pick_block,
+    )
+
+    b, s_loc, n, h = q.shape
+    n_kv = k.shape[2]
+    if n % n_kv:
+        raise ValueError(f"q heads {n} not a multiple of kv heads {n_kv}")
+    if scale is None:
+        scale = h**-0.5
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    bq = _pick_block(s_loc, block_q or DEFAULT_BLOCK)
+    bk = _pick_block(s_loc, block_k or DEFAULT_BLOCK)
+
+    q3 = q.transpose(0, 2, 1, 3).reshape(b * n, s_loc, h)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, s_loc, h)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * n_kv, s_loc, h)
+    o3 = _ring_flash(
+        q3, k3, v3, axis_name, (n, n_kv), float(scale), bool(causal),
+        (bq, bk), interpret,
+    )
+    return o3.reshape(b, n, s_loc, h).transpose(0, 2, 1, 3)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    axis_name: str = "context",
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Full-array entry point for ring_flash_attention_local (tests/bench).
+
+    check_vma=False: a pallas_call inside lax.scan under the jax-0.9 vma
+    checker KeyErrors in the closed_call lowering cache; the computation is
+    identical either way (verified against dense).
+    """
+    spec = P(("data", "fsdp"), axis_name, None, None)
+    fn = functools.partial(
+        ring_flash_attention_local, axis_name=axis_name, causal=causal,
+        scale=scale, interpret=interpret,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
 def ulysses_attention_local(
     q: jax.Array,
     k: jax.Array,

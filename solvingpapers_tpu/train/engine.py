@@ -138,7 +138,7 @@ class Trainer:
                 out = jax.shard_map(
                     lambda r, b: self.init_fn(self.model, r, b),
                     mesh=self.mesh, in_specs=(P(), self._batch_specs()),
-                    out_specs=P(),
+                    out_specs=P(), check_vma=self._check_vma(),
                 )(rngs, example_batch)
             else:
                 out = self.init_fn(self.model, rngs, example_batch)
@@ -211,7 +211,8 @@ class Trainer:
         # decorrelate dropout across every shard: each holds a different
         # (batch, sequence) slice
         return self._shard_map_loss_call(
-            ("data", "fsdp", "context"), P(), rng_axes=("data", "fsdp", "context")
+            ("data", "fsdp", "context"), P(),
+            rng_axes=("data", "fsdp", "context"),
         )
 
     def _pp_loss_call(self):
@@ -259,11 +260,19 @@ class Trainer:
                 f"{mode} {why} and does not compose with {bad} axes yet"
             )
 
+    def _check_vma(self) -> bool:
+        """vma checking must be off whenever the model's attention core is
+        a pallas kernel: a pallas_call inside lax.scan under the jax-0.9
+        vma checker KeyErrors in the closed_call lowering cache. One gate
+        for every shard_map this Trainer builds (CP loss, PP loss, CP init)."""
+        return not getattr(getattr(self.model, "cfg", None), "use_flash", False)
+
     def _shard_map_loss_call(self, axes, param_in_specs, rng_axes):
         """Common shard_map loss wrapper for CP/PP. `param_in_specs` is a
         spec pytree/prefix, or a (path, leaf) -> P function evaluated
         against the abstract params at call time."""
         batch_specs = self._batch_specs()
+        check_vma = self._check_vma()
 
         def call(params, model_state, batch, rng, train):
             if model_state is not None:
@@ -293,7 +302,7 @@ class Trainer:
             loss, aux = jax.shard_map(
                 local, mesh=self.mesh,
                 in_specs=(p_specs, batch_specs, P()),
-                out_specs=(P(), P()),
+                out_specs=(P(), P()), check_vma=check_vma,
             )(params, batch, rng)
             return loss, aux, None
 

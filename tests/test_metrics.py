@@ -57,3 +57,36 @@ def test_active_param_count_discounts_routed_experts():
     assert active == total - (3 * 8 * 24 - 3 * 8 * 24 * 2 // 8)
     # without MoE info: plain total
     assert mfu_mod.active_param_count(params) == total
+
+
+def test_parity_regression_check():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "parity_suite",
+        pathlib.Path(__file__).parent.parent / "tools" / "parity_suite.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    history = [{"workloads": {
+        "gpt_shakespeare": {"steps": 1000, "val_loss": 1.90},
+        "vit_mnist": {"steps": 1200, "val_accuracy": 0.97},
+    }}]
+    ok = {"workloads": {
+        "gpt_shakespeare": {"steps": 1000, "val_loss": 1.91},  # within tol
+        "vit_mnist": {"steps": 1200, "val_accuracy": 0.975},
+    }}
+    assert mod.check_regressions(history, ok) == []
+    bad = {"workloads": {
+        "gpt_shakespeare": {"steps": 1000, "val_loss": 2.10},
+        "vit_mnist": {"steps": 1200, "val_accuracy": 0.91},
+    }}
+    flags = mod.check_regressions(history, bad)
+    assert len(flags) == 2, flags
+    # different step counts must not be compared
+    other = {"workloads": {
+        "gpt_shakespeare": {"steps": 125, "val_loss": 3.0},
+    }}
+    assert mod.check_regressions(history, other) == []

@@ -1,0 +1,206 @@
+"""Quality-parity harness: a pinned, deterministic convergence suite run
+every round, with round-over-round regression tracking
+(`artifacts/parity/parity.json`).
+
+The reference's recorded quality numbers (BASELINE.md / SURVEY.md §6):
+gpt val loss 1.8871 @ 1k steps (gpt-jax.ipynb cell 18), dsv3 loss
+2.90068/ppl 18.18644 @ 10k (deepseekv3/readme.md:73), ViT 97.25%, KD
+97.50%. TinyStories/MNIST/Shakespeare are not fetchable here (zero
+egress), so the suite pins the SAME synthetic corpora every round (char
+corpus seed 0; separable image set) — numbers are comparable across
+rounds and regressions are flagged, while real-data parity runs remain a
+hardware/data question, not a code one: pass --data-path / --image-path
+with local copies of the real sets to produce the reference-comparable
+numbers with no code change.
+
+Usage: python tools/parity_suite.py [--round N] [--fast]
+  --fast trims step counts ~8x (CI smoke); default is the full pinned
+  schedule (~10 min on one v5e chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+REGRESSION_TOL = {  # metric -> allowed worsening vs the best prior round
+    "val_loss": 0.05,
+    "accuracy": -0.01,  # may drop at most 1 point
+}
+
+
+def _run_lm(name: str, steps: int, data_path: str | None):
+    import jax
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import (
+        build_char_lm_run,
+        init_fn_for,
+        loss_fn_for,
+        rules_for,
+    )
+    from solvingpapers_tpu.sharding import batch_sharding, create_mesh
+    from solvingpapers_tpu.train import Trainer
+
+    cfg = get_config(name, steps=steps)
+    if data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": data_path})
+    mesh = create_mesh(cfg.train.mesh)
+    cfg, model, _, train_iter, eval_iter_fn = build_char_lm_run(
+        cfg, sharding=batch_sharding(mesh)
+    )
+    trainer = Trainer(model, cfg.train, loss_fn=loss_fn_for(cfg),
+                      init_fn=init_fn_for(cfg), mesh=mesh, rules=rules_for(cfg))
+    t0 = time.perf_counter()
+    state = trainer.fit(train_iter)
+    val = trainer.evaluate(state, eval_iter_fn())
+    wall = time.perf_counter() - t0
+    out = {"steps": steps, "wall_s": round(wall, 1)}
+    out.update({k: round(float(v), 5) for k, v in val.items()})
+    return out
+
+
+def _run_image(name: str, steps: int, image_path: str | None):
+    import jax
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.sharding import create_mesh
+
+    cfg = get_config(name, steps=steps)
+    if image_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": image_path})
+    mesh = create_mesh(cfg.train.mesh)
+    t0 = time.perf_counter()
+    if cfg.model_family == "kd":
+        from solvingpapers_tpu.configs.factory import build_image_run
+        from solvingpapers_tpu.models.kd import MLPClassifier, teacher_config
+        from solvingpapers_tpu.train import Trainer, make_kd_loss_fn
+
+        _, train_iter, eval_iter_fn, cls_loss = build_image_run(cfg, mesh=mesh)
+        t_cfg = dataclasses.replace(
+            cfg.train, steps=max(steps // 2, 1), checkpoint_dir=None, ckpt_every=0
+        )
+        teacher = MLPClassifier(teacher_config(dtype=cfg.model.dtype))
+        t_state = Trainer(teacher, t_cfg, loss_fn=cls_loss, mesh=mesh).fit(
+            train_iter
+        )
+        student = MLPClassifier(cfg.model)
+        kd_loss = make_kd_loss_fn(teacher, jax.device_get(t_state.params))
+        trainer = Trainer(student, cfg.train, loss_fn=kd_loss, mesh=mesh)
+        state = trainer.fit(train_iter)
+        val = trainer.evaluate(state, eval_iter_fn())
+    else:
+        from solvingpapers_tpu.configs.factory import build_image_run
+        from solvingpapers_tpu.train import Trainer
+
+        model, train_iter, eval_iter_fn, loss_fn = build_image_run(cfg, mesh=mesh)
+        trainer = Trainer(model, cfg.train, loss_fn=loss_fn, mesh=mesh)
+        state = trainer.fit(train_iter)
+        val = trainer.evaluate(state, eval_iter_fn())
+    wall = time.perf_counter() - t0
+    out = {"steps": steps, "wall_s": round(wall, 1)}
+    out.update({k: round(float(v), 5) for k, v in val.items()})
+    return out
+
+
+def check_regressions(history: list[dict], current: dict) -> list[str]:
+    """Compare the current round's numbers against the best prior round."""
+    flags = []
+    for wl, res in current["workloads"].items():
+        for metric, tol in (("val_loss", REGRESSION_TOL["val_loss"]),):
+            if metric not in res:
+                continue
+            prior = [
+                h["workloads"][wl][metric]
+                for h in history
+                if wl in h.get("workloads", {}) and metric in h["workloads"][wl]
+                and h["workloads"][wl].get("steps") == res.get("steps")
+            ]
+            if prior and res[metric] > min(prior) + tol:
+                flags.append(
+                    f"{wl}.{metric}: {res[metric]} vs best prior {min(prior)}"
+                )
+        acc = res.get("val_accuracy")
+        if acc is not None:
+            prior = [
+                h["workloads"][wl]["val_accuracy"]
+                for h in history
+                if wl in h.get("workloads", {})
+                and "val_accuracy" in h["workloads"][wl]
+                and h["workloads"][wl].get("steps") == res.get("steps")
+            ]
+            if prior and acc < max(prior) + REGRESSION_TOL["accuracy"]:
+                flags.append(f"{wl}.val_accuracy: {acc} vs best prior {max(prior)}")
+    return flags
+
+
+REFERENCE = {  # the reference's recorded numbers these workloads mirror
+    "gpt_shakespeare": {"val_loss": 1.8871, "source": "gpt-jax.ipynb cell 18 (real Shakespeare)"},
+    "dsv3_tinystories": {"loss": 2.90068, "perplexity": 18.18644,
+                         "source": "deepseekv3/readme.md:73 (TinyStories, 10k steps)"},
+    "vit_mnist": {"accuracy": 0.9725, "source": "ViT.ipynb cell 15 (MNIST)"},
+    "kd_mnist": {"accuracy": 0.9750, "source": "kd run screenshot (MNIST)"},
+}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, default=None)
+    p.add_argument("--fast", action="store_true")
+    p.add_argument("--data-path", default=None,
+                   help="real text corpus (e.g. shakespeare.txt) for the LM rows")
+    p.add_argument("--image-path", default=None,
+                   help="real MNIST npz for the vision rows")
+    p.add_argument("--out-dir", default="artifacts/parity")
+    args = p.parse_args()
+
+    div = 8 if args.fast else 1
+    plan = [
+        ("gpt_shakespeare", _run_lm, 1000 // div, args.data_path),
+        ("dsv3_tinystories", _run_lm, 2000 // div, args.data_path),
+        ("vit_mnist", _run_image, 1200 // div, args.image_path),
+        ("kd_mnist", _run_image, 1200 // div, args.image_path),
+    ]
+
+    current: dict = {
+        "round": args.round,
+        "time": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "data": {"text": args.data_path or "synthetic(seed 0)",
+                 "images": args.image_path or "synthetic separable set"},
+        "workloads": {},
+        "reference": REFERENCE,
+    }
+    for name, runner, steps, path in plan:
+        print(f"[parity] {name} ({steps} steps)...", flush=True)
+        current["workloads"][name] = runner(name, steps, path)
+        print(f"[parity] {name}: {current['workloads'][name]}", flush=True)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    hist_path = os.path.join(args.out_dir, "parity.json")
+    history = []
+    if os.path.exists(hist_path):
+        with open(hist_path) as f:
+            history = json.load(f)
+
+    flags = check_regressions(history, current)
+    current["regressions"] = flags
+    history.append(current)
+    with open(hist_path, "w") as f:
+        json.dump(history, f, indent=1)
+    print(f"[parity] wrote {hist_path} ({len(history)} rounds recorded)")
+    if flags:
+        print("[parity] REGRESSIONS:", *flags, sep="\n  ")
+        return 1
+    print("[parity] no regressions vs prior rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
