@@ -179,3 +179,22 @@ class GPTPipe:
     @property
     def max_positions(self) -> int:
         return self.cfg.block_size
+
+    # ---------------------------------------------------------------- export
+
+    def to_dense(self, params: dict):
+        """Restack the stage-stacked params into the dense GPT layout
+        (block_{i} keys) and return (GPT model, params) — the decode path
+        for pipeline-trained weights (PP itself has no cache support).
+        GPTPipe block j of stage s is GPT block s*layers_per_stage + j;
+        module names are shared, so the forward is bit-identical."""
+        from solvingpapers_tpu.models.gpt import GPT
+
+        cfg = self.cfg
+        dense = {k: v for k, v in params.items() if k != "stages"}
+        for s in range(cfg.n_stages):
+            for j in range(cfg.layers_per_stage):
+                dense[f"block_{s * cfg.layers_per_stage + j}"] = jax.tree.map(
+                    lambda a: a[s], params["stages"][f"block_{j}"]
+                )
+        return GPT(cfg.block_cfg()), dense

@@ -63,27 +63,29 @@ def _axis_product(mesh: Mesh | None, entry) -> int:
     return n
 
 
+def leaf_spec(path, leaf, rules: list[tuple[str, P]] = LM_RULES,
+              mesh: Mesh | None = None) -> P:
+    """First-match rule spec for one (path, leaf). When `mesh` is given,
+    any dimension whose size is not divisible by the product of its
+    assigned mesh axes degrades to replicated for that dim (e.g. a SwiGLU
+    hidden of (2·4·D)//3 that lands on an odd size)."""
+    p = _path_str(path)
+    for pattern, spec in rules:
+        if re.search(pattern, p):
+            entries = list(spec[: leaf.ndim])  # never shard more dims than leaf
+            entries = [
+                e if leaf.shape[d] % _axis_product(mesh, e) == 0 else None
+                for d, e in enumerate(entries)
+            ]
+            return P(*entries)
+    return P()
+
+
 def param_specs(params, rules: list[tuple[str, P]] = LM_RULES, mesh: Mesh | None = None):
-    """Map a params pytree to a pytree of PartitionSpec via first-match rules.
-
-    When `mesh` is given, any dimension whose size is not divisible by the
-    product of its assigned mesh axes degrades to replicated for that dim
-    (e.g. a SwiGLU hidden of (2·4·D)//3 that lands on an odd size).
-    """
-
-    def spec_for(path, leaf):
-        p = _path_str(path)
-        for pattern, spec in rules:
-            if re.search(pattern, p):
-                entries = list(spec[: leaf.ndim])  # never shard more dims than leaf
-                entries = [
-                    e if leaf.shape[d] % _axis_product(mesh, e) == 0 else None
-                    for d, e in enumerate(entries)
-                ]
-                return P(*entries)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    """Map a params pytree to a pytree of PartitionSpec via first-match rules."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: leaf_spec(path, leaf, rules, mesh), params
+    )
 
 
 def param_shardings(mesh: Mesh, params, rules: list[tuple[str, P]] = LM_RULES):

@@ -192,12 +192,14 @@ class Trainer:
     def _cp_loss_call(self):
         """Build the context-parallel loss: the model applies inside
         shard_map with the sequence sharded over 'context' (its attention
-        runs the ppermute ring / Ulysses all_to_all), params replicated
-        across the batch/context axes, and the per-shard loss pmean'd back
-        to the global mean (equal shard sizes make that exact). Gradients
-        through shard_map psum across shards automatically."""
+        runs the ppermute ring / Ulysses all_to_all); params enter in their
+        STORED layout — sharded over 'fsdp' (ZeRO) when that axis is > 1,
+        replicated otherwise — and are all-gathered inside the step. The
+        per-shard loss is pmean'd back to the global mean (equal shard
+        sizes make that exact); gradients psum/reduce-scatter through
+        shard_map's transpose automatically."""
         self._reject_axes(
-            "context_parallel", ("fsdp", "model", "expert", "pipe"),
+            "context_parallel", ("model", "expert", "pipe"),
             "replicates params inside shard_map",
         )
         if not getattr(getattr(self.model, "cfg", None), "context_parallel", False):
@@ -208,11 +210,34 @@ class Trainer:
                 "positions restarting at 0) and train a silently wrong "
                 "objective"
             )
-        # decorrelate dropout across every shard: each holds a different
-        # (batch, sequence) slice
+        # FSDP composes: params enter shard_map in their stored (sharded)
+        # layout and are all-gathered over 'fsdp' inside the step — the
+        # gather's transpose reduce-scatters the grads, i.e. ZeRO-3, so
+        # per-device param memory stays 1/fsdp at rest. Decorrelate dropout
+        # across every shard: each holds a different (batch, seq) slice.
         return self._shard_map_loss_call(
-            ("data", "fsdp", "context"), P(),
-            rng_axes=("data", "fsdp", "context"),
+            ("data", "fsdp", "context"), self._fsdp_param_specs(),
+            rng_axes=("data", "fsdp", "context"), gather_fsdp=True,
+        )
+
+    def _fsdp_param_specs(self):
+        """(path, leaf) -> P giving each param's STORED layout restricted
+        to the 'fsdp' axis — derived from the same rule table/mesh as the
+        state shardings, so it needs no init_state precondition (evaluate /
+        fit with an external state build steps without one). model/expert/
+        pipe are rejected above; their size-1 names in the rule table would
+        otherwise mark values conservatively varying over those axes."""
+        from solvingpapers_tpu.sharding.rules import leaf_spec
+
+        def only_fsdp(spec):
+            def f(entry):
+                names = entry if isinstance(entry, tuple) else (entry,)
+                return "fsdp" if "fsdp" in names else None
+
+            return P(*(f(e) if e is not None else None for e in spec))
+
+        return lambda path, leaf: only_fsdp(
+            leaf_spec(path, leaf, self.rules, self.mesh)
         )
 
     def _pp_loss_call(self):
@@ -267,12 +292,24 @@ class Trainer:
         for every shard_map this Trainer builds (CP loss, PP loss, CP init)."""
         return not getattr(getattr(self.model, "cfg", None), "use_flash", False)
 
-    def _shard_map_loss_call(self, axes, param_in_specs, rng_axes):
+    def _shard_map_loss_call(self, axes, param_in_specs, rng_axes,
+                             gather_fsdp: bool = False):
         """Common shard_map loss wrapper for CP/PP. `param_in_specs` is a
         spec pytree/prefix, or a (path, leaf) -> P function evaluated
-        against the abstract params at call time."""
+        against the abstract params at call time. With `gather_fsdp`, each
+        param enters in its stored (sharded) layout and is all-gathered
+        along the dims its spec shards before the model applies — the
+        gather's transpose reduce-scatters the grads (ZeRO-3)."""
         batch_specs = self._batch_specs()
         check_vma = self._check_vma()
+
+        def gather_param(p, spec):
+            for dim, entry in enumerate(spec):
+                if entry is None:
+                    continue
+                for name in (entry if isinstance(entry, tuple) else (entry,)):
+                    p = jax.lax.all_gather(p, name, axis=dim, tiled=True)
+            return p
 
         def call(params, model_state, batch, rng, train):
             if model_state is not None:
@@ -288,6 +325,10 @@ class Trainer:
             )
 
             def local(params, batch, rng):
+                if gather_fsdp:
+                    # p_specs nodes are matched whole at params' leaf
+                    # boundary (flatten_up_to), so each leaf pairs with its P
+                    params = jax.tree.map(gather_param, params, p_specs)
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(rng_axes))
                 loss, aux, _ = self.loss_fn(
                     self.model, params, batch, rng, None, train

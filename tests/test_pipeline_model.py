@@ -129,3 +129,26 @@ def test_pp_cli_front_door(devices, tmp_path):
     assert train_rows and all(np.isfinite(r["train_loss"]) for r in train_rows)
     assert train_rows[-1]["train_loss"] < train_rows[0]["train_loss"] + 0.5
     assert any("val_loss" in r for r in rows)
+
+
+def test_pp_export_to_dense_gpt_matches_and_decodes():
+    """to_dense restacks stage params into the dense GPT layout: forward
+    must be identical, and the dense model's cached decode works — the
+    decode path for pipeline-trained weights."""
+    cfg = GPTPipeConfig(vocab_size=64, block_size=32, dim=32, n_layers=4,
+                        n_heads=2, n_stages=2, n_microbatches=2)
+    model = GPTPipe(cfg)
+    toks = jax.random.randint(jax.random.key(5), (2, 16), 0, 64)
+    params = model.init({"params": jax.random.key(6)}, toks)["params"]
+    ref, _ = model.apply({"params": params}, toks)
+
+    gpt, dense_params = model.to_dense(params)
+    out, _ = gpt.apply({"params": dense_params}, toks, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+    from solvingpapers_tpu.infer import generate
+
+    ids = generate(gpt, dense_params, toks[:1, :8], jax.random.key(7),
+                   max_new_tokens=8)
+    assert ids.shape == (1, 16)
