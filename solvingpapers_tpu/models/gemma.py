@@ -29,7 +29,13 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, maybe_remat
+from solvingpapers_tpu.models.layers import (
+    Attention,
+    GLUFFN,
+    RMSNorm,
+    default_positions,
+    maybe_remat,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +53,10 @@ class GemmaConfig:
     dtype: str = "float32"
     use_flash: bool = False
     remat: bool = False  # jax.checkpoint each block: recompute activations in backward
+    # context parallelism (same contract as LlamaConfig: apply inside a
+    # shard_map whose 'context' axis shards the sequence)
+    context_parallel: bool = False
+    context_impl: str = "ring"  # ring | ulysses
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -77,6 +87,8 @@ class GemmaBlock(nn.Module):
             use_bias=False,
             dtype=cfg.compute_dtype,
             use_flash=cfg.use_flash,
+            context_parallel=cfg.context_parallel,
+            context_impl=cfg.context_impl,
             name="attn",
         )(
             RMSNorm(eps=cfg.norm_eps, name="attn_norm")(x),
@@ -112,7 +124,7 @@ class Gemma(nn.Module):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            positions = default_positions(b, s, cfg.context_parallel)
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(tokens)
         if cfg.dropout > 0.0:
             x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)

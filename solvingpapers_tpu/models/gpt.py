@@ -21,7 +21,13 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, LayerNorm, MLP, maybe_remat
+from solvingpapers_tpu.models.layers import (
+    Attention,
+    LayerNorm,
+    MLP,
+    default_positions,
+    maybe_remat,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +42,10 @@ class GPTConfig:
     dtype: str = "float32"
     use_flash: bool = False
     remat: bool = False  # jax.checkpoint each block: recompute activations in backward
+    # context parallelism (same contract as LlamaConfig: apply inside a
+    # shard_map whose 'context' axis shards the sequence)
+    context_parallel: bool = False
+    context_impl: str = "ring"  # ring | ulysses
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -58,6 +68,8 @@ class GPTBlock(nn.Module):
             use_bias=True,
             dtype=cfg.compute_dtype,
             use_flash=cfg.use_flash,
+            context_parallel=cfg.context_parallel,
+            context_impl=cfg.context_impl,
             name="attn",
         )(LayerNorm(name="ln1")(x), positions=positions, cache=cache, deterministic=deterministic)
         x = x + h
@@ -86,7 +98,11 @@ class GPT(nn.Module):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            # max_positions: the learned table length — out-of-range global
+            # positions fail at trace time instead of silently clamping
+            positions = default_positions(
+                b, s, cfg.context_parallel, max_positions=cfg.block_size
+            )
         tok_emb = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(
             tokens
         )

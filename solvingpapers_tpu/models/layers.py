@@ -25,6 +25,34 @@ from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import KVCache, update_kv_cache
 
 
+def default_positions(
+    b: int, s: int, context_parallel: bool = False,
+    context_axis: str = "context", max_positions: int | None = None,
+) -> jax.Array:
+    """Default (B, S) absolute positions. Under context parallelism the
+    caller sees only its local sequence shard inside shard_map, so defaults
+    must be GLOBAL (axis_index * s + arange) — otherwise RoPE/learned
+    tables restart at 0 on every shard while the ring masks globally. One
+    definition for Attention and every model's embedding path.
+
+    `max_positions` (e.g. a learned table length) turns silent clipping
+    into a trace-time error: jnp.take would clamp out-of-range global
+    positions to the last row and train a silently wrong objective."""
+    if context_parallel:
+        axis_size = jax.lax.psum(1, context_axis)  # static under shard_map
+        if max_positions is not None and axis_size * s > max_positions:
+            raise ValueError(
+                f"global sequence {axis_size * s} (= {axis_size} context "
+                f"shards x {s}) exceeds max positions {max_positions}; "
+                "jnp.take would silently clamp to the last table row"
+            )
+        start = jax.lax.axis_index(context_axis) * s
+        return jnp.broadcast_to(start + jnp.arange(s), (b, s))
+    if max_positions is not None and s > max_positions:
+        raise ValueError(f"sequence {s} exceeds max positions {max_positions}")
+    return jnp.broadcast_to(jnp.arange(s), (b, s))
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
 
@@ -103,14 +131,9 @@ class Attention(nn.Module):
         )
 
         if positions is None:
-            if self.context_parallel:
-                # inside shard_map x is the LOCAL sequence shard; default
-                # positions must be global or RoPE would restart at 0 on
-                # every shard while the ring masks by global position
-                start = jax.lax.axis_index(self.context_axis) * s
-                positions = jnp.broadcast_to(start + jnp.arange(s), (b, s))
-            else:
-                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            positions = default_positions(
+                b, s, self.context_parallel, self.context_axis
+            )
 
         if n_kv == self.n_heads:
             qkv = dense(3 * self.n_heads * head_dim, "qkv")(x)

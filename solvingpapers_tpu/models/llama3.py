@@ -27,7 +27,14 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import KVCache
-from solvingpapers_tpu.models.layers import Attention, GLUFFN, RMSNorm, swiglu_hidden_dim, maybe_remat
+from solvingpapers_tpu.models.layers import (
+    Attention,
+    GLUFFN,
+    RMSNorm,
+    default_positions,
+    maybe_remat,
+    swiglu_hidden_dim,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,14 +125,7 @@ class Llama(nn.Module):
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
-            if cfg.context_parallel:
-                # inside shard_map `tokens` is the local sequence shard;
-                # defaults must be GLOBAL positions or RoPE restarts at 0
-                # on every shard while the ring masks globally
-                start = jax.lax.axis_index("context") * s
-                positions = jnp.broadcast_to(start + jnp.arange(s), (b, s))
-            else:
-                positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            positions = default_positions(b, s, cfg.context_parallel)
         x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype, name="tok_emb")(tokens)
 
         new_caches = [] if caches is not None else None

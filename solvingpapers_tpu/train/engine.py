@@ -73,8 +73,9 @@ class TrainConfig:
     # context parallelism: shard the sequence dim of (B, S) token batches
     # over the mesh 'context' axis and run the whole loss inside shard_map
     # (the model must be built with context_parallel=True so its attention
-    # runs the ppermute ring / Ulysses all_to_all). Params are replicated
-    # across 'context'; composes with the data axes.
+    # runs the ppermute ring / Ulysses all_to_all). Composes with the data
+    # axes AND fsdp: params stay stored in their ZeRO layout (sharded over
+    # 'fsdp') and are all-gathered inside the step, grads reduce-scatter.
     context_parallel: bool = False
     # pipeline parallelism: the model's stage-stacked decoder params (under
     # a top-level 'stages' key, models/gpt_pipe.py) are sharded over the

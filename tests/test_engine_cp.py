@@ -158,3 +158,71 @@ def test_cp_fsdp_trainer_step_matches_dense(devices):
                     jax.tree.leaves(jax.device_get(d_state.params))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("family", ["gpt", "gemma"])
+def test_cp_extends_across_zoo(devices, family):
+    """CP is zoo-wide (long-context is first-class): GPT (learned positions)
+    and Gemma (grouped MQA + RoPE) train under the CP Trainer and match
+    their dense single-device step."""
+    if family == "gpt":
+        from solvingpapers_tpu.models.gpt import GPT as Model, GPTConfig as Cfg
+
+        kw = dict(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                  n_heads=4, dropout=0.0)
+    else:
+        from solvingpapers_tpu.models.gemma import Gemma as Model, GemmaConfig as Cfg
+
+        kw = dict(vocab_size=64, max_seq_len=64, dim=32, n_layers=2,
+                  n_heads=4, n_kv_heads=2, dropout=0.0)
+    batch = _make_batch(jax.random.key(4), 4, 64, 64)
+    train = TrainConfig(
+        steps=1, batch_size=4, log_every=1, eval_every=0,
+        optimizer=OptimizerConfig(name="sgd", max_lr=1e-1, warmup_steps=0,
+                                  total_steps=4, grad_clip=1.0),
+    )
+
+    dense = Trainer(Model(Cfg(**kw)), train,
+                    mesh=create_mesh(MeshConfig(data=1), devices[:1]))
+    d_state = dense.init_state(batch)
+    dense._build_steps()
+    d_state, d_metrics = dense._train_step(d_state, batch)
+
+    import dataclasses as dc
+
+    c_train = dc.replace(train, context_parallel=True,
+                         mesh=MeshConfig(data=2, context=4))
+    cp = Trainer(Model(Cfg(**kw, context_parallel=True)), c_train,
+                 mesh=create_mesh(MeshConfig(data=2, context=4), devices))
+    c_state = cp.init_state(batch)
+    cp._build_steps()
+    c_state, c_metrics = cp._train_step(c_state, batch)
+
+    np.testing.assert_allclose(
+        float(jax.device_get(c_metrics["train_loss"])),
+        float(jax.device_get(d_metrics["train_loss"])), rtol=1e-5,
+    )
+    for a, b in zip(jax.tree.leaves(jax.device_get(c_state.params)),
+                    jax.tree.leaves(jax.device_get(d_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_cp_rejects_positions_past_table(devices):
+    """A CP GPT whose GLOBAL sequence exceeds the learned position table
+    must fail at trace time, not silently clamp every late token to the
+    last table row."""
+    from jax.sharding import PartitionSpec as P
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=1,
+                    n_heads=2, dropout=0.0, context_parallel=True)
+    model = GPT(cfg)
+    mesh = create_mesh(MeshConfig(data=2, context=4), devices)
+    toks = jnp.zeros((2, 128), jnp.int32)  # global 128 > block_size 64
+    with pytest.raises(ValueError, match="exceeds max positions"):
+        jax.shard_map(
+            lambda x: model.init({"params": jax.random.key(0)}, x),
+            mesh=mesh, in_specs=P(("data",), "context"), out_specs=P(),
+        )(toks)
