@@ -236,7 +236,7 @@ def _llama3_long() -> RunConfig:
         ),
         train=TrainConfig(
             steps=10_000, batch_size=8, log_every=50, eval_every=500,
-            eval_batches=8,
+            eval_batches=8, ckpt_every=1000,
             mesh=MeshConfig(data=-1, context=4),
             context_parallel=True,
             optimizer=OptimizerConfig(
@@ -377,6 +377,70 @@ def _dsv3_long() -> RunConfig:
               "bpe_vocab_size": 32_000},
         notes="beyond-reference: 64x the reference's maximum context for "
               "its own flagship architecture, one chip",
+    )
+
+
+@register("dsv3_long_cp")
+def _dsv3_long_cp() -> RunConfig:
+    """The flagship at 65,536-token context via context parallelism: MLA
+    rings over the latent stream across a 4-way 'context' axis (flash
+    kernel per chunk), MoE routing state psum'd shard-invariant — 4x the
+    single-chip dsv3_long ceiling, 256x the reference's maximum context."""
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config
+
+    return RunConfig(
+        name="dsv3_long_cp",
+        model_family="deepseekv3",
+        model=DeepSeekV3Config(
+            vocab_size=50257, block_size=65_536, dtype="bfloat16",
+            use_flash=True, remat=True, context_parallel=True,
+            dropout=0.0, attn_dropout=0.0,
+        ),
+        train=TrainConfig(
+            steps=10_000, batch_size=4, log_every=50, eval_every=500,
+            eval_batches=4, ckpt_every=1000,
+            mesh=MeshConfig(data=-1, context=4),
+            context_parallel=True,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=3e-4, warmup_steps=200, total_steps=10_000,
+                b1=0.9, b2=0.95, weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=4 * 65_536,
+        ),
+        data={"kind": "bpe", "path": None, "block_size": 65_536,
+              "bpe_vocab_size": 32_000},
+        notes="flagship long-context over the context axis (ring flash-MLA)",
+    )
+
+
+@register("dsv3_long_cp_smoke")
+def _dsv3_long_cp_smoke() -> RunConfig:
+    """CPU-mesh-sized dsv3_long_cp (virtual 8-device mesh: data=2 x
+    context=4): same CP Trainer path — ring flash-MLA + psum'd MoE state —
+    at toy dims."""
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config
+
+    return RunConfig(
+        name="dsv3_long_cp_smoke",
+        model_family="deepseekv3",
+        model=DeepSeekV3Config(
+            vocab_size=256, block_size=256, dim=32, n_layers=2, n_heads=4,
+            latent_dim=8, n_experts=4, top_experts=2, dropout=0.0,
+            attn_dropout=0.0, use_flash=True, context_parallel=True,
+        ),
+        train=TrainConfig(
+            steps=20, batch_size=4, log_every=5, eval_every=10,
+            eval_batches=2,
+            mesh=MeshConfig(data=-1, context=4),
+            context_parallel=True,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=5, total_steps=20,
+                weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=4 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="dsv3_long_cp at smoke scale for the virtual CPU mesh",
     )
 
 

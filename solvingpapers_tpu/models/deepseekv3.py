@@ -61,8 +61,20 @@ class DeepSeekV3Config:
     attn_dropout: float = 0.1
     remat: bool = False  # jax.checkpoint each decoder layer
     use_flash: bool = False  # MLA scores via the Pallas flash kernel (train path)
+    # context parallelism (apply inside a shard_map whose 'context' axis
+    # shards the sequence): MLA runs the kv ring over the LATENT stream
+    # (absorbed-query MLA is MQA with k = v = latents, so the ring's
+    # n_kv=1 path serves it; Ulysses cannot — 1 kv head can't split).
+    # MoE load stats / bias updates are psum'd across the step's axes so
+    # the routing state stays shard-invariant.
+    context_parallel: bool = False
     norm_eps: float = 1e-6
     dtype: str = "float32"
+
+    @property
+    def stats_axes(self) -> tuple | None:
+        """Axes MoE state/stats must be psum'd over under shard_map."""
+        return ("data", "fsdp", "context") if self.context_parallel else None
 
     @property
     def compute_dtype(self) -> jnp.dtype:
@@ -94,6 +106,12 @@ class MLA(nn.Module):
         n, hd, lat = cfg.n_heads, cfg.head_dim, cfg.latent_dim
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        if cache is not None and cfg.context_parallel:
+            raise NotImplementedError(
+                "latent caches are unsupported under context parallelism: "
+                "a per-shard cache would silently attend only local slots. "
+                "Decode with a non-CP model config."
+            )
 
         latent = nn.Dense(
             lat, use_bias=False, dtype=cfg.compute_dtype, name="w_dkv"
@@ -108,7 +126,30 @@ class MLA(nn.Module):
         # absorbed query: project q into latent space once, score vs latents
         q_lat = jnp.einsum("bsnh,lnh->bsnl", q, w_k.astype(dt))
 
-        if cache is None and cfg.use_flash:
+        if cache is None and cfg.context_parallel:
+            # ring over the latent stream (k = v = latents, one shared kv
+            # head): long-context CP for the flagship family. The same
+            # latent-space algebra as the dense path — decompression by
+            # w_v happens after the ring, on the local ctx shard.
+            from solvingpapers_tpu.sharding.ring_attention import (
+                ring_attention_local,
+                ring_flash_attention_local,
+            )
+
+            if cfg.attn_dropout > 0.0 and not deterministic:
+                raise NotImplementedError(
+                    "attention-prob dropout is not implemented under "
+                    "context_parallel MLA; set attn_dropout=0.0"
+                )
+            c_kv = latent.astype(dt)[:, :, None, :]  # (B, S_loc, 1, L)
+            ring = (
+                ring_flash_attention_local if cfg.use_flash
+                else ring_attention_local
+            )
+            ctx = ring(
+                q_lat, c_kv, c_kv, "context", causal=True, scale=hd**-0.5
+            ).astype(dt)
+        elif cache is None and cfg.use_flash:
             # absorbed-query MLA *is* MQA over the latent stream: scores are
             # q_lat . c and the context is probs @ c, i.e. attention with
             # k = v = c and one shared kv head — so the Pallas flash kernel
@@ -221,6 +262,12 @@ class MoELayer(nn.Module):
                 g = jnp.einsum("ecd,edh->ech", xe, w2.astype(dt))
                 return jnp.einsum("ech,ehd->ecd", ops.swish(a) * g, w3.astype(dt))
 
+            # under CP/shard_map b*s is the LOCAL token count, so capacity
+            # is per-shard — the standard distributed-MoE dispatch
+            # semantics. Parity with the dense single-device step is exact
+            # in the drop-free regime; once capacity binds, drops are
+            # decided per shard rather than globally (watch
+            # moe_drop_fraction, psum'd across shards).
             cap = ops.moe.expert_capacity(
                 b * s, e, cfg.top_experts, cfg.capacity_factor
             )
@@ -237,17 +284,22 @@ class MoELayer(nn.Module):
             and not deterministic
             and self.is_mutable_collection("moe_state")
         ):
+            # stats_axes: under shard_map the load is psum'd so every shard
+            # applies the identical bias update (shard-invariant state)
             bias.value = ops.moe.aux_free_bias_update(
-                probs, bias.value, cfg.aux_free_bias_update_rate
+                probs, bias.value, cfg.aux_free_bias_update_rate,
+                axis_names=cfg.stats_axes,
             )
 
         if self.is_mutable_collection("moe_metrics"):
             # load-balance observability (SURVEY.md hard part #1): sown per
             # layer, aggregated into train metrics by dsv3_loss_fn
-            stats = ops.moe.load_balance_stats(probs)
+            stats = ops.moe.load_balance_stats(probs, axis_names=cfg.stats_axes)
             stats["drop_fraction"] = (
                 jnp.zeros(()) if cfg.moe_impl == "dense"
-                else ops.moe.dispatch_drop_fraction(probs, cap)
+                else ops.moe.dispatch_drop_fraction(
+                    probs, cap, axis_names=cfg.stats_axes
+                )
             )
             stats["bias_norm"] = jnp.linalg.norm(bias.value)
             self.sow("moe_metrics", "stats", stats)
@@ -293,8 +345,19 @@ class DeepSeekV3(nn.Module):
         return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V))."""
         cfg = self.cfg
         b, s = tokens.shape
+        if cfg.context_parallel and return_mtp and cfg.mtp_heads > 0:
+            raise NotImplementedError(
+                "MTP under context parallelism: the i+k target shift "
+                "crosses shard boundaries; train MTP on a non-CP config"
+            )
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            from solvingpapers_tpu.models.layers import default_positions
+
+            # max_positions: the sinusoidal table length (same silent-clamp
+            # hazard as a learned table)
+            positions = default_positions(
+                b, s, cfg.context_parallel, max_positions=cfg.block_size
+            )
         embed = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.compute_dtype,
             embedding_init=nn.initializers.normal(0.02), name="tok_emb",

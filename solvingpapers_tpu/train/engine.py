@@ -312,12 +312,30 @@ class Trainer:
                     p = jax.lax.all_gather(p, name, axis=dim, tiled=True)
             return p
 
+        def pmean(a):
+            # aux may mix shard-varying values (per-shard loss terms) with
+            # already-invariant ones (psum'd MoE stats). Under the vma
+            # checker, reduce only the axes a value actually varies over;
+            # without vma tracking (check_vma=False), the plain pmean of an
+            # invariant value is a numeric no-op anyway.
+            vma = getattr(jax.typeof(a), "vma", None)
+            if check_vma and vma is not None:
+                ax = tuple(x for x in axes if x in vma)
+                return jax.lax.pmean(a, ax) if ax else a
+            return jax.lax.pmean(a, axes)
+
         def call(params, model_state, batch, rng, train):
-            if model_state is not None:
+            if model_state is not None and not check_vma and getattr(
+                getattr(self.model, "cfg", None), "stats_axes", None
+            ) is None:
+                # with vma checking off (flash models) the out_specs P()
+                # contract below is unverified — require the model to
+                # declare shard-invariant state updates explicitly, or a
+                # per-shard-varying state would be silently mis-replicated
                 raise NotImplementedError(
-                    "shard_map-composed training with model_state (e.g. MoE "
-                    "routing bias): per-shard state updates would silently "
-                    "diverge; psum the state update inside the loss_fn first"
+                    "model_state under shard_map without vma checking: the "
+                    "model must declare shard-invariant state updates "
+                    "(cfg.stats_axes, psum'd like DeepSeekV3's MoE load)"
                 )
             p_specs = (
                 jax.tree_util.tree_map_with_path(param_in_specs, params)
@@ -325,28 +343,32 @@ class Trainer:
                 else param_in_specs
             )
 
-            def local(params, batch, rng):
+            def local(params, ms, batch, rng):
                 if gather_fsdp:
                     # p_specs nodes are matched whole at params' leaf
                     # boundary (flatten_up_to), so each leaf pairs with its P
                     params = jax.tree.map(gather_param, params, p_specs)
                 rng = jax.random.fold_in(rng, jax.lax.axis_index(rng_axes))
-                loss, aux, _ = self.loss_fn(
-                    self.model, params, batch, rng, None, train
+                loss, aux, new_ms = self.loss_fn(
+                    self.model, params, batch, rng, ms, train
                 )
-                loss = jax.lax.pmean(loss, axes)
-                aux = jax.tree.map(lambda a: jax.lax.pmean(a, axes), aux)
+                loss = pmean(loss)
+                aux = jax.tree.map(pmean, aux)
                 if "perplexity" in aux:
                     # exp of the global mean, not the pmean of local exps
                     aux["perplexity"] = jnp.exp(loss)
-                return loss, aux
+                return loss, aux, new_ms
 
-            loss, aux = jax.shard_map(
+            # model_state (e.g. the MoE routing bias) enters replicated and
+            # must leave replicated: the model's in-step updates have to be
+            # shard-invariant (psum'd loads — DeepSeekV3Config.stats_axes);
+            # out_specs P() asserts that contract under the vma checker
+            loss, aux, new_ms = jax.shard_map(
                 local, mesh=self.mesh,
-                in_specs=(p_specs, batch_specs, P()),
-                out_specs=(P(), P()), check_vma=check_vma,
-            )(params, batch, rng)
-            return loss, aux, None
+                in_specs=(p_specs, P(), batch_specs, P()),
+                out_specs=(P(), P(), P()), check_vma=check_vma,
+            )(params, model_state, batch, rng)
+            return loss, aux, new_ms
 
         return call
 
