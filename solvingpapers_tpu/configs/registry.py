@@ -380,6 +380,33 @@ def _dsv3_long() -> RunConfig:
     )
 
 
+@register("dsv3_mtp")
+def _dsv3_mtp() -> RunConfig:
+    """The flagship with multi-token prediction ENABLED (2 extra heads,
+    loss weight 0.3). The reference builds the full MTP machinery but ships
+    mtp_heads=0 (deepseekv3.ipynb cells 33, 46 — the else-branch runs);
+    this config exercises the capability the notebook only gestures at."""
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config
+
+    return RunConfig(
+        name="dsv3_mtp",
+        model_family="deepseekv3",
+        model=DeepSeekV3Config(dtype="bfloat16", mtp_heads=2),
+        train=TrainConfig(
+            steps=10_000, batch_size=16, log_every=50, eval_every=500,
+            eval_batches=8, ckpt_every=1000,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=6e-4, warmup_steps=400, total_steps=10_000,
+                b1=0.9, b2=0.95, weight_decay=0.1, grad_clip=1.0,
+            ),
+            tokens_per_step=16 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="deepseekv3 with mtp_heads=2 live (the reference's dormant "
+              "branch); main CE + 0.3 x MTP loss",
+    )
+
+
 @register("dsv3_long_cp")
 def _dsv3_long_cp() -> RunConfig:
     """The flagship at 65,536-token context via context parallelism: MLA

@@ -279,6 +279,9 @@ class MoELayer(nn.Module):
                 name="shared_expert",
             )(xt)
 
+        # one load reduction (+ one cross-shard collective under CP) shared
+        # by the bias update and the sown stats
+        ci = None
         if (
             cfg.use_aux_free
             and not deterministic
@@ -286,15 +289,17 @@ class MoELayer(nn.Module):
         ):
             # stats_axes: under shard_map the load is psum'd so every shard
             # applies the identical bias update (shard-invariant state)
+            ci = ops.moe.expert_load(probs, cfg.stats_axes)
             bias.value = ops.moe.aux_free_bias_update(
-                probs, bias.value, cfg.aux_free_bias_update_rate,
-                axis_names=cfg.stats_axes,
+                probs, bias.value, cfg.aux_free_bias_update_rate, ci=ci
             )
 
         if self.is_mutable_collection("moe_metrics"):
             # load-balance observability (SURVEY.md hard part #1): sown per
             # layer, aggregated into train metrics by dsv3_loss_fn
-            stats = ops.moe.load_balance_stats(probs, axis_names=cfg.stats_axes)
+            stats = ops.moe.load_balance_stats(
+                probs, axis_names=cfg.stats_axes, ci=ci
+            )
             stats["drop_fraction"] = (
                 jnp.zeros(()) if cfg.moe_impl == "dense"
                 else ops.moe.dispatch_drop_fraction(

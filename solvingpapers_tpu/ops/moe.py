@@ -34,7 +34,7 @@ def topk_gate_probs(gate_logits: jax.Array, k: int) -> jax.Array:
 
 
 def aux_free_bias_update(
-    probs: jax.Array, bias: jax.Array, rate: float, axis_names=None
+    probs: jax.Array, bias: jax.Array, rate: float, axis_names=None, ci=None
 ) -> jax.Array:
     """New routing bias per deepseekv3 cell 23: load c_i = sum of routed
     probabilities per expert; bias += rate * sign(mean(c) - c). Run under
@@ -42,12 +42,22 @@ def aux_free_bias_update(
 
     `axis_names`: mesh axes to psum the per-expert load over — REQUIRED
     inside shard_map (context/data-parallel steps), where each shard sees
-    only its tokens and a local update would silently diverge per shard."""
-    ci = jax.lax.stop_gradient(jnp.sum(probs, axis=0))
-    if axis_names:
-        ci = jax.lax.psum(ci, axis_names)
+    only its tokens and a local update would silently diverge per shard.
+    `ci`: precomputed (already psum'd) per-expert load, to share one
+    reduction/collective with load_balance_stats."""
+    if ci is None:
+        ci = expert_load(probs, axis_names)
     err = jnp.mean(ci) - ci
     return bias + rate * jnp.sign(err).astype(bias.dtype)
+
+
+def expert_load(probs: jax.Array, axis_names=None) -> jax.Array:
+    """(E,) routed probability mass per expert under stop_gradient,
+    psum'd over `axis_names` when inside shard_map."""
+    ci = jax.lax.stop_gradient(jnp.sum(probs.astype(jnp.float32), axis=0))
+    if axis_names:
+        ci = jax.lax.psum(ci, axis_names)
+    return ci
 
 
 def expert_capacity(
@@ -112,14 +122,15 @@ def dispatch_drop_fraction(
     return (routed - kept) / jnp.maximum(routed, 1.0)
 
 
-def load_balance_stats(probs: jax.Array, axis_names=None) -> dict[str, jax.Array]:
+def load_balance_stats(
+    probs: jax.Array, axis_names=None, ci=None
+) -> dict[str, jax.Array]:
     """Routing-load summary from (T, E) gate probs, under stop_gradient:
     load_entropy (normalized to [0, 1]; 1 = perfectly balanced),
     load_max_fraction (1/E = balanced, 1 = collapsed). `axis_names`: psum
-    the per-expert load across shards first."""
-    ci = jax.lax.stop_gradient(jnp.sum(probs.astype(jnp.float32), axis=0))
-    if axis_names:
-        ci = jax.lax.psum(ci, axis_names)
+    the per-expert load across shards first; `ci`: precomputed load."""
+    if ci is None:
+        ci = expert_load(probs, axis_names)
     e = probs.shape[-1]
     load = ci / jnp.maximum(jnp.sum(ci), 1e-9)
     entropy = -jnp.sum(load * jnp.log(load + 1e-9)) / jnp.log(float(e))
