@@ -40,12 +40,18 @@ class GPTPipeConfig:
     n_heads: int = 4
     mlp_mult: int = 4
     dtype: str = "float32"
-    use_flash: bool = False
     n_stages: int = 4
     n_microbatches: int = 4
     # True: apply inside shard_map over the 'pipe' axis with the GPipe
     # schedule; False: sequential scan over stages (dense oracle)
     pipeline_parallel: bool = False
+    # compose with context parallelism: the sequence dim is additionally
+    # sharded over 'context' and each stage's attention runs the ppermute
+    # ring within its pipe coordinate's context group (orthogonal axes,
+    # uniform schedule on every device)
+    context_parallel: bool = False
+    context_impl: str = "ring"  # ring | ulysses
+    use_flash: bool = False
 
     def __post_init__(self):
         if self.n_layers % self.n_stages:
@@ -71,6 +77,8 @@ class GPTPipeConfig:
             dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
             mlp_mult=self.mlp_mult, dropout=0.0, dtype=self.dtype,
             use_flash=self.use_flash,
+            context_parallel=self.context_parallel,
+            context_impl=self.context_impl,
         )
 
 
@@ -89,6 +97,11 @@ class GPTPipe:
         k_emb, k_pos, k_blocks, k_ln, k_head = jax.random.split(rng, 5)
         dummy = jnp.zeros((1, min(tokens.shape[1], cfg.block_size), cfg.dim),
                           cfg.compute_dtype)
+        if cfg.context_parallel:
+            # init runs inside shard_map (the blocks trace the context
+            # ring); a constant dummy is axis-invariant and would clash
+            # with the ring's varying carries under the vma checker
+            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
 
         def stage_init(key):
             blocks = {}
@@ -147,11 +160,15 @@ class GPTPipe:
                 "decode caches are unsupported under pipeline parallelism; "
                 "export the params and restack for the dense GPT to decode"
             )
+        from solvingpapers_tpu.models.layers import default_positions
+
         cfg = self.cfg
         p = variables["params"]
         b, s = tokens.shape
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            positions = default_positions(
+                b, s, cfg.context_parallel, max_positions=cfg.block_size
+            )
         x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
         x = x + jnp.take(p["pos_emb"], positions[0], axis=0)
         x = x.astype(cfg.compute_dtype)
@@ -187,7 +204,11 @@ class GPTPipe:
         (block_{i} keys) and return (GPT model, params) — the decode path
         for pipeline-trained weights (PP itself has no cache support).
         GPTPipe block j of stage s is GPT block s*layers_per_stage + j;
-        module names are shared, so the forward is bit-identical."""
+        module names are shared, so the forward is bit-identical. The
+        export config drops context_parallel: the dense model decodes
+        outside shard_map (no 'context' axis to ring over)."""
+        import dataclasses as _dc
+
         from solvingpapers_tpu.models.gpt import GPT
 
         cfg = self.cfg
@@ -197,4 +218,5 @@ class GPTPipe:
                 dense[f"block_{s * cfg.layers_per_stage + j}"] = jax.tree.map(
                     lambda a: a[s], params["stages"][f"block_{j}"]
                 )
-        return GPT(cfg.block_cfg()), dense
+        dense_cfg = _dc.replace(cfg.block_cfg(), context_parallel=False)
+        return GPT(dense_cfg), dense

@@ -35,8 +35,15 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     # shard_map vma typing: carriers and the replicated input must be marked
-    # varying over the pipe axis before mixing with per-device values
-    microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
+    # varying over the pipe axis before mixing with per-device values — but
+    # only when vma tracking is active; under check_vma=False the pcast's
+    # TRANSPOSE (a psum over the axes) fails in the backward pass. Probe
+    # tracking via the stage params, which enter sharded over the pipe axis
+    # and therefore read as pipe-varying exactly when tracking is on.
+    probe = jax.tree.leaves(stage_params)[0]
+    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
+    if tracking and axis_name not in jax.typeof(microbatches).vma:
+        microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
     buf = jnp.zeros_like(microbatches[0])  # current activation on this device
     out = jnp.zeros_like(microbatches)     # collected at the last stage
 

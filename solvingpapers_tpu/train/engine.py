@@ -40,6 +40,14 @@ from solvingpapers_tpu.train.state import TrainState
 LossFn = Callable[..., tuple[jax.Array, dict, Any]]
 
 
+def _pp_param_spec(path, _leaf) -> P:
+    """shard_map in_spec for pipeline-parallel params: the stage-stacked
+    subtree (top-level 'stages' key, models/gpt_pipe.py) over 'pipe',
+    everything else replicated. One definition for both PP and CP+PP."""
+    key = getattr(path[0], "key", None) if path else None
+    return P("pipe") if key == "stages" else P()
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainConfig:
     steps: int = 1000
@@ -258,6 +266,41 @@ class Trainer:
                 "built with pipeline_parallel=True: it would scan stages "
                 "sequentially on every pipe device"
             )
+        self._check_pp_stages(mcfg)
+        # identical rng on every pipe device (they compute the same loss);
+        # decorrelate only across data shards. The loss is already
+        # invariant over 'pipe' (the pipeline output is psum-broadcast),
+        # so only the data axes are reduced.
+        return self._shard_map_loss_call(
+            ("data", "fsdp"), _pp_param_spec, rng_axes=("data", "fsdp")
+        )
+
+    def _cp_pp_loss_call(self):
+        """CP x PP composition: the sequence is sharded over 'context' AND
+        the stage-stacked params over 'pipe' — each stage's attention runs
+        the ppermute ring within its pipe coordinate's context group while
+        microbatches hop stages (orthogonal axes, uniform schedule). The
+        loss is invariant over 'pipe' (pipeline output psum-broadcast) and
+        pmean'd over the data/context axes (the vma-aware pmean reduces
+        exactly the axes each value varies over)."""
+        self._reject_axes(
+            "context_parallel+pipeline_parallel", ("fsdp", "model", "expert"),
+            "replicates non-stage params inside shard_map",
+        )
+        mcfg = getattr(self.model, "cfg", None)
+        for flag in ("context_parallel", "pipeline_parallel"):
+            if not getattr(mcfg, flag, False):
+                raise ValueError(
+                    f"TrainConfig CP+PP but the model was not built with "
+                    f"{flag}=True"
+                )
+        self._check_pp_stages(mcfg)
+        return self._shard_map_loss_call(
+            ("data", "fsdp", "context"), _pp_param_spec,
+            rng_axes=("data", "fsdp", "context"),
+        )
+
+    def _check_pp_stages(self, mcfg) -> None:
         pipe = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("pipe", 1)
         if getattr(mcfg, "n_stages", None) != pipe:
             raise ValueError(
@@ -265,18 +308,6 @@ class Trainer:
                 f"equal the mesh 'pipe' axis size ({pipe}): the GPipe body "
                 "holds exactly one stage per device"
             )
-
-        def param_spec(path, _leaf):
-            key = getattr(path[0], "key", None) if path else None
-            return P("pipe") if key == "stages" else P()
-
-        # identical rng on every pipe device (they compute the same loss);
-        # decorrelate only across data shards. The loss is already
-        # invariant over 'pipe' (the pipeline output is psum-broadcast),
-        # so only the data axes are reduced.
-        return self._shard_map_loss_call(
-            ("data", "fsdp"), param_spec, rng_axes=("data", "fsdp")
-        )
 
     def _reject_axes(self, mode: str, axes: tuple, why: str) -> None:
         sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
@@ -375,11 +406,8 @@ class Trainer:
     def _build_steps(self):
         replicated = NamedSharding(self.mesh, P())
         if self.config.context_parallel and self.config.pipeline_parallel:
-            raise NotImplementedError(
-                "context_parallel + pipeline_parallel composition is not "
-                "supported yet"
-            )
-        if self.config.context_parallel:
+            loss_call = self._cp_pp_loss_call()
+        elif self.config.context_parallel:
             loss_call = self._cp_loss_call()
         elif self.config.pipeline_parallel:
             loss_call = self._pp_loss_call()

@@ -152,3 +152,63 @@ def test_pp_export_to_dense_gpt_matches_and_decodes():
     ids = generate(gpt, dense_params, toks[:1, :8], jax.random.key(7),
                    max_new_tokens=8)
     assert ids.shape == (1, 16)
+
+
+@pytest.mark.parametrize("use_flash", [False, True], ids=["jnp", "flash"])
+def test_cp_pp_trainer_step_matches_dense(devices, use_flash):
+    """CP x PP (data=1 x context=2 x pipe=4): sequence sharded over
+    'context' with the ring inside each stage, stages over 'pipe' — must
+    equal the dense single-device staged scan."""
+    batch = _batch(jax.random.key(7), b=4, s=32)
+
+    d_model, d_train = _cfgs(False, MeshConfig(data=1))
+    dense = Trainer(GPTPipe(d_model), d_train,
+                    mesh=create_mesh(MeshConfig(data=1), devices[:1]))
+    d_state = dense.init_state(batch)
+    dense._build_steps()
+    d_state, d_metrics = dense._train_step(d_state, batch)
+
+    mesh_cfg = MeshConfig(data=1, context=2, pipe=4)
+    c_model, c_train = _cfgs(True, mesh_cfg)
+    c_model = dataclasses.replace(c_model, context_parallel=True,
+                                  use_flash=use_flash)
+    c_train = dataclasses.replace(c_train, context_parallel=True)
+    cp = Trainer(GPTPipe(c_model), c_train, rules=PP_RULES,
+                 mesh=create_mesh(mesh_cfg, devices))
+    c_state = cp.init_state(batch)
+    assert "pipe" in str(jax.tree.leaves(c_state.params["stages"])[0].sharding.spec)
+    cp._build_steps()
+    c_state, c_metrics = cp._train_step(c_state, batch)
+
+    np.testing.assert_allclose(
+        float(jax.device_get(c_metrics["train_loss"])),
+        float(jax.device_get(d_metrics["train_loss"])), rtol=2e-5,
+    )
+    for a, b in zip(jax.tree.leaves(jax.device_get(c_state.params)),
+                    jax.tree.leaves(jax.device_get(d_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_cp_pp_export_to_dense_decodes(devices):
+    """A CP+PP-trained GPTPipe must export to a DENSE (non-CP) GPT that
+    decodes outside shard_map."""
+    cfg = GPTPipeConfig(vocab_size=64, block_size=32, dim=32, n_layers=4,
+                        n_heads=2, n_stages=2, n_microbatches=2,
+                        pipeline_parallel=True, context_parallel=True)
+    model = GPTPipe(cfg)
+    mesh = create_mesh(MeshConfig(data=2, context=2, pipe=2), devices)
+    from jax.sharding import PartitionSpec as P
+
+    toks = jnp.zeros((2, 32), jnp.int32)
+    params = jax.shard_map(
+        lambda x: model.init({"params": jax.random.key(0)}, x)["params"],
+        mesh=mesh, in_specs=P(("data",), "context"), out_specs=P(),
+    )(toks)
+    gpt, dense_params = model.to_dense(jax.device_get(params))
+    assert not gpt.cfg.context_parallel
+    from solvingpapers_tpu.infer import generate
+
+    ids = generate(gpt, dense_params, toks[:1, :4], jax.random.key(1),
+                   max_new_tokens=4)
+    assert ids.shape == (1, 8)
