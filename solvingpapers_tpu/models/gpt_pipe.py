@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from solvingpapers_tpu.models.gpt import GPTBlock, GPTConfig
-from solvingpapers_tpu.models.layers import LayerNorm
+from solvingpapers_tpu.models.layers import LayerNorm, default_positions
 from solvingpapers_tpu.sharding.pipeline import pipeline_local_apply
 
 
@@ -160,8 +160,6 @@ class GPTPipe:
                 "decode caches are unsupported under pipeline parallelism; "
                 "export the params and restack for the dense GPT to decode"
             )
-        from solvingpapers_tpu.models.layers import default_positions
-
         cfg = self.cfg
         p = variables["params"]
         b, s = tokens.shape
@@ -207,8 +205,6 @@ class GPTPipe:
         module names are shared, so the forward is bit-identical. The
         export config drops context_parallel: the dense model decodes
         outside shard_map (no 'context' axis to ring over)."""
-        import dataclasses as _dc
-
         from solvingpapers_tpu.models.gpt import GPT
 
         cfg = self.cfg
@@ -218,5 +214,5 @@ class GPTPipe:
                 dense[f"block_{s * cfg.layers_per_stage + j}"] = jax.tree.map(
                     lambda a: a[s], params["stages"][f"block_{j}"]
                 )
-        dense_cfg = _dc.replace(cfg.block_cfg(), context_parallel=False)
+        dense_cfg = dataclasses.replace(cfg.block_cfg(), context_parallel=False)
         return GPT(dense_cfg), dense

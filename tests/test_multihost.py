@@ -1,0 +1,151 @@
+"""REAL multi-process (multi-"host") tests: two spawned processes form a
+jax.distributed cluster over CPU (Gloo collectives across processes — the
+DCN stand-in this environment allows), build one global mesh through
+`sharding.distributed.initialize`, feed host-local batch slices, and run a
+sharded GPT train step. The resulting loss/params must match the
+single-process run on the same global batch — upgrading the multi-host row
+(SURVEY.md §2.3) from unit-tested helpers to an executed cross-process
+training step.
+
+These tests spawn subprocesses with their own JAX runtimes, so they do NOT
+use the session fixture's in-process devices.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from solvingpapers_tpu.sharding.distributed import (
+        host_batch_slice,
+        host_seed,
+        initialize,
+    )
+
+    assert initialize(f"localhost:{port}", num_processes=nprocs, process_id=pid)
+    assert jax.process_count() == nprocs
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    cfg = GPTConfig(vocab_size=64, block_size=16, dim=16, n_layers=1,
+                    n_heads=2, dropout=0.0)
+    tcfg = TrainConfig(steps=1, batch_size=8, log_every=100, eval_every=0,
+                       optimizer=OptimizerConfig(name="sgd", max_lr=1e-1,
+                                                 warmup_steps=0, total_steps=4))
+    mesh = create_mesh(MeshConfig(data=-1))  # all 4 global devices
+    trainer = Trainer(GPT(cfg), tcfg, mesh=mesh)
+
+    # the SAME deterministic global batch on every host; each host feeds
+    # only its slice via jax.make_array_from_process_local_data
+    rng = np.random.default_rng(0)
+    gx = rng.integers(0, cfg.vocab_size, size=(tcfg.batch_size, cfg.block_size))
+    gy = np.roll(gx, -1, axis=1)
+    per, off = host_batch_slice(tcfg.batch_size)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(("data", "fsdp"), None))
+    batch = {
+        "x": jax.make_array_from_process_local_data(
+            sh, gx[off:off + per].astype(np.int32), gx.shape),
+        "y": jax.make_array_from_process_local_data(
+            sh, gy[off:off + per].astype(np.int32), gy.shape),
+    }
+    state = trainer.init_state(batch)
+    trainer._build_steps()
+    state, metrics = trainer._train_step(state, batch)
+    loss = float(jax.device_get(metrics["train_loss"]))
+    p0 = np.asarray(jax.device_get(
+        jax.tree.leaves(state.params)[0])).ravel()[:4].tolist()
+    seeds = host_seed(7)
+    print("RESULT " + json.dumps({
+        "pid": pid, "loss": loss, "p0": p0, "host_seed": seeds,
+        "devices": len(jax.devices()),
+    }))
+""")
+
+
+def _free_port() -> str:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return str(s.getsockname()[1])
+
+
+def _run_cluster(nprocs=2):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(i), str(nprocs), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(nprocs)
+    ]
+    results = {}
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            assert p.returncode == 0, out[-2000:]
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    r = json.loads(line[len("RESULT "):])
+                    results[r["pid"]] = r
+    finally:
+        for p in procs:  # no orphaned coordinators holding the port
+            if p.poll() is None:
+                p.kill()
+    assert len(results) == nprocs, results
+    return results
+
+
+@pytest.mark.multihost  # deselect with -m "not multihost" where TCP is blocked
+def test_two_process_training_step_matches_single_process():
+    res = _run_cluster()
+    # both processes see the 4-device global mesh and agree on the loss
+    assert res[0]["devices"] == 4 and res[1]["devices"] == 4
+    np.testing.assert_allclose(res[0]["loss"], res[1]["loss"], rtol=1e-6)
+    np.testing.assert_allclose(res[0]["p0"], res[1]["p0"], rtol=1e-6)
+    # per-host seeds are distinct and deterministic
+    assert res[0]["host_seed"] != res[1]["host_seed"]
+    assert res[0]["host_seed"] == 7 * 1_000_003
+
+    # single-process oracle on the identical global batch
+    oracle_port = _free_port()
+    code = _WORKER.replace('int(sys.argv[1])', '0').replace(
+        'int(sys.argv[2])', '1')
+    code = code.replace('device_count=2', 'device_count=4')
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code, "0", "1", oracle_port],
+        capture_output=True, text=True, env=env, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    single = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith("RESULT ")][0][7:]
+    )
+    np.testing.assert_allclose(res[0]["loss"], single["loss"], rtol=1e-5)
+    np.testing.assert_allclose(res[0]["p0"], single["p0"], rtol=1e-4)
