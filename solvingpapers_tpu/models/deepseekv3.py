@@ -280,7 +280,15 @@ class MoELayer(nn.Module):
             )(xt)
 
         # one load reduction (+ one cross-shard collective under CP) shared
-        # by the bias update and the sown stats
+        # by the bias update and the sown stats. probs_g: along a ZeRO'd
+        # 'expert' axis every member holds identical probs (tokens are
+        # replicated across it) but the vma types them varying after the
+        # gathered expert weights touch the residual stream — the pmean is
+        # a numeric no-op that certifies the invariant-state contract.
+        probs_g = (
+            jax.lax.pmean(probs, "expert") if cfg.stats_axes is not None
+            else probs
+        )
         ci = None
         if (
             cfg.use_aux_free
@@ -289,21 +297,21 @@ class MoELayer(nn.Module):
         ):
             # stats_axes: under shard_map the load is psum'd so every shard
             # applies the identical bias update (shard-invariant state)
-            ci = ops.moe.expert_load(probs, cfg.stats_axes)
+            ci = ops.moe.expert_load(probs_g, cfg.stats_axes)
             bias.value = ops.moe.aux_free_bias_update(
-                probs, bias.value, cfg.aux_free_bias_update_rate, ci=ci
+                probs_g, bias.value, cfg.aux_free_bias_update_rate, ci=ci
             )
 
         if self.is_mutable_collection("moe_metrics"):
             # load-balance observability (SURVEY.md hard part #1): sown per
             # layer, aggregated into train metrics by dsv3_loss_fn
             stats = ops.moe.load_balance_stats(
-                probs, axis_names=cfg.stats_axes, ci=ci
+                probs_g, axis_names=cfg.stats_axes, ci=ci
             )
             stats["drop_fraction"] = (
                 jnp.zeros(()) if cfg.moe_impl == "dense"
                 else ops.moe.dispatch_drop_fraction(
-                    probs, cap, axis_names=cfg.stats_axes
+                    probs_g, cap, axis_names=cfg.stats_axes
                 )
             )
             stats["bias_norm"] = jnp.linalg.norm(bias.value)

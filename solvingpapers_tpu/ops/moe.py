@@ -137,6 +137,32 @@ def load_balance_stats(
     return {"load_entropy": entropy, "load_max_fraction": jnp.max(load)}
 
 
+def moe_expert_sliced_combine(
+    x: jax.Array,
+    probs: jax.Array,
+    expert_fn,
+    capacity: int,
+    axis_name: str = "expert",
+) -> jax.Array:
+    """Expert-parallel MoE for shard_map bodies: the caller's expert
+    weights are SHARDED over `axis_name` (each member holds E/ep experts)
+    while tokens/probs are replicated across it. Each member dispatches its
+    local expert columns (identical slot assignment to the unsharded
+    dispatch, per-column independent), runs `expert_fn((E_local, C, D))`,
+    and the partial combines psum over the axis. No all_to_all needed —
+    token replication over 'expert' makes EP a slice + reduce, composing
+    freely with the data/context axes of the same shard_map."""
+    t, e = probs.shape
+    ep = jax.lax.psum(1, axis_name)
+    if e % ep:
+        raise ValueError(f"{e} experts not divisible by '{axis_name}' axis {ep}")
+    e_local = e // ep
+    start = jax.lax.axis_index(axis_name) * e_local
+    probs_local = jax.lax.dynamic_slice(probs, (0, start), (t, e_local))
+    partial = moe_dispatch_combine(x, probs_local, expert_fn, capacity)
+    return jax.lax.psum(partial, axis_name)
+
+
 def moe_dense_combine(x: jax.Array, probs: jax.Array, expert_fn_all) -> jax.Array:
     """Drop-free reference path: run every expert on every token.
 

@@ -208,7 +208,7 @@ class Trainer:
         sizes make that exact); gradients psum/reduce-scatter through
         shard_map's transpose automatically."""
         self._reject_axes(
-            "context_parallel", ("model", "expert", "pipe"),
+            "context_parallel", ("model", "pipe"),
             "replicates params inside shard_map",
         )
         if not getattr(getattr(self.model, "cfg", None), "context_parallel", False):
@@ -222,30 +222,52 @@ class Trainer:
         # FSDP composes: params enter shard_map in their stored (sharded)
         # layout and are all-gathered over 'fsdp' inside the step — the
         # gather's transpose reduce-scatters the grads, i.e. ZeRO-3, so
-        # per-device param memory stays 1/fsdp at rest. Decorrelate dropout
+        # per-device param memory stays 1/fsdp at rest. The 'expert' axis
+        # composes the same way (ZeRO over expert weights: sharded at
+        # rest, gathered in-step, grads reduce-scattered); sliced-COMPUTE
+        # EP stays on the GSPMD path outside shard_map (flax validates
+        # param shapes at apply, so a module can't receive expert slices;
+        # ops.moe.moe_expert_sliced_combine carries the shard_map EP
+        # compute pattern for functional callers). Decorrelate dropout
         # across every shard: each holds a different (batch, seq) slice.
+        # 'expert' is in the reduce axes only for typing: gathered expert
+        # weights read as expert-varying (all_gather proves no invariance),
+        # and the pmean — a numeric no-op across identical members — is
+        # what certifies the out_specs P() replication
         return self._shard_map_loss_call(
-            ("data", "fsdp", "context"), self._fsdp_param_specs(),
+            ("data", "fsdp", "context", "expert"), self._fsdp_param_specs(),
             rng_axes=("data", "fsdp", "context"), gather_fsdp=True,
         )
 
     def _fsdp_param_specs(self):
         """(path, leaf) -> P giving each param's STORED layout restricted
-        to the 'fsdp' axis — derived from the same rule table/mesh as the
-        state shardings, so it needs no init_state precondition (evaluate /
-        fit with an external state build steps without one). model/expert/
-        pipe are rejected above; their size-1 names in the rule table would
-        otherwise mark values conservatively varying over those axes."""
+        to the 'fsdp' and 'expert' axes — derived from the same rule
+        table/mesh as the state shardings, so it needs no init_state
+        precondition (evaluate / fit with an external state build steps
+        without one). Both axes' dims are gathered in-step (ZeRO layout at
+        rest). model/pipe are rejected above; their size-1 names in the
+        rule table would otherwise mark values conservatively varying over
+        those axes."""
         from solvingpapers_tpu.sharding.rules import leaf_spec
 
-        def only_fsdp(spec):
+        def keep(spec):
             def f(entry):
                 names = entry if isinstance(entry, tuple) else (entry,)
-                return "fsdp" if "fsdp" in names else None
+                kept = tuple(n for n in names if n in ("fsdp", "expert"))
+                if len(kept) > 1:
+                    # gather_param reassembles one name at a time, which
+                    # would interleave a jointly-sharded dim's chunks in
+                    # the wrong order — no shipped rule co-shards a dim
+                    # over both axes, so refuse rather than corrupt
+                    raise NotImplementedError(
+                        f"dim jointly sharded over {kept} is not supported "
+                        "by the in-step ZeRO gather"
+                    )
+                return kept[0] if kept else None
 
             return P(*(f(e) if e is not None else None for e in spec))
 
-        return lambda path, leaf: only_fsdp(
+        return lambda path, leaf: keep(
             leaf_spec(path, leaf, self.rules, self.mesh)
         )
 
