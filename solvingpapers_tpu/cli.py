@@ -46,11 +46,26 @@ def _add_common(p: argparse.ArgumentParser) -> None:
 
 
 def _apply_platform(args) -> None:
+    """Apply --platform/--virtual-devices. Called from main() BEFORE any
+    command code touches jax attributes: XLA reads XLA_FLAGS at backend
+    initialization, so mutating it after a backend exists is a silent no-op
+    — fail loudly instead of quietly running on the wrong device count."""
     n = getattr(args, "virtual_devices", None)
     if n:
         import os
         import re
 
+        try:  # private, so degrade to best-effort if the API moves
+            from jax._src import xla_bridge
+
+            if xla_bridge.backends_are_initialized():
+                raise RuntimeError(
+                    "--virtual-devices must be applied before any JAX "
+                    "backend initializes, but one already has; re-exec with "
+                    f"XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+                )
+        except (ImportError, AttributeError):
+            pass
         flags = re.sub(
             r"--xla_force_host_platform_device_count=\d+", "",
             os.environ.get("XLA_FLAGS", ""),
@@ -71,7 +86,6 @@ def cmd_list(_args) -> int:
 
 
 def cmd_train(args) -> int:
-    _apply_platform(args)
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import (
         build_char_lm_run,
@@ -215,7 +229,6 @@ def _train_kd(cfg, mesh, writer) -> int:
 
 
 def cmd_sample(args) -> int:
-    _apply_platform(args)
     from solvingpapers_tpu import ops
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import build_char_lm_run
@@ -289,7 +302,6 @@ def _restore_for_inference(cfg, model, checkpoint_dir, example_batch, trainer=No
 def cmd_eval(args) -> int:
     """estimate_loss over the held-out split (gpt cell 14 / gemma cell 17 /
     dsv3 cell 48) or accuracy for classifiers (ViT cell 15, kd.py:145)."""
-    _apply_platform(args)
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import (
         build_char_lm_run,
@@ -336,7 +348,6 @@ def cmd_eval(args) -> int:
 
 def cmd_export(args) -> int:
     """Params-only export (the reference publishes bare weights to HF)."""
-    _apply_platform(args)
     from solvingpapers_tpu.checkpoint import export_params
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import (
@@ -402,6 +413,9 @@ def main(argv=None) -> int:
     p_export.add_argument("--out", required=True)
 
     args = parser.parse_args(argv)
+    if args.cmd != "list":
+        # before any command code touches jax (see _apply_platform docstring)
+        _apply_platform(args)
     return {
         "list": cmd_list,
         "train": cmd_train,

@@ -168,7 +168,9 @@ class GPTPipe:
                 b, s, cfg.context_parallel, max_positions=cfg.block_size
             )
         x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
-        x = x + jnp.take(p["pos_emb"], positions[0], axis=0)
+        # full (B, S) positions like models/gpt.py — positions[0] would
+        # silently apply the first row's positions to every batch row
+        x = x + jnp.take(p["pos_emb"], positions, axis=0)
         x = x.astype(cfg.compute_dtype)
 
         if cfg.pipeline_parallel:

@@ -316,6 +316,14 @@ def ring_flash_attention_local(
     n_kv = k.shape[2]
     if n % n_kv:
         raise ValueError(f"q heads {n} not a multiple of kv heads {n_kv}")
+    if k.shape[1] != s_loc:
+        # square per-shard chunks are the ring contract: the merge treats
+        # the kernel's empty-row lse=0 sentinel as real unit mass, which
+        # unequal shard lengths could trigger
+        raise ValueError(
+            f"ring chunks must be square: q shard seq {s_loc} != kv shard "
+            f"seq {k.shape[1]}"
+        )
     if scale is None:
         scale = h**-0.5
     if interpret is None:

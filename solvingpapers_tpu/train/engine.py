@@ -179,14 +179,20 @@ class Trainer:
     def _set_batch_shardings(self, example_batch: dict) -> None:
         """Record rank-appropriate batch shardings (x may be 2-D tokens or
         4-D images; y may be 2-D targets or 1-D labels). Under context
-        parallelism, the sequence dim of rank-2 token arrays is sharded over
-        'context' in addition to the batch dim over (data, fsdp)."""
+        parallelism, the sequence dim of rank-2 arrays under the
+        sequence-aligned keys 'x'/'y' is sharded over 'context' in addition
+        to the batch dim over (data, fsdp) — the key gate keeps a rank-2
+        non-sequence array (e.g. (B, n_classes) soft labels) from being
+        silently mis-sharded over 'context'."""
         cp = self.config.context_parallel
-        self._batch_shardings = jax.tree.map(
-            lambda a: batch_sharding(
-                self.mesh, jnp.ndim(a) - 1, context=cp and jnp.ndim(a) == 2
-            ),
-            example_batch,
+
+        def shard(path, a):
+            key = getattr(path[0], "key", None) if path else None
+            seq = cp and jnp.ndim(a) == 2 and key in ("x", "y")
+            return batch_sharding(self.mesh, jnp.ndim(a) - 1, context=seq)
+
+        self._batch_shardings = jax.tree_util.tree_map_with_path(
+            shard, example_batch
         )
 
     def _batch_specs(self):
