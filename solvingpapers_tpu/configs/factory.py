@@ -188,6 +188,17 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
             )
         tok = _IdTok()
         train_toks, val_toks = split_train_val(toks)
+    elif cfg.data.get("source") == "markov":
+        # entropy-calibrated corpus: val loss has an absolute target
+        # (MarkovSource.entropy_rate_nats) that memorization cannot reach;
+        # markov_text shares chain defaults with markov_entropy_nats so the
+        # trained-on corpus and the gating floor come from the same chain
+        from solvingpapers_tpu.data.char import CharTokenizer, split_train_val
+        from solvingpapers_tpu.data.synthetic import markov_text
+
+        text = markov_text(cfg.data)
+        tok = CharTokenizer(text)
+        train_toks, val_toks = split_train_val(tok.encode(text))
     else:
         tok, train_toks, val_toks = load_char_corpus(path=cfg.data.get("path"))
     block = cfg.data.get("block_size", 256)

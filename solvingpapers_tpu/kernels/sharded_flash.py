@@ -28,19 +28,31 @@ def sharded_flash_attention(
     scale: float | None = None,
     dropout_rate: float = 0.0,
     dropout_seed: jax.Array | int = 0,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """flash_attention with BSNH operands partitioned over `mesh`:
     batch over ('data','fsdp'), heads over 'model'. Seq stays unsharded
-    (use ring_attention for context parallelism)."""
-    n_heads, n_kv = q.shape[2], k.shape[2]
+    (use ring_attention for context parallelism).
+
+    MQA (n_kv == 1, e.g. absorbed-query MLA where k = v = latents) keeps
+    its single kv head replicated over the model axis while q heads shard:
+    the kernel's local q->kv head map (h * n_kv_local // n_heads_local)
+    then resolves every local q head to kv head 0, which is correct."""
+    b, n_heads, n_kv = q.shape[0], q.shape[2], k.shape[2]
     tp = mesh.shape.get("model", 1)
-    if n_heads % tp or n_kv % tp:
+    dp = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    if b % dp:
         raise ValueError(
-            f"heads ({n_heads} q / {n_kv} kv) must divide the model axis ({tp})"
+            f"batch {b} must be divisible by the data x fsdp axes ({dp})"
+        )
+    if n_heads % tp or (n_kv % tp and n_kv != 1):
+        raise ValueError(
+            f"heads ({n_heads} q / {n_kv} kv) must divide the model axis "
+            f"({tp}); only n_kv == 1 (MQA/MLA) may stay replicated"
         )
 
     spec = P(("data", "fsdp"), None, "model", None)
+    kv_spec = spec if n_kv % tp == 0 else P(("data", "fsdp"), None, None, None)
     seed = jax.numpy.asarray(dropout_seed, jax.numpy.int32)
 
     def local(q, k, v, seed):
@@ -65,6 +77,6 @@ def sharded_flash_attention(
     # embarrassingly parallel over every sharded axis so the check adds
     # nothing here
     return jax.shard_map(
-        local, mesh=mesh, in_specs=(spec, spec, spec, P()), out_specs=spec,
-        check_vma=False,
+        local, mesh=mesh, in_specs=(spec, kv_spec, kv_spec, P()),
+        out_specs=spec, check_vma=False,
     )(q, k, v, seed)

@@ -281,16 +281,28 @@ def apply_flash_attention(module, q, k, v, *, causal, scale=None,
     dropout on real TPU (same Bernoulli semantics as the dense path; mask
     regenerated in the backward from the seed, never materialized); when
     dropout is active OFF-TPU the dense path runs instead — interpret-mode
-    pltpu PRNG is a zero stub, so in-kernel dropout cannot run there."""
-    from solvingpapers_tpu.kernels import flash_attention
+    pltpu PRNG is a zero stub, so in-kernel dropout cannot run there.
+
+    On a >1-device GSPMD mesh (Trainer marks it via sharding.ambient_mesh)
+    the call routes through kernels.sharded_flash_attention — pallas_call is
+    opaque to GSPMD, so the direct call would silently all-gather q/k/v
+    (losing DP batch partitioning and TP head partitioning alike)."""
+    from solvingpapers_tpu.kernels import flash_attention, sharded_flash_attention
     from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+    from solvingpapers_tpu.sharding import get_ambient_mesh
+
+    mesh = get_ambient_mesh()
+    if mesh is not None and mesh.devices.size > 1:
+        kernel = functools.partial(sharded_flash_attention, mesh=mesh)
+    else:
+        kernel = flash_attention
 
     if dropout_rate > 0.0 and not deterministic:
         if is_tpu_backend():
             seed = jax.random.randint(
                 module.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max
             )
-            return flash_attention(
+            return kernel(
                 q, k, v, causal=causal, scale=scale,
                 dropout_rate=dropout_rate, dropout_seed=seed,
             )
@@ -298,7 +310,7 @@ def apply_flash_attention(module, q, k, v, *, causal, scale=None,
             q, k, v, causal=causal, scale=scale, dropout_rate=dropout_rate,
             dropout_rng=module.make_rng("dropout"), deterministic=False,
         )
-    return flash_attention(q, k, v, causal=causal, scale=scale)
+    return kernel(q, k, v, causal=causal, scale=scale)
 
 
 def maybe_remat(block_cls, remat: bool, caches) -> type:

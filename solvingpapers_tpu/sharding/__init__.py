@@ -12,9 +12,11 @@ parallelism.
 from solvingpapers_tpu.sharding.mesh import (
     MESH_AXES,
     MeshConfig,
+    ambient_mesh,
     create_mesh,
     batch_spec,
     batch_sharding,
+    get_ambient_mesh,
 )
 from solvingpapers_tpu.sharding.rules import (
     GPT_RULES,

@@ -15,6 +15,8 @@ single chip every axis has size 1 and all of this compiles to a no-op.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import math
 
@@ -23,6 +25,31 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 MESH_AXES = ("data", "fsdp", "model", "expert", "context", "pipe")
+
+# The mesh a GSPMD-partitioned model is currently tracing under (set by the
+# Trainer around its non-shard_map step/init bodies). pallas_call is opaque
+# to GSPMD — without this, a use_flash model under a >1-device mesh would
+# silently all-gather its attention operands; with it, apply_flash_attention
+# routes through the shard_map-wrapped kernels.sharded_flash_attention.
+# Inside CP/PP shard_map bodies this stays None: operands there are already
+# local, so the direct kernel call is correct.
+_AMBIENT_MESH: contextvars.ContextVar[Mesh | None] = contextvars.ContextVar(
+    "ambient_gspmd_mesh", default=None
+)
+
+
+@contextlib.contextmanager
+def ambient_mesh(mesh: Mesh | None):
+    """Mark `mesh` as the GSPMD mesh for code traced within this scope."""
+    token = _AMBIENT_MESH.set(mesh)
+    try:
+        yield
+    finally:
+        _AMBIENT_MESH.reset(token)
+
+
+def get_ambient_mesh() -> Mesh | None:
+    return _AMBIENT_MESH.get()
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,6 +29,7 @@ from solvingpapers_tpu.metrics import ConsoleWriter, MetricsWriter
 from solvingpapers_tpu.sharding import (
     LM_RULES,
     MeshConfig,
+    ambient_mesh,
     batch_sharding,
     create_mesh,
     param_specs,
@@ -150,7 +151,8 @@ class Trainer:
                     out_specs=P(), check_vma=self._check_vma(),
                 )(rngs, example_batch)
             else:
-                out = self.init_fn(self.model, rngs, example_batch)
+                with ambient_mesh(self.mesh):
+                    out = self.init_fn(self.model, rngs, example_batch)
             # init_fn may return params alone or (params, model_state)
             params, model_state = out if isinstance(out, tuple) else (out, None)
             return TrainState.create(
@@ -440,9 +442,13 @@ class Trainer:
         elif self.config.pipeline_parallel:
             loss_call = self._pp_loss_call()
         else:
-            loss_call = lambda params, ms, batch, rng, train: self.loss_fn(  # noqa: E731
-                self.model, params, batch, rng, ms, train
-            )
+            def loss_call(params, ms, batch, rng, train):
+                # mark the GSPMD mesh while the model traces so use_flash
+                # attention routes through the shard_map-wrapped kernel on
+                # >1-device meshes (pallas_call is opaque to GSPMD — the
+                # direct call would all-gather q/k/v)
+                with ambient_mesh(self.mesh):
+                    return self.loss_fn(self.model, params, batch, rng, ms, train)
 
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)

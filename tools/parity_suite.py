@@ -33,7 +33,14 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 REGRESSION_TOL = {  # metric -> allowed worsening vs the best prior round
     "val_loss": 0.05,
     "accuracy": -0.01,  # may drop at most 1 point
+    "gap_to_entropy": 0.05,
 }
+
+# Absolute quality bar for the entropy-calibrated (markov) rows: held-out
+# loss must land within this many nats of the corpus' exact entropy rate.
+# A memorizing model sits near ln(64)-H ~= 1.8 nats above the floor, so
+# this target separates generalization from table lookup by ~7x margin.
+GAP_TARGET_NATS = 0.25
 
 
 def _run_lm(name: str, steps: int, data_path: str | None):
@@ -50,7 +57,7 @@ def _run_lm(name: str, steps: int, data_path: str | None):
     from solvingpapers_tpu.train import Trainer
 
     cfg = get_config(name, steps=steps)
-    if data_path:
+    if data_path and cfg.data.get("source") != "markov":
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": data_path})
     mesh = create_mesh(cfg.train.mesh)
     cfg, model, _, train_iter, eval_iter_fn = build_char_lm_run(
@@ -64,6 +71,12 @@ def _run_lm(name: str, steps: int, data_path: str | None):
     wall = time.perf_counter() - t0
     out = {"steps": steps, "wall_s": round(wall, 1)}
     out.update({k: round(float(v), 5) for k, v in val.items()})
+    if cfg.data.get("source") == "markov":
+        from solvingpapers_tpu.data.synthetic import markov_entropy_nats
+
+        h = markov_entropy_nats(cfg.data)
+        out["entropy_nats"] = round(h, 5)
+        out["gap_to_entropy"] = round(out["val_loss"] - h, 5)
     return out
 
 
@@ -114,7 +127,18 @@ def check_regressions(history: list[dict], current: dict) -> list[str]:
     """Compare the current round's numbers against the best prior round."""
     flags = []
     for wl, res in current["workloads"].items():
-        for metric, tol in (("val_loss", REGRESSION_TOL["val_loss"]),):
+        gap = res.get("gap_to_entropy")
+        # the absolute target is calibrated for the full pinned schedule;
+        # --fast (trimmed steps) rows keep the relative regression gates only
+        if gap is not None and not current.get("fast") and gap > GAP_TARGET_NATS:
+            flags.append(
+                f"{wl}.gap_to_entropy: {gap} nats above the corpus entropy "
+                f"floor (absolute target {GAP_TARGET_NATS})"
+            )
+        for metric, tol in (
+            ("val_loss", REGRESSION_TOL["val_loss"]),
+            ("gap_to_entropy", REGRESSION_TOL["gap_to_entropy"]),
+        ):
             if metric not in res:
                 continue
             prior = [
@@ -167,10 +191,17 @@ def main() -> int:
         ("dsv3_tinystories", _run_lm, 2000 // div, args.data_path),
         ("vit_mnist", _run_image, 1200 // div, args.image_path),
         ("kd_mnist", _run_image, 1200 // div, args.image_path),
+        # entropy-calibrated rows: val_loss - H is an absolute quality bar
+        # (H is the markov corpus' exact entropy rate; memorization fails it)
+        ("gpt_markov", _run_lm, 3000 // div, None),
+        ("llama3_markov", _run_lm, 3000 // div, None),
+        ("gemma_markov", _run_lm, 3000 // div, None),
+        ("dsv3_markov", _run_lm, 3000 // div, None),
     ]
 
     current: dict = {
         "round": args.round,
+        "fast": bool(args.fast),
         "time": time.strftime("%Y-%m-%d %H:%M:%S"),
         "data": {"text": args.data_path or "synthetic(seed 0)",
                  "images": args.image_path or "synthetic separable set"},
