@@ -106,12 +106,13 @@ class MarkovSource:
         """The single source of chain hyperparameter defaults — used by both
         the data factory (corpus construction) and markov_entropy_nats (the
         gating floor), so the trained-on chain and the entropy target can
-        never drift apart."""
-        return cls(
-            vocab=data_cfg.get("markov_vocab", 64),
-            order=data_cfg.get("markov_order", 2),
-            alpha=data_cfg.get("markov_alpha", 0.1),
-            seed=data_cfg.get("markov_seed", 1234),
+        never drift apart. Returns a cached instance per parameter tuple
+        (the Dirichlet draw + power iteration are worth building once)."""
+        return _cached_source(
+            data_cfg.get("markov_vocab", 64),
+            data_cfg.get("markov_order", 2),
+            data_cfg.get("markov_alpha", 0.1),
+            data_cfg.get("markov_seed", 1234),
         )
 
     def sample(self, n_chars: int, seed: int = 0) -> str:
@@ -132,27 +133,31 @@ class MarkovSource:
         return syms[out].tobytes().decode()
 
 
+@functools.lru_cache(maxsize=4)
+def _cached_source(vocab: int, order: int, alpha: float, seed: int) -> MarkovSource:
+    return MarkovSource(vocab=vocab, order=order, alpha=alpha, seed=seed)
+
+
 def markov_entropy_nats(data_cfg: dict) -> float:
     """Entropy rate for a ``{"source": "markov", ...}`` data config — the
     absolute val-loss target its corpus carries."""
     return MarkovSource.from_config(data_cfg).entropy_rate_nats
 
 
-@functools.lru_cache(maxsize=4)
-def _markov_text_cached(vocab: int, order: int, alpha: float, seed: int,
-                        n_chars: int, sample_seed: int) -> str:
-    return MarkovSource(vocab=vocab, order=order, alpha=alpha,
-                        seed=seed).sample(n_chars, seed=sample_seed)
+@functools.lru_cache(maxsize=8)
+def _sample_cached(src: MarkovSource, n_chars: int, sample_seed: int) -> str:
+    # keyed on the cached source INSTANCE (identity-stable via _cached_source)
+    return src.sample(n_chars, seed=sample_seed)
 
 
 def markov_text(data_cfg: dict) -> str:
     """Corpus text for a markov data config. Cached: the parity suite's four
     LM rows share one pinned chain, and the sequential sampler is a
     per-character Python loop (~10s per 4M chars) worth running once."""
-    return _markov_text_cached(
-        data_cfg.get("markov_vocab", 64), data_cfg.get("markov_order", 2),
-        data_cfg.get("markov_alpha", 0.1), data_cfg.get("markov_seed", 1234),
-        data_cfg.get("n_chars", 1_000_000), data_cfg.get("sample_seed", 0),
+    return _sample_cached(
+        MarkovSource.from_config(data_cfg),
+        data_cfg.get("n_chars", 1_000_000),
+        data_cfg.get("sample_seed", 0),
     )
 
 

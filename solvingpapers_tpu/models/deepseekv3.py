@@ -257,10 +257,15 @@ class MoELayer(nn.Module):
 
             out = ops.moe.moe_dense_combine(xt, probs, expert_fn_all)
         else:
+            def expert_body(xe, w1s, w2s, w3s):  # (E', C, D) -> (E', C, D)
+                a = jnp.einsum("ecd,edh->ech", xe, w1s)
+                g = jnp.einsum("ecd,edh->ech", xe, w2s)
+                return jnp.einsum("ech,ehd->ecd", ops.swish(a) * g, w3s)
+
             def expert_fn(xe):  # (E, C, D) -> (E, C, D)
-                a = jnp.einsum("ecd,edh->ech", xe, w1.astype(dt))
-                g = jnp.einsum("ecd,edh->ech", xe, w2.astype(dt))
-                return jnp.einsum("ech,ehd->ecd", ops.swish(a) * g, w3.astype(dt))
+                return expert_body(
+                    xe, w1.astype(dt), w2.astype(dt), w3.astype(dt)
+                )
 
             # under CP/shard_map b*s is the LOCAL token count, so capacity
             # is per-shard — the standard distributed-MoE dispatch
@@ -271,7 +276,29 @@ class MoELayer(nn.Module):
             cap = ops.moe.expert_capacity(
                 b * s, e, cfg.top_experts, cfg.capacity_factor
             )
-            out = ops.moe.moe_dispatch_combine(xt, probs, expert_fn, cap)
+            if cfg.context_parallel:
+                # inside the CP shard_map the 'expert' mesh axis shards
+                # expert COMPUTE, not just storage: the in-step ZeRO gather
+                # hands every member the full (E, ...) stacks, but each
+                # member dispatches only its E/ep expert columns against its
+                # own slice and the partial combines psum over the axis
+                # (ops.moe.moe_expert_sliced_combine). With ep == 1 the
+                # slice is the whole stack and this is exactly the line
+                # above. probs stay replicated over 'expert' (gate weights
+                # are), so slot assignment per column matches unsharded.
+                def expert_fn_sliced(xe):  # (E/ep, C, D) -> (E/ep, C, D)
+                    e_local = xe.shape[0]
+                    start = jax.lax.axis_index("expert") * e_local
+                    sl = lambda w: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+                        w.astype(dt), start, e_local, 0
+                    )
+                    return expert_body(xe, sl(w1), sl(w2), sl(w3))
+
+                out = ops.moe.moe_expert_sliced_combine(
+                    xt, probs, expert_fn_sliced, cap, axis_name="expert"
+                )
+            else:
+                out = ops.moe.moe_dispatch_combine(xt, probs, expert_fn, cap)
 
         if cfg.use_shared_expert:
             out = out + GLUFFN(

@@ -231,12 +231,13 @@ class Trainer:
         # layout and are all-gathered over 'fsdp' inside the step — the
         # gather's transpose reduce-scatters the grads, i.e. ZeRO-3, so
         # per-device param memory stays 1/fsdp at rest. The 'expert' axis
-        # composes the same way (ZeRO over expert weights: sharded at
-        # rest, gathered in-step, grads reduce-scattered); sliced-COMPUTE
-        # EP stays on the GSPMD path outside shard_map (flax validates
-        # param shapes at apply, so a module can't receive expert slices;
-        # ops.moe.moe_expert_sliced_combine carries the shard_map EP
-        # compute pattern for functional callers). Decorrelate dropout
+        # composes as ZeRO over expert STORAGE (sharded at rest, gathered
+        # in-step, grads reduce-scattered) plus sliced expert COMPUTE:
+        # MoELayer under context_parallel dispatches only its E/ep expert
+        # columns and psums the partial combines over 'expert'
+        # (ops.moe.moe_expert_sliced_combine — flax validates param shapes
+        # at apply, so slicing happens inside the layer after the gather,
+        # not in the param pytree). Decorrelate dropout
         # across every shard: each holds a different (batch, seq) slice.
         # 'expert' is in the reduce axes only for typing: gathered expert
         # weights read as expert-varying (all_gather proves no invariance),

@@ -436,3 +436,40 @@ def test_dsv3_cp_ep_train_step_matches_dense(devices):
                     jax.tree.leaves(jax.device_get(d_state.params))):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-4, atol=3e-4)
+
+
+def test_cp_ep_uses_sliced_expert_compute(devices, monkeypatch):
+    """Under the CP shard_map the MoE layer must go through
+    moe_expert_sliced_combine (sharded expert FLOPs), not the replicated
+    full-stack dispatch — the equality test above would pass either way."""
+    import dataclasses as dc
+
+    from solvingpapers_tpu import ops as sp_ops
+
+    calls = {"sliced": 0}
+    real = sp_ops.moe.moe_expert_sliced_combine
+
+    def spy(*args, **kwargs):
+        calls["sliced"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(sp_ops.moe, "moe_expert_sliced_combine", spy)
+
+    cfg = dc.replace(TINY, block_size=32, dropout=0.0, attn_dropout=0.0,
+                     context_parallel=True)
+    batch_x = jax.random.randint(jax.random.key(5), (4, 32), 0, cfg.vocab_size)
+    batch = {"x": batch_x, "y": jnp.roll(batch_x, -1, axis=1)}
+    mesh_cfg = MeshConfig(data=2, context=2, expert=2)
+    tcfg = TrainConfig(
+        steps=1, batch_size=4, log_every=1, eval_every=0,
+        context_parallel=True, mesh=mesh_cfg,
+        optimizer=OptimizerConfig(name="sgd", max_lr=1e-1, warmup_steps=0,
+                                  total_steps=4),
+    )
+    tr = Trainer(DeepSeekV3(cfg), tcfg, loss_fn=dsv3_loss_fn,
+                 init_fn=dsv3_init_fn, mesh=create_mesh(mesh_cfg, devices))
+    state = tr.init_state(batch)
+    tr._build_steps()
+    state, metrics = tr._train_step(state, batch)
+    assert calls["sliced"] > 0, "CP step did not take the sliced-EP path"
+    assert float(jax.device_get(metrics["train_loss"])) > 0
