@@ -144,20 +144,24 @@ def markov_entropy_nats(data_cfg: dict) -> float:
     return MarkovSource.from_config(data_cfg).entropy_rate_nats
 
 
-@functools.lru_cache(maxsize=8)
-def _sample_cached(src: MarkovSource, n_chars: int, sample_seed: int) -> str:
-    # keyed on the cached source INSTANCE (identity-stable via _cached_source)
-    return src.sample(n_chars, seed=sample_seed)
+@functools.lru_cache(maxsize=4)
+def _sample_cached(vocab: int, order: int, alpha: float, seed: int,
+                   n_chars: int, sample_seed: int) -> str:
+    # value-tuple key (not source identity): entries stay reachable even
+    # after the source instance is evicted from _cached_source
+    return _cached_source(vocab, order, alpha, seed).sample(
+        n_chars, seed=sample_seed
+    )
 
 
 def markov_text(data_cfg: dict) -> str:
     """Corpus text for a markov data config. Cached: the parity suite's four
     LM rows share one pinned chain, and the sequential sampler is a
     per-character Python loop (~10s per 4M chars) worth running once."""
+    src = MarkovSource.from_config(data_cfg)
     return _sample_cached(
-        MarkovSource.from_config(data_cfg),
-        data_cfg.get("n_chars", 1_000_000),
-        data_cfg.get("sample_seed", 0),
+        src.vocab, src.order, src.alpha, src.seed,
+        data_cfg.get("n_chars", 1_000_000), data_cfg.get("sample_seed", 0),
     )
 
 

@@ -148,10 +148,13 @@ def moe_expert_sliced_combine(
     weights are SHARDED over `axis_name` (each member holds E/ep experts)
     while tokens/probs are replicated across it. Each member dispatches its
     local expert columns (identical slot assignment to the unsharded
-    dispatch, per-column independent), runs `expert_fn((E_local, C, D))`,
-    and the partial combines psum over the axis. No all_to_all needed —
-    token replication over 'expert' makes EP a slice + reduce, composing
-    freely with the data/context axes of the same shard_map."""
+    dispatch, per-column independent), runs
+    ``expert_fn((E_local, C, D), start)`` — `start` is the member's first
+    global expert index, so callers slice their weight stacks by the SAME
+    convention this op slices probs (contiguous blocks) — and the partial
+    combines psum over the axis. No all_to_all needed — token replication
+    over 'expert' makes EP a slice + reduce, composing freely with the
+    data/context axes of the same shard_map."""
     t, e = probs.shape
     ep = jax.lax.psum(1, axis_name)
     if e % ep:
@@ -159,7 +162,9 @@ def moe_expert_sliced_combine(
     e_local = e // ep
     start = jax.lax.axis_index(axis_name) * e_local
     probs_local = jax.lax.dynamic_slice(probs, (0, start), (t, e_local))
-    partial = moe_dispatch_combine(x, probs_local, expert_fn, capacity)
+    partial = moe_dispatch_combine(
+        x, probs_local, lambda xe: expert_fn(xe, start), capacity
+    )
     return jax.lax.psum(partial, axis_name)
 
 

@@ -104,8 +104,11 @@ def test_forward_shape_and_weight_tying():
     assert "routing_bias" in variables["moe_state"]["layer_0"]["moe"]
 
 
-def test_cached_decode_equals_full_forward():
-    model, variables = init_model()
+@pytest.mark.parametrize("rope_dim", [0, 8], ids=["norope", "rope"])
+def test_cached_decode_equals_full_forward(rope_dim):
+    import dataclasses as dc
+
+    model, variables = init_model(cfg=dc.replace(TINY, rope_dim=rope_dim))
     rng = jax.random.key(1)
     prompt = jax.random.randint(rng, (2, 5), 0, TINY.vocab_size)
     params = variables["params"]
@@ -295,8 +298,12 @@ def test_moe_metrics_flow_through_train_step():
 # ------------------------------------------------------- context parallelism
 
 
-@pytest.mark.parametrize("use_flash", [False, True], ids=["jnp", "flash"])
-def test_dsv3_cp_train_step_matches_dense(devices, use_flash):
+@pytest.mark.parametrize(
+    "use_flash,rope_dim",
+    [(False, 0), (True, 0), (False, 8), (True, 8)],
+    ids=["jnp", "flash", "jnp_rope", "flash_rope"],
+)
+def test_dsv3_cp_train_step_matches_dense(devices, use_flash, rope_dim):
     """The flagship under CP: MLA rings over the LATENT stream (k = v =
     latents, one shared kv head) inside the stock CP Trainer; the MoE
     routing-bias update is psum'd so state stays shard-invariant. One step
@@ -307,6 +314,7 @@ def test_dsv3_cp_train_step_matches_dense(devices, use_flash):
 
     cfg = dc.replace(
         TINY, block_size=32, dropout=0.0, attn_dropout=0.0,
+        rope_dim=rope_dim,  # decoupled-RoPE k rides the latent ring (cat)
     )
     batch_x = jax.random.randint(jax.random.key(0), (4, 32), 0, cfg.vocab_size)
     batch = {"x": batch_x, "y": jnp.roll(batch_x, -1, axis=1)}
@@ -375,9 +383,10 @@ def test_moe_expert_sliced_combine_matches_unsharded(devices):
     ref = ops.moe.moe_dispatch_combine(x, probs, fn(w1, w2, w3), capacity=t)
 
     def local(x, probs, w1, w2, w3):
-        # w* arrive as this member's (1, ...) expert slice
+        # w* arrive as this member's (1, ...) expert slice, so the op's
+        # `start` index is unused here (weights are already local)
         return ops.moe.moe_expert_sliced_combine(
-            x, probs, fn(w1, w2, w3), capacity=t)
+            x, probs, lambda xe, start: fn(w1, w2, w3)(xe), capacity=t)
 
     out = jax.shard_map(
         local, mesh=mesh,

@@ -196,7 +196,7 @@ def main() -> int:
         ("gpt_markov", _run_lm, 3000 // div, None),
         ("llama3_markov", _run_lm, 3000 // div, None),
         ("gemma_markov", _run_lm, 3000 // div, None),
-        ("dsv3_markov", _run_lm, 3000 // div, None),
+        ("dsv3_markov", _run_lm, 1200 // div, None),
     ]
 
     current: dict = {
