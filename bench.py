@@ -1,10 +1,17 @@
 """Benchmark of record — prints ONE JSON line.
 
-Workload: the reference's own GPT char-LM training config
-(gpt/gpt-jax.ipynb cell 8: batch 128 x block 256 = 32,768 tok/step,
-dim 256, 1 head, 8 layers), trained with AdamW in bf16 on this repo's
-engine. Baseline: the reference's measured ~16.1k tok/s on its hardware
-(1x T4, BASELINE.md). Metric: steady-state training tokens/sec.
+Primary metric (top-level keys, driver contract): the reference's own GPT
+char-LM training config (gpt/gpt-jax.ipynb cell 8: batch 128 x block 256 =
+32,768 tok/step, dim 256, 1 head, 8 layers) trained with AdamW in bf16 on
+this repo's engine, vs the reference's measured ~16.1k tok/s (1x T4,
+BASELINE.md). Metric: steady-state training tokens/sec.
+
+`scorecard` (same JSON line): the full driver-visible surface the round-2
+verdict asked for (missing item 5) — the 350M MFU study point, flash-MLA
+16k step time, cached-decode throughput incl. a 16k-prompt prefill row,
+and (on real TPU) the in-kernel dropout linearity identity, so the
+kernel's riskiest path is verified every round. Each row is isolated: a
+failure records {"error": ...} instead of killing the bench.
 """
 
 from __future__ import annotations
@@ -13,24 +20,47 @@ import json
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
+BASELINE_TOK_S = 16_100.0  # gpt-jax.ipynb cell 18 tqdm, 1x T4
 
-def main() -> None:
+
+def _fence(x) -> float:
+    # device_get of a dependent scalar: block_until_ready is not a real
+    # fence on the axon-tunnelled platform
+    return float(jax.device_get(x))
+
+
+def _timed_windows(step, n_steps=40, n_windows=3, warmup=20):
+    """Best-of-N windows of `n_steps` steps; step() must return a scalar-
+    fence-able value. The tunnelled device has bursty transport noise, so
+    the minimum is the honest steady-state figure."""
+    for _ in range(warmup):
+        out = step()
+    _fence(out)
+    windows = []
+    for _ in range(n_windows):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            out = step()
+        _fence(out)
+        windows.append(time.perf_counter() - t0)
+    return min(windows) / n_steps, sum(windows) / (n_windows * n_steps)
+
+
+def bench_gpt_train():
     from solvingpapers_tpu.data.batches import lm_batch_iterator
-    from solvingpapers_tpu.metrics.mfu import chip_peak_flops, transformer_flops_per_token
+    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+    from solvingpapers_tpu.metrics.mfu import (
+        chip_peak_flops, transformer_flops_per_token,
+    )
     from solvingpapers_tpu.models.gpt import GPT, GPTConfig
     from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
 
-    BASELINE_TOK_S = 16_100.0  # gpt-jax.ipynb cell 18 tqdm, 1x T4
-
-    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
-
     # the framework's fast path: Pallas flash attention with in-kernel
-    # dropout (same Bernoulli semantics as the reference's prob dropout;
-    # measured ~22% faster than the dense path on this workload). Off-TPU
-    # smoke runs use the dense path (apply_flash_attention would fall back
-    # per-call anyway; this keeps the measured graph uniform).
+    # dropout (same Bernoulli semantics as the reference's prob dropout).
+    # Off-TPU smoke runs use the dense path.
     cfg = GPTConfig(
         vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=1,
         dropout=0.1, dtype="bfloat16", use_flash=is_tpu_backend(),
@@ -41,64 +71,268 @@ def main() -> None:
         optimizer=OptimizerConfig(name="adamw", max_lr=1e-3, total_steps=1000),
     )
     trainer = Trainer(GPT(cfg), tcfg)
-
-    rng = np.random.default_rng(0)
-    toks = rng.integers(0, cfg.vocab_size, size=1_000_000)
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=1_000_000)
     it = lm_batch_iterator(toks, batch, cfg.block_size, seed=0)
     b0 = next(it)
     state = trainer.init_state(b0)
     trainer._build_steps()
+    holder = {"state": state}
 
-    # compile + warmup; fence via value fetch (block_until_ready does not
-    # actually sync on the axon-tunnelled TPU platform). Warmup long enough
-    # to fill the dispatch queue — short warmups leave first-window
-    # stragglers that inflate the measurement by ~40%
-    for _ in range(20):
-        state, metrics = trainer._train_step(state, next(it))
-    float(jax.device_get(metrics["train_loss"]))
+    def step():
+        holder["state"], metrics = trainer._train_step(
+            holder["state"], next(it)
+        )
+        return metrics["train_loss"]
 
-    # 3 timed windows, best wins: the tunnelled device has bursty transport
-    # noise (observed 23-32 ms/step across identical runs); the minimum is
-    # the honest steady-state figure
-    n_steps = 40
-    windows = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            state, metrics = trainer._train_step(state, next(it))
-        float(jax.device_get(metrics["train_loss"]))
-        windows.append(time.perf_counter() - t0)
-    dt = min(windows)
-
-    tok_per_step = batch * cfg.block_size
-    tok_s = n_steps * tok_per_step / dt
-
+    dt, dt_mean = _timed_windows(step)
+    tok_s = batch * cfg.block_size / dt
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    fpt = transformer_flops_per_token(n_params, cfg.n_layers, cfg.dim, cfg.block_size)
-    mfu = tok_s * fpt / chip_peak_flops()
-
-    print(json.dumps({
-        "metric": "gpt_charlm_train_tokens_per_sec",
-        "value": round(tok_s, 1),
-        "unit": "tokens/sec",
+    fpt = transformer_flops_per_token(
+        n_params, cfg.n_layers, cfg.dim, cfg.block_size
+    )
+    return {
+        "tokens_per_sec": round(tok_s, 1),
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 3),
+        "step_time_ms": round(1000 * dt, 2),
+        "step_time_ms_mean": round(1000 * dt_mean, 2),
+        "mfu": round(tok_s * fpt / chip_peak_flops(), 4),
+        "n_params": int(n_params),
+    }
+
+
+def bench_350m_mfu():
+    """The 342M llama3 single-chip MFU point (tools/scale_350m.py row):
+    dim 1024, 24 layers, 16q/8kv heads, seq 1024, bf16, flash."""
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+    from solvingpapers_tpu.metrics.mfu import (
+        chip_peak_flops, transformer_flops_per_token,
+    )
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    bs, seq = 8, 1024
+    cfg = LlamaConfig(
+        vocab_size=32_000, max_seq_len=seq, dim=1024, n_layers=24,
+        n_heads=16, n_kv_heads=8, dropout=0.0, dtype="bfloat16",
+        use_flash=is_tpu_backend(),
+    )
+    tcfg = TrainConfig(
+        steps=0, batch_size=bs, log_every=10_000, eval_every=0,
+        optimizer=OptimizerConfig(name="adamw", max_lr=3e-4, total_steps=100),
+    )
+    trainer = Trainer(Llama(cfg), tcfg)
+    toks = np.random.default_rng(1).integers(0, cfg.vocab_size, size=500_000)
+    it = lm_batch_iterator(toks, bs, seq, seed=0)
+    b0 = next(it)
+    state = trainer.init_state(b0)
+    trainer._build_steps()
+    holder = {"state": state}
+
+    def step():
+        holder["state"], metrics = trainer._train_step(
+            holder["state"], next(it)
+        )
+        return metrics["train_loss"]
+
+    dt, _ = _timed_windows(step, n_steps=10, n_windows=3, warmup=8)
+    tok_s = bs * seq / dt
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    fpt = transformer_flops_per_token(n_params, cfg.n_layers, cfg.dim, seq)
+    return {
+        "tokens_per_sec": round(tok_s, 1),
+        "step_time_ms": round(1000 * dt, 2),
+        "mfu": round(tok_s * fpt / chip_peak_flops(), 4),
+        "n_params": int(n_params),
+    }
+
+
+def bench_flash_mla_16k():
+    """dsv3_long's core claim: a 16,384-token flagship train step on one
+    chip via flash-MLA + remat (the dense path cannot even compile)."""
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3, DeepSeekV3Config
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+    from solvingpapers_tpu.train.objectives import dsv3_init_fn, dsv3_loss_fn
+
+    seq = 16_384
+    cfg = DeepSeekV3Config(
+        vocab_size=32_000, block_size=seq, dtype="bfloat16", use_flash=True,
+        remat=True, pe_scale=0.02, rope_dim=64, dropout=0.0, attn_dropout=0.0,
+    )
+    tcfg = TrainConfig(
+        steps=0, batch_size=1, log_every=10_000, eval_every=0,
+        optimizer=OptimizerConfig(name="adamw", max_lr=3e-4, total_steps=100),
+    )
+    trainer = Trainer(DeepSeekV3(cfg), tcfg, loss_fn=dsv3_loss_fn,
+                      init_fn=dsv3_init_fn)
+    toks = np.random.default_rng(2).integers(0, cfg.vocab_size, size=200_000)
+    it = lm_batch_iterator(toks, 1, seq, seed=0)
+    b0 = next(it)
+    state = trainer.init_state(b0)
+    trainer._build_steps()
+    holder = {"state": state}
+
+    def step():
+        holder["state"], metrics = trainer._train_step(
+            holder["state"], next(it)
+        )
+        return metrics["train_loss"]
+
+    dt, _ = _timed_windows(step, n_steps=5, n_windows=2, warmup=3)
+    return {
+        "seq": seq,
+        "step_time_ms": round(1000 * dt, 2),
+        "tokens_per_sec": round(seq / dt, 1),
+    }
+
+
+def bench_decode():
+    """Cached scan decode (llama3 d1024 L24) — the reference re-runs the
+    full forward per token (SURVEY.md §3.4)."""
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.infer import generate
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    bs, prompt_len, new = 8, 128, 256
+    cfg = LlamaConfig(
+        vocab_size=32_000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        max_seq_len=prompt_len + new, dropout=0.0, dtype="bfloat16",
+    )
+    model = Llama(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (bs, prompt_len)),
+        jnp.int32,
+    )
+    params = model.init({"params": jax.random.key(0)}, prompt)["params"]
+    rng = jax.random.key(1)
+
+    def run():
+        return generate(model, params, prompt, rng, max_new_tokens=new,
+                        sampler=ops.sample_greedy)
+
+    _fence(jnp.sum(run()[:, -1]))  # compile
+    best = min(
+        (lambda t0: (_fence(jnp.sum(run()[:, -1])), time.perf_counter() - t0)[1])(
+            time.perf_counter()
+        )
+        for _ in range(3)
+    )
+    return {
+        "bs": bs, "prompt": prompt_len, "new": new,
+        "tokens_per_sec": round(bs * new / best),
+        "ms_per_token": round(best / new * 1e3, 3),
+    }
+
+
+def bench_decode_16k_prefill():
+    """Long-context generation: 16k-token prompt prefill through the
+    end-aligned flash path into the MLA latent cache, then scan decode."""
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.infer import generate
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3, DeepSeekV3Config
+
+    prompt_len, new = 16_384, 32
+    cfg = DeepSeekV3Config(
+        vocab_size=32_000, block_size=prompt_len + new, dtype="bfloat16",
+        use_flash=True, pe_scale=0.02, rope_dim=64, dropout=0.0,
+        attn_dropout=0.0,
+    )
+    model = DeepSeekV3(cfg)
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, cfg.vocab_size, (1, prompt_len)),
+        jnp.int32,
+    )
+    variables = model.init({"params": jax.random.key(2)},
+                           jnp.zeros((1, 8), jnp.int32))
+    extra = {"moe_state": variables["moe_state"]}
+    rng = jax.random.key(3)
+
+    def run(n):
+        return generate(model, variables["params"], prompt, rng,
+                        max_new_tokens=n, sampler=ops.sample_greedy,
+                        extra_variables=extra, prefill_chunk=2048)
+
+    _fence(jnp.sum(run(1)[:, -1]))  # compile prefill
+    t0 = time.perf_counter()
+    _fence(jnp.sum(run(1)[:, -1]))
+    prefill_s = time.perf_counter() - t0
+    _fence(jnp.sum(run(new)[:, -1]))  # compile decode scan
+    t0 = time.perf_counter()
+    _fence(jnp.sum(run(new)[:, -1]))
+    total_s = time.perf_counter() - t0
+    decode_s = max(total_s - prefill_s, 1e-9)
+    return {
+        "prompt": prompt_len, "new": new,
+        "prefill_s": round(prefill_s, 3),
+        "prefill_tokens_per_sec": round(prompt_len / prefill_s),
+        "decode_tokens_per_sec": round((new - 1) / decode_s),
+    }
+
+
+def bench_dropout_identity():
+    """In-kernel dropout backward verification (real TPU only): out is
+    linear in v with a fixed seed, so <loss(v+u) - loss(v)> must equal
+    <u, grad_v loss> EXACTLY when the backward kernels regenerate the
+    forward's masks (tests/test_flash_dropout_tpu.py's identity)."""
+    from solvingpapers_tpu.kernels import flash_attention
+    from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+
+    if not is_tpu_backend():
+        return {"skipped": "requires the hardware PRNG (real TPU)"}
+    key = jax.random.key(7)
+    kq, kk, kv, kw, ku = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (1, 256, 2, 32))
+    k = jax.random.normal(kk, (1, 256, 2, 32))
+    v = jax.random.normal(kv, (1, 256, 2, 32))
+    w = jax.random.normal(kw, q.shape)
+    u = jax.random.normal(ku, v.shape)
+
+    def loss(v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                            dropout_seed=11) * w
+        )
+
+    gv = jax.grad(loss)(v)
+    lhs = _fence(loss(v + u)) - _fence(loss(v))
+    rhs = _fence(jnp.sum(u * gv))
+    rel = abs(lhs - rhs) / max(abs(rhs), 1e-9)
+    return {"rel_err": round(rel, 5), "pass": bool(rel < 2e-2)}
+
+
+def main() -> None:
+    rows = []
+    primary = None
+    for name, fn in (
+        ("gpt_charlm_train", bench_gpt_train),
+        ("llama3_350m_mfu", bench_350m_mfu),
+        ("flash_mla_16k_step", bench_flash_mla_16k),
+        ("decode_llama3_350m", bench_decode),
+        ("decode_dsv3_16k_prefill", bench_decode_16k_prefill),
+        ("flash_dropout_linearity", bench_dropout_identity),
+    ):
+        try:
+            res = {"name": name, **fn()}
+        except Exception as e:  # isolate rows; record the failure
+            res = {"name": name, "error": repr(e)[:300]}
+        rows.append(res)
+        if name == "gpt_charlm_train":
+            primary = res
+
+    out = {
+        "metric": "gpt_charlm_train_tokens_per_sec",
+        "value": primary.get("tokens_per_sec", 0.0),
+        "unit": "tokens/sec",
+        "vs_baseline": primary.get("vs_baseline", 0.0),
         "detail": {
             "config": "gpt-jax.ipynb cell 8 (bs128 x block256, dim256, L8)",
             "baseline": "16.1k tok/s on 1x T4 (reference cell 18)",
-            "step_time_ms": round(1000 * dt / n_steps, 2),
-            # the mean across windows, for honesty about transport noise
-            # (the min is the reported steady-state figure)
-            "step_time_ms_mean": round(
-                1000 * sum(windows) / (len(windows) * n_steps), 2
-            ),
-            "tokens_per_sec_mean": round(
-                len(windows) * n_steps * tok_per_step / sum(windows), 1
-            ),
-            "mfu": round(mfu, 4),
-            "n_params": int(n_params),
             "device": str(jax.devices()[0].device_kind),
         },
-    }))
+        "scorecard": rows,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

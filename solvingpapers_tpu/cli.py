@@ -132,10 +132,18 @@ def cmd_train(args) -> int:
         )
         callbacks = None
         can_sample = False
-        no_decode = cp or cfg.train.pipeline_parallel
+        # CP samples through a dense twin (params are replicated at rest);
+        # PP stage-stacked params still need the export conversion first
+        no_decode = cfg.train.pipeline_parallel
+        sample_model = model
+        if cp:
+            sample_model = type(model)(
+                dataclasses.replace(model.cfg, context_parallel=False)
+            )
         if args.artifacts_dir and no_decode:
             print("[sample] disabled: decode caches are unsupported under "
-                  "context/pipeline parallelism", file=sys.stderr)
+                  "pipeline parallelism (export stage params first)",
+                  file=sys.stderr)
         elif args.artifacts_dir:
             try:  # token-file runs have no text tokenizer to build prompts
                 can_sample = len(tok.encode("\n")) > 0
@@ -154,12 +162,17 @@ def cmd_train(args) -> int:
             # fresh partial per call would retrace + recompile every sample
             sampler = functools.partial(ops.sample_top_k, k=50)
 
-            def sample_cb(state, step, _tok=tok, _model=model):
+            def sample_cb(state, step, _tok=tok, _model=sample_model, _cp=cp):
                 prompt = jnp.asarray(_tok.encode("\n"), jnp.int32)[None, :]
                 extra = state.model_state or None
+                # CP state lives on the training mesh; pull the replicated
+                # params to host so the dense twin decodes on one device
+                params = jax.device_get(state.params) if _cp else state.params
+                if _cp and extra:
+                    extra = jax.device_get(extra)
                 limit = getattr(_model, "max_positions", None) or 1_000_000
                 out = generate(
-                    _model, state.params, prompt, jax.random.key(step),
+                    _model, params, prompt, jax.random.key(step),
                     max_new_tokens=min(200, limit - prompt.shape[1]),
                     sampler=sampler,
                     extra_variables=extra,
@@ -235,22 +248,61 @@ def cmd_sample(args) -> int:
     from solvingpapers_tpu.infer import generate
 
     cfg = get_config(args.config)
-    if getattr(cfg.model, "context_parallel", False) or cfg.train.pipeline_parallel:
+    if cfg.train.pipeline_parallel:
         print(
-            "sampling is unsupported for context/pipeline-parallel configs "
-            "(decode caches don't compose with the sharded forward); export "
-            "the params and decode with the dense model family",
+            "sampling is unsupported for pipeline-parallel configs; "
+            "export the stage-stacked params to the dense family first",
             file=sys.stderr,
         )
         return 2
+    if getattr(cfg.model, "context_parallel", False):
+        # CP params are replicated at rest, so a non-CP twin of the same
+        # architecture decodes them directly (tested:
+        # tests/test_infer_prefill.py::test_cp_trained_weights_export_to_plain_decode)
+        from solvingpapers_tpu.sharding import MeshConfig
+
+        cfg = dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(cfg.model, context_parallel=False),
+            train=dataclasses.replace(
+                cfg.train, context_parallel=False, mesh=MeshConfig()
+            ),
+        )
     if args.data_path:
         cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
     cfg, model, tok, _, _ = build_char_lm_run(cfg)
 
     rng = jax.random.key(args.seed)
-    prompt_text = args.prompt or "\n"
-    prompt = jnp.asarray(tok.encode(prompt_text), jnp.int32)[None, :]
-    variables = model.init({"params": rng}, prompt)
+    if getattr(args, "prompt_file", None):
+        with open(args.prompt_file, "r", encoding="utf-8") as f:
+            prompt_text = f.read()
+    else:
+        prompt_text = args.prompt or "\n"
+    ids = tok.encode(prompt_text)
+    limit = getattr(model, "max_positions", None)
+    if limit is not None and len(ids) + args.max_new_tokens > limit:
+        # keep a multiple of 128 so every flash prefill chunk keeps a
+        # Mosaic-legal q block (kernels/flash_attention._pick_block_q);
+        # floor at 1 token — tiny contexts truncate unaligned rather than
+        # keeping nothing (ids[-0:] would silently keep everything)
+        keep = (limit - args.max_new_tokens) // 128 * 128
+        if keep <= 0:
+            keep = limit - args.max_new_tokens
+        if keep <= 0:
+            print(f"[sample] max-new-tokens {args.max_new_tokens} >= model "
+                  f"max positions {limit}: no room for a prompt",
+                  file=sys.stderr)
+            return 2
+        print(f"[sample] prompt of {len(ids)} tokens truncated to its last "
+              f"{keep} (model max positions {limit} - "
+              f"{args.max_new_tokens} new)", file=sys.stderr)
+        ids = ids[-keep:]
+    prompt = jnp.asarray(ids, jnp.int32)[None, :]
+    # init on a short dummy: param shapes are seq-independent, and a full
+    # uncached forward over a 16k prompt just to initialize would run the
+    # single-shot attention the chunked prefill exists to avoid
+    init_toks = prompt[:, : min(prompt.shape[1], 128)]
+    variables = model.init({"params": rng}, init_toks)
     params = variables["params"]
     extra = {k: v for k, v in variables.items() if k != "params"}
 
@@ -270,9 +322,15 @@ def cmd_sample(args) -> int:
         if args.greedy
         else functools.partial(ops.sample_top_k, k=args.top_k, temperature=args.temperature)
     )
+    # long prompts prefill in chunks (static end-aligned flash/causal calls
+    # into the cache) so activation memory stays bounded; "auto" = one chunk
+    # for short prompts, 2048-token chunks past that
+    chunk = args.prefill_chunk
+    if chunk is None and prompt.shape[1] > 4096:
+        chunk = 2048
     out = generate(
         model, params, prompt, rng, max_new_tokens=args.max_new_tokens,
-        sampler=sampler, extra_variables=extra or None,
+        sampler=sampler, extra_variables=extra or None, prefill_chunk=chunk,
     )
     print(tok.decode(np.asarray(out[0])))
     return 0
@@ -399,6 +457,12 @@ def main(argv=None) -> int:
     p_sample = sub.add_parser("sample")
     _add_common(p_sample)
     p_sample.add_argument("--prompt", default=None)
+    p_sample.add_argument("--prompt-file", default=None,
+                          help="read the prompt text from a file (long-"
+                               "context prompts, e.g. 16k tokens)")
+    p_sample.add_argument("--prefill-chunk", type=int, default=None,
+                          help="prefill the prompt in chunks of this many "
+                               "tokens (default: auto — 2048 past 4096)")
     p_sample.add_argument("--max-new-tokens", type=int, default=200)
     p_sample.add_argument("--top-k", type=int, default=50)
     p_sample.add_argument("--temperature", type=float, default=1.0)

@@ -34,6 +34,14 @@ def build_model(cfg: RunConfig):
         from solvingpapers_tpu.models.gpt_pipe import GPTPipe
 
         return GPTPipe(cfg.model)
+    if fam == "dsv3_pipe":
+        from solvingpapers_tpu.models.deepseekv3_pipe import DSV3Pipe
+
+        return DSV3Pipe(cfg.model)
+    if fam == "llama3_pipe":
+        from solvingpapers_tpu.models.llama3_pipe import LlamaPipe
+
+        return LlamaPipe(cfg.model)
     if fam == "vit":
         from solvingpapers_tpu.models.vit import ViT
 
@@ -72,8 +80,10 @@ def loss_fn_for(cfg: RunConfig):
         "gpt": lm_loss_fn,
         "gpt_pipe": lm_loss_fn,
         "llama3": lm_loss_fn,
+        "llama3_pipe": lm_loss_fn,
         "gemma": lm_loss_fn,
         "deepseekv3": dsv3_loss_fn,
+        "dsv3_pipe": dsv3_loss_fn,
         "vit": classification_loss_fn,
         "alexnet": classification_loss_fn,
         "kd": classification_loss_fn,
@@ -93,7 +103,7 @@ def rules_for(cfg: RunConfig):
 
 def init_fn_for(cfg: RunConfig):
     """Trainer init_fn override (None = default params-only init)."""
-    if cfg.model_family == "deepseekv3":
+    if cfg.model_family in ("deepseekv3", "dsv3_pipe"):
         from solvingpapers_tpu.train.objectives import dsv3_init_fn
 
         return dsv3_init_fn
@@ -140,7 +150,13 @@ def build_char_lm_run(cfg: RunConfig, sharding=None):
         from solvingpapers_tpu.data.bpe import ByteBPETokenizer
         from solvingpapers_tpu.data.char import load_text, split_train_val
 
-        text = load_text(cfg.data.get("path"))
+        # synthetic_chars: long-context configs need a corpus larger than
+        # one block AFTER tokenization (BPE compresses ~4.5x — a 65k block
+        # needs ~300k+ chars minimum; lm_batch_iterator raises otherwise)
+        text = load_text(
+            cfg.data.get("path"),
+            synthetic_chars=cfg.data.get("synthetic_chars", 200_000),
+        )
         if cfg.data.get("vocab_path") and cfg.data.get("merges_path"):
             tok = ByteBPETokenizer.from_files(
                 cfg.data["vocab_path"], cfg.data["merges_path"]

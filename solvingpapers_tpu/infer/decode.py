@@ -21,7 +21,9 @@ from solvingpapers_tpu import ops
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "max_new_tokens", "sampler", "max_len")
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "sampler", "max_len",
+                     "prefill_chunk"),
 )
 def generate(
     model,
@@ -34,6 +36,7 @@ def generate(
     max_len: int | None = None,
     extra_variables: dict | None = None,
     eos_id: int | None = None,
+    prefill_chunk: int | None = None,
 ) -> jax.Array:
     """Generate `max_new_tokens` continuations of `prompt` (B, S0) int32.
 
@@ -44,6 +47,15 @@ def generate(
     stop-on-EOS semantics in static-shape form: once a sequence samples
     EOS, all its later positions are EOS (the scan itself always runs
     max_new_tokens steps — XLA needs static shapes).
+
+    Prefill passes a STATIC `attend_len` to the model, so cached attention
+    runs end-aligned causal over only the written cache slots (the Pallas
+    flash kernel for use_flash models) instead of masked dense scores over
+    the whole preallocated cache — this is what makes 16k-prompt prefill
+    feasible (the dense path would materialize (B, N, S0, max_len) probs).
+    `prefill_chunk` bounds prefill activation memory further by feeding the
+    prompt in chunks: chunk i attends to cache slots [0, end_i) with the
+    same end-aligned kernel call, writing as it goes.
     """
     b, s0 = prompt.shape
     total = s0 + max_new_tokens
@@ -58,11 +70,26 @@ def generate(
         )
 
     caches = model.init_caches(b, max_len)
-    positions = jnp.broadcast_to(jnp.arange(s0), (b, s0))
     variables = {"params": params, **(extra_variables or {})}
-    logits, caches = model.apply(
-        variables, prompt, positions=positions, caches=caches, deterministic=True
-    )
+    if prefill_chunk is None or s0 <= prefill_chunk:
+        positions = jnp.broadcast_to(jnp.arange(s0), (b, s0))
+        logits, caches = model.apply(
+            variables, prompt, positions=positions, caches=caches,
+            deterministic=True, attend_len=s0,
+        )
+    else:
+        # python loop = unrolled chunks with static slice bounds; the last
+        # (possibly ragged) chunk just compiles one more layer shape
+        for start in range(0, s0, prefill_chunk):
+            end = min(start + prefill_chunk, s0)
+            chunk = jax.lax.slice_in_dim(prompt, start, end, axis=1)
+            positions = jnp.broadcast_to(
+                jnp.arange(start, end), (b, end - start)
+            )
+            logits, caches = model.apply(
+                variables, chunk, positions=positions, caches=caches,
+                deterministic=True, attend_len=end,
+            )
     rng, sub = jax.random.split(rng)
     first_tok = sampler(logits[:, -1], sub).astype(prompt.dtype)
     done0 = (

@@ -78,6 +78,25 @@ def _pick_block(seq: int, requested: int) -> int:
     return max(block, 1)
 
 
+def _pick_block_q(seq: int, requested: int) -> int:
+    """Q-side block: the lse output's block is (1, 1, block_q), and Mosaic
+    requires its last dim be 128-divisible OR equal to the array dim. Seqs
+    with no >=128 power-of-2 divisor (e.g. a ragged 2016-token prefill
+    chunk) run as ONE q block (equal-to-array is always legal); VMEM bounds
+    that fallback, so past 4096 the caller must pad/truncate to a multiple
+    of 128 instead."""
+    block = _pick_block(seq, requested)
+    if block % 128 and block != seq:
+        if seq > 4096:
+            raise ValueError(
+                f"seq_q {seq} has no 128-divisible block and is too long "
+                "for a single q block; pad or truncate the q sequence to a "
+                "multiple of 128"
+            )
+        return seq
+    return block
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying `like`'s varying-axes metadata, so the
     pallas_calls here are usable directly inside shard_map under the vma
@@ -156,7 +175,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         m_i, l_i, acc = m_scr[...], l_scr[...], acc_scr[...]
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # fully-masked rows inside a LIVE block (seq_q > seq_k end-aligned
+        # causal, e.g. a single-q-block fallback) have every s == BIG_NEG
+        # and m_new == BIG_NEG: exp(s - m_new) would be 1, crediting unit
+        # mass to invisible keys. Zero masked entries explicitly so those
+        # rows keep l == 0 and hit the empty-row guard at _finish.
+        p = jnp.where(s <= BIG_NEG * 0.5, 0.0, jnp.exp(s - m_new))
         alpha = jnp.exp(m_i - m_new)
         # l accumulates the UNdropped mass (the softmax denominator);
         # dropout applies to the normalized probs, i.e. to acc only
@@ -540,7 +564,7 @@ def flash_attention(
         )
     if scale is None:
         scale = d**-0.5
-    block_q = _pick_block(seq_q, block_q)
+    block_q = _pick_block_q(seq_q, block_q)
     block_k = _pick_block(seq_k, block_k)
 
     q3 = q.transpose(0, 2, 1, 3).reshape(b * n_heads, seq_q, d)

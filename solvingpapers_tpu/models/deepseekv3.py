@@ -74,6 +74,13 @@ class DeepSeekV3Config:
     noisy_topk: bool = False
     use_aux_free: bool = True
     aux_free_bias_update_rate: float = 0.001
+    # optional complementary sequence-wise balance loss (DeepSeek-V3 paper's
+    # L_Bal, eq. 17-18 — the notebook implements only the bias mechanism):
+    # weight * sum_e f_e * P_e with f_e the scaled selection fraction and
+    # P_e the mean gate probability. 0.0 = off (notebook parity); small
+    # values (1e-3..1e-2) push residual imbalance the bias update alone
+    # leaves (drop_fraction > 0 on clustered data).
+    balance_loss_weight: float = 0.0
     moe_impl: str = "dispatch"  # dispatch | dense
     capacity_factor: float = 2.0
     mtp_heads: int = 0
@@ -121,12 +128,18 @@ class MLA(nn.Module):
     cfg: DeepSeekV3Config
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True,
+                 attend_len=None):
         cfg = self.cfg
         b, s, _ = x.shape
         n, hd, lat = cfg.n_heads, cfg.head_dim, cfg.latent_dim
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            # CP-aware default (global positions derived from the axis
+            # index) — the PP stage_fn applies layers without positions, so
+            # under CP x PP this default must not restart at 0 per shard
+            from solvingpapers_tpu.models.layers import default_positions
+
+            positions = default_positions(b, s, cfg.context_parallel)
         if cache is not None and cfg.context_parallel:
             raise NotImplementedError(
                 "latent caches are unsupported under context parallelism: "
@@ -176,19 +189,36 @@ class MLA(nn.Module):
                 ring_flash_attention_local,
             )
 
-            if cfg.attn_dropout > 0.0 and not deterministic:
+            from solvingpapers_tpu.kernels.flash_attention import (
+                is_tpu_backend,
+            )
+
+            drop_active = cfg.attn_dropout > 0.0 and not deterministic
+            if drop_active and not (cfg.use_flash and is_tpu_backend()):
                 raise NotImplementedError(
-                    "attention-prob dropout is not implemented under "
-                    "context_parallel MLA; set attn_dropout=0.0"
+                    "attention-prob dropout under context_parallel MLA "
+                    "requires the ring-flash path on real TPU (per-chunk "
+                    "in-kernel masks); set attn_dropout=0.0 or use_flash"
                 )
             c_kv = latent.astype(dt)[:, :, None, :]  # (B, S_loc, 1, L)
-            ring = (
-                ring_flash_attention_local if cfg.use_flash
-                else ring_attention_local
-            )
-            ctx = ring(
-                q_lat, c_kv, c_kv, "context", causal=True, scale=scale
-            ).astype(dt)
+            if cfg.use_flash:
+                kwargs = {}
+                if drop_active:
+                    kwargs = dict(
+                        dropout_rate=cfg.attn_dropout,
+                        dropout_seed=jax.random.randint(
+                            self.make_rng("dropout"), (), 0,
+                            jnp.iinfo(jnp.int32).max,
+                        ),
+                    )
+                ctx = ring_flash_attention_local(
+                    q_lat, c_kv, c_kv, "context", causal=True, scale=scale,
+                    **kwargs,
+                ).astype(dt)
+            else:
+                ctx = ring_attention_local(
+                    q_lat, c_kv, c_kv, "context", causal=True, scale=scale
+                ).astype(dt)
         elif cache is None and cfg.use_flash:
             # absorbed-query MLA *is* MQA over the latent stream: scores are
             # q_lat . c and the context is probs @ c, i.e. attention with
@@ -204,6 +234,24 @@ class MLA(nn.Module):
                 self, q_lat, c_kv, c_kv, causal=True, scale=scale,
                 dropout_rate=cfg.attn_dropout, deterministic=deterministic,
             ).astype(dt)
+        elif cache is not None and attend_len is not None:
+            # PREFILL: this chunk occupies cache slots [attend_len - S,
+            # attend_len) with every earlier slot written, so attention is
+            # end-aligned causal over a STATIC slice of the latent cache —
+            # no (S, max_len) score tensor (16k-prompt prefill fits HBM).
+            cache = update_latent_cache(cache, latent, positions[0, 0])
+            c_att = jax.lax.slice_in_dim(cache.c, 0, attend_len, axis=1)
+            c_kv = c_att[:, :, None, :]  # (B, attend_len, 1, L[+R])
+            if cfg.use_flash:
+                from solvingpapers_tpu.models.layers import apply_flash_attention
+
+                ctx = apply_flash_attention(
+                    self, q_lat, c_kv, c_kv, causal=True, scale=scale,
+                ).astype(dt)
+            else:
+                ctx = ops.dot_product_attention(
+                    q_lat, c_kv, c_kv, causal=True, scale=scale
+                ).astype(dt)
         else:
             if cache is not None:
                 cache = update_latent_cache(cache, latent, positions[0, 0])
@@ -371,12 +419,36 @@ class MoELayer(nn.Module):
                 probs_g, bias.value, cfg.aux_free_bias_update_rate, ci=ci
             )
 
+        if (
+            cfg.balance_loss_weight > 0.0
+            and self.is_mutable_collection("moe_metrics")
+        ):
+            # sequence-wise balance loss (differentiable — NOT under the
+            # stop_gradient the stats below use): f_e = selection fraction
+            # scaled by E/k, P_e = mean softmax gate prob over ALL experts.
+            # dsv3_loss_fn reads the sown value and adds weight * mean.
+            sel_frac = jnp.mean((probs > 0.0).astype(jnp.float32), axis=0)
+            f = sel_frac * (e / cfg.top_experts)
+            p_full = jnp.mean(
+                jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1),
+                axis=0,
+            )
+            self.sow("moe_metrics", "balance_loss", jnp.sum(f * p_full))
+
         if self.is_mutable_collection("moe_metrics"):
             # load-balance observability (SURVEY.md hard part #1): sown per
             # layer, aggregated into train metrics by dsv3_loss_fn
+            if ci is None:
+                ci = ops.moe.expert_load(probs_g, cfg.stats_axes)
             stats = ops.moe.load_balance_stats(
                 probs_g, axis_names=cfg.stats_axes, ci=ci
             )
+            # raw (E,) routed load: consumers that must re-derive the
+            # aux-free bias update OUTSIDE the layer (the pipeline-parallel
+            # wrapper, where the in-layer update can't run because the
+            # GPipe stage_fn applies layers immutably) read it from here;
+            # _aggregate_moe_metrics skips it (vector, not a train scalar)
+            stats["ci"] = ci
             stats["drop_fraction"] = (
                 jnp.zeros(()) if cfg.moe_impl == "dense"
                 else ops.moe.dispatch_drop_fraction(
@@ -394,13 +466,15 @@ class DSV3DecoderLayer(nn.Module):
     cfg: DeepSeekV3Config
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True,
+                 attend_len=None):
         cfg = self.cfg
         h, cache = MLA(cfg, name="mla")(
             RMSNorm(eps=cfg.norm_eps, name="norm1")(x),
             positions=positions,
             cache=cache,
             deterministic=deterministic,
+            attend_len=attend_len,
         )
         x = x + h
         x = x + MoELayer(cfg, name="moe")(
@@ -422,6 +496,7 @@ class DeepSeekV3(nn.Module):
         caches: list[LatentCache] | None = None,
         deterministic: bool = True,
         return_mtp: bool = False,
+        attend_len: int | None = None,
     ):
         """Returns (logits, caches) or ((logits, mtp_logits), caches) when
         return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V))."""
@@ -460,6 +535,7 @@ class DeepSeekV3(nn.Module):
                 positions,
                 None if caches is None else caches[i],
                 deterministic,
+                attend_len,
             )
             if new_caches is not None:
                 new_caches.append(c)

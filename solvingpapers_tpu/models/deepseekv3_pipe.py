@@ -1,0 +1,369 @@
+"""Pipeline-parallel DeepSeekV3: MLA + MoE decoder layers grouped into
+stages (stacked variables, leading stage dim sharded over 'pipe'), applied
+with the GPipe ppermute schedule inside shard_map.
+
+No counterpart in the reference (its flagship trains under single-process
+DataParallel, deepseekv3.ipynb cell 37); SURVEY.md §2.3 lists PP as a
+TPU-native capability to add. The blocks are the exact DSV3DecoderLayer
+modules of models/deepseekv3.py, so staged == dense is a restack away
+(`to_dense`), and decode for PP-trained weights goes through the dense
+family after export.
+
+Routing state under PP (the hard part): the aux-free routing bias
+(deepseekv3.ipynb cell 23's no-grad buffer) is carried stacked over stages
+but REPLICATED across the mesh, and must stay shard-invariant. Inside the
+GPipe stage_fn the layers apply with 'moe_state' immutable (a pure
+(params, x) function re-runs across schedule ticks), sowing their raw
+per-expert loads instead; the schedule sums those over each device's valid
+ticks (bubble ticks masked — sharding/pipeline.py with_aux), data-axis
+psums make the loads global, and each device's update for ITS stage's
+layers is scattered into a zero stack and psum'd over 'pipe' — every
+device applies the identical full-stack update, so out_specs P() holds by
+construction (verified under the vma checker for non-flash configs).
+
+Dropout is structurally 0 for the same reason as GPTPipe: the stage_fn is
+pure and re-runs across ticks, so per-tick mask threading would be
+required for well-defined dropout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config, DSV3DecoderLayer
+from solvingpapers_tpu.models.layers import RMSNorm, default_positions
+from solvingpapers_tpu.models.staged import (
+    init_stage_stack,
+    restack_to_dense,
+    stage_slice,
+)
+from solvingpapers_tpu.sharding.pipeline import pipeline_local_apply
+
+_STAT_KEYS = ("load_entropy", "load_max_fraction", "drop_fraction",
+              "bias_norm")
+
+
+@dataclasses.dataclass(frozen=True)
+class DSV3PipeConfig:
+    vocab_size: int = 50257
+    block_size: int = 256
+    dim: int = 512
+    n_layers: int = 6
+    n_heads: int = 8
+    latent_dim: int = 64
+    rope_dim: int = 0
+    rope_theta: float = 10000.0
+    pe_scale: float = 1.0
+    n_experts: int = 8
+    top_experts: int = 2
+    use_shared_expert: bool = True
+    use_aux_free: bool = True
+    aux_free_bias_update_rate: float = 0.001
+    moe_impl: str = "dispatch"  # dispatch | dense
+    capacity_factor: float = 2.0
+    dtype: str = "float32"
+    use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block inside the stage_fn
+    n_stages: int = 2
+    n_microbatches: int = 2
+    # True: GPipe schedule inside shard_map over 'pipe'; False: sequential
+    # scan over stages (the dense oracle the schedule is tested against)
+    pipeline_parallel: bool = False
+    # compose with context parallelism (sequence over 'context'; each
+    # stage's MLA rings within its pipe coordinate's context group)
+    context_parallel: bool = False
+    mtp_heads: int = 0  # MTP is not staged; kept for init_fn compatibility
+
+    def __post_init__(self):
+        if self.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {self.n_layers} not divisible by n_stages "
+                f"{self.n_stages}"
+            )
+        if self.mtp_heads:
+            raise NotImplementedError(
+                "MTP under pipeline parallelism is not supported: the i+k "
+                "shift needs the full hidden stream at the last stage; "
+                "train MTP on the dense family"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.n_layers // self.n_stages
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    @property
+    def stats_axes(self):
+        # engine contract for model_state under shard_map without vma
+        # checking (use_flash): the state updates are shard-invariant
+        # (psum'd loads + pipe-psum'd stack recombination)
+        return ("data", "fsdp") + (("context",) if self.context_parallel else ())
+
+    def layer_cfg(self) -> DeepSeekV3Config:
+        return DeepSeekV3Config(
+            vocab_size=self.vocab_size, block_size=self.block_size,
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            latent_dim=self.latent_dim, rope_dim=self.rope_dim,
+            rope_theta=self.rope_theta, pe_scale=self.pe_scale,
+            n_experts=self.n_experts, top_experts=self.top_experts,
+            use_shared_expert=self.use_shared_expert,
+            use_aux_free=self.use_aux_free,
+            aux_free_bias_update_rate=self.aux_free_bias_update_rate,
+            moe_impl=self.moe_impl, capacity_factor=self.capacity_factor,
+            dropout=0.0, attn_dropout=0.0, dtype=self.dtype,
+            use_flash=self.use_flash,
+            context_parallel=self.context_parallel,
+        )
+
+
+class DSV3Pipe:
+    """init/apply surface compatible with Trainer + dsv3_loss_fn."""
+
+    def __init__(self, cfg: DSV3PipeConfig):
+        self.cfg = cfg
+        self._block = DSV3DecoderLayer(cfg.layer_cfg())
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, rngs: dict, tokens: jax.Array, return_mtp: bool = False) -> dict:
+        cfg = self.cfg
+        rng = rngs["params"] if isinstance(rngs, dict) else rngs
+        k_emb, k_blocks, k_ln = jax.random.split(rng, 3)
+        dummy = jnp.zeros((1, min(tokens.shape[1], cfg.block_size), cfg.dim),
+                          cfg.compute_dtype)
+        if cfg.context_parallel:
+            # init runs inside shard_map (blocks trace the context ring); a
+            # constant dummy is axis-invariant and would clash with the
+            # ring's varying carries under the vma checker
+            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+
+        stacked = init_stage_stack(
+            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage
+        )
+        params = {
+            "tok_emb": {
+                "embedding": nn.initializers.normal(0.02)(
+                    k_emb, (cfg.vocab_size, cfg.dim), jnp.float32
+                )
+            },
+            "stages": stacked["params"],
+            "norm_f": RMSNorm().init(k_ln, dummy)["params"],
+        }
+        return {"params": params, "moe_state": {"stages": stacked["moe_state"]}}
+
+    # ----------------------------------------------------------------- apply
+
+    def _make_stage_fn(self, bias_stack, positions, stage_index_fn):
+        """stage_fn(stage_params, x) -> (y, aux): applies this stage's
+        layers with the routing bias READ-ONLY, collecting per-layer raw
+        loads + load stats. `stage_index_fn()` -> traced stage id (axis
+        index under PP, python int under the dense oracle)."""
+        cfg = self.cfg
+
+        def one(block_params, bias_j, x):
+            (y, _), mut = self._block.apply(
+                {"params": block_params, "moe_state": bias_j},
+                x, positions, None, True, None,
+                mutable=["moe_metrics"],
+            )
+            stats = mut["moe_metrics"]["moe"]["stats"][0]
+            return y, {k: stats[k] for k in (*_STAT_KEYS, "ci")}
+
+        if cfg.remat:
+            one = jax.checkpoint(one)
+
+        def stage_fn(sp, x):
+            sid = stage_index_fn()
+            aux_layers = []
+            for j in range(cfg.layers_per_stage):
+                bias_j = stage_slice(bias_stack[f"block_{j}"], sid)
+                x, layer_aux = one(sp[f"block_{j}"], bias_j, x)
+                aux_layers.append(layer_aux)
+            aux = {
+                k: jnp.stack([a[k] for a in aux_layers])
+                for k in aux_layers[0]
+            }
+            return x, aux
+
+        return stage_fn
+
+    def apply(
+        self,
+        variables: dict,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches=None,
+        deterministic: bool = True,
+        rngs=None,
+        mutable=(),
+        return_mtp: bool = False,
+    ):
+        if caches is not None:
+            raise NotImplementedError(
+                "decode caches are unsupported under pipeline parallelism; "
+                "to_dense() the params and decode with DeepSeekV3"
+            )
+        if return_mtp:
+            raise NotImplementedError("MTP is not staged; use DeepSeekV3")
+        cfg = self.cfg
+        p = variables["params"]
+        bias_stack = variables["moe_state"]["stages"]
+        b, s = tokens.shape
+        if positions is None:
+            positions = default_positions(
+                b, s, cfg.context_parallel, max_positions=cfg.block_size
+            )
+        pe = ops.sinusoidal_position_encoding(cfg.block_size, cfg.dim)
+        x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
+        x = x + cfg.pe_scale * jnp.take(pe, positions, axis=0)
+        x = x.astype(cfg.compute_dtype)
+
+        if cfg.pipeline_parallel:
+            mb = x.shape[0] // cfg.n_microbatches
+            mb_positions = positions[:mb]
+            stage_fn = self._make_stage_fn(
+                bias_stack, mb_positions, lambda: jax.lax.axis_index("pipe")
+            )
+            x, aux = pipeline_local_apply(
+                p["stages"], x, stage_fn,
+                n_microbatches=cfg.n_microbatches, with_aux=True,
+            )
+            # aux sums over this device's n_microbatches valid ticks
+            n_ticks = cfg.n_microbatches
+        else:
+            # dense oracle: same layers, same aux plumbing, no pipe axis
+            aux_stages = []
+            for st in range(cfg.n_stages):
+                stage_fn = self._make_stage_fn(
+                    bias_stack, positions, lambda st=st: st
+                )
+                x, aux_s = stage_fn(
+                    jax.tree.map(lambda a: a[st], p["stages"]), x
+                )
+                aux_stages.append(aux_s)
+            n_ticks = 1
+
+        x = 2.0 * cfg.n_layers**-0.5 * x  # deepseek depth scaling (cell 31)
+        x = RMSNorm().apply({"params": p["norm_f"]}, x)
+        logits = (
+            x.astype(cfg.compute_dtype)
+            @ p["tok_emb"]["embedding"].T.astype(cfg.compute_dtype)
+        )
+
+        mutated = {}
+        wants = set(mutable if not isinstance(mutable, str) else [mutable])
+        if wants:
+            mutated = self._mutate(
+                bias_stack,
+                aux if cfg.pipeline_parallel else aux_stages,
+                n_ticks, wants, deterministic,
+            )
+            return (logits, None), mutated
+        return logits, None
+
+    # --------------------------------------------------------- state updates
+
+    def _mutate(self, bias_stack, aux, n_ticks, wants, deterministic):
+        """Recombine per-device aux into the shard-invariant moe_state
+        update + scalar metrics. Under PP, `aux` holds THIS device's stage
+        sums; the update is scattered into a zero stack and psum'd over
+        'pipe'. Under the dense oracle, `aux` is a per-stage list."""
+        cfg = self.cfg
+        pp = cfg.pipeline_parallel
+        mutated: dict = {}
+
+        if pp:
+            sid = jax.lax.axis_index("pipe")
+            ci = aux["ci"]  # (layers_per_stage, E), summed over valid ticks
+            # make loads global across the data axes (inside the block,
+            # stats_axes covered data/fsdp/context only under CP)
+            if not cfg.context_parallel:
+                ci = jax.lax.psum(ci, ("data", "fsdp"))
+        else:
+            ci = jnp.stack([a["ci"] for a in aux])  # (n_stages, lps, E)
+
+        if "moe_state" in wants:
+            new_stack = bias_stack
+            if cfg.use_aux_free and not deterministic:
+                def upd(bias_j, delta_j):
+                    # bias_j: (n_stages, E); delta_j: (E,) for own stage
+                    full = jnp.zeros_like(bias_j)
+                    full = jax.lax.dynamic_update_index_in_dim(
+                        full, delta_j.astype(bias_j.dtype), sid, 0
+                    )
+                    return bias_j + jax.lax.psum(full, "pipe")
+
+                rate = cfg.aux_free_bias_update_rate
+                new_stack = dict(bias_stack)
+                for j in range(cfg.layers_per_stage):
+                    key = f"block_{j}"
+                    if pp:
+                        err = jnp.mean(ci[j]) - ci[j]
+                        delta = rate * jnp.sign(err)
+                        new_stack[key] = jax.tree.map(
+                            lambda b: upd(b, delta), bias_stack[key]
+                        )
+                    else:
+                        deltas = []
+                        for st in range(cfg.n_stages):
+                            err = jnp.mean(ci[st, j]) - ci[st, j]
+                            deltas.append(rate * jnp.sign(err))
+                        new_stack[key] = jax.tree.map(
+                            lambda b: b + jnp.stack(deltas).astype(b.dtype),
+                            bias_stack[key],
+                        )
+            mutated["moe_state"] = {"stages": new_stack}
+
+        if "moe_metrics" in wants:
+            if pp:
+                # own-stage scalar sums over valid ticks -> global means:
+                # /ticks, sum over own layers, psum over pipe, /n_layers
+                stats = {}
+                for k in _STAT_KEYS:
+                    v = jnp.sum(aux[k]) / n_ticks
+                    stats[k] = jax.lax.psum(v, "pipe") / cfg.n_layers
+            else:
+                stats = {
+                    k: jnp.mean(jnp.stack([a[k] for a in aux]))
+                    for k in _STAT_KEYS
+                }
+            mutated["moe_metrics"] = {"pipeline": {"stats": (stats,)}}
+        return mutated
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.block_size
+
+    # ---------------------------------------------------------------- export
+
+    def to_dense(self, params: dict, moe_state: dict):
+        """Restack stage-stacked variables into the dense DeepSeekV3 layout
+        and return (model, params, moe_state) — the decode path for
+        PP-trained weights (PP itself has no cache support). The export
+        config drops context_parallel (dense decode runs outside shard_map)."""
+        from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3
+
+        cfg = self.cfg
+        name = lambda i: f"layer_{i}"  # noqa: E731
+        dense_params = {
+            "tok_emb": params["tok_emb"],
+            "norm_f": params["norm_f"],
+            **restack_to_dense(params["stages"], cfg.n_stages,
+                               cfg.layers_per_stage, name),
+        }
+        dense_state = restack_to_dense(
+            moe_state["stages"], cfg.n_stages, cfg.layers_per_stage, name
+        )
+        dense_cfg = dataclasses.replace(
+            cfg.layer_cfg(), context_parallel=False
+        )
+        return DeepSeekV3(dense_cfg), dense_params, dense_state

@@ -73,7 +73,8 @@ class GemmaBlock(nn.Module):
     cfg: GemmaConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True,
+                 attend_len=None):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -95,6 +96,7 @@ class GemmaBlock(nn.Module):
             positions=positions,
             cache=cache,
             deterministic=deterministic,
+            attend_len=attend_len,
         )
         x = x + h
         h = GLUFFN(
@@ -120,6 +122,7 @@ class Gemma(nn.Module):
         positions: jax.Array | None = None,
         caches: list[KVCache] | None = None,
         deterministic: bool = True,
+        attend_len: int | None = None,
     ) -> tuple[jax.Array, list[KVCache] | None]:
         cfg = self.cfg
         b, s = tokens.shape
@@ -137,6 +140,7 @@ class Gemma(nn.Module):
                 positions,
                 None if caches is None else caches[i],
                 deterministic,
+                attend_len,
             )
             if new_caches is not None:
                 new_caches.append(c)

@@ -58,7 +58,8 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True,
+                 attend_len=None):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -71,7 +72,8 @@ class GPTBlock(nn.Module):
             context_parallel=cfg.context_parallel,
             context_impl=cfg.context_impl,
             name="attn",
-        )(LayerNorm(name="ln1")(x), positions=positions, cache=cache, deterministic=deterministic)
+        )(LayerNorm(name="ln1")(x), positions=positions, cache=cache, deterministic=deterministic,
+           attend_len=attend_len)
         x = x + h
         x = x + MLP(
             dim=cfg.dim,
@@ -94,6 +96,7 @@ class GPT(nn.Module):
         positions: jax.Array | None = None,
         caches: list[KVCache] | None = None,
         deterministic: bool = True,
+        attend_len: int | None = None,
     ) -> tuple[jax.Array, list[KVCache] | None]:
         cfg = self.cfg
         b, s = tokens.shape
@@ -121,6 +124,7 @@ class GPT(nn.Module):
                 positions,
                 None if caches is None else caches[i],
                 deterministic,
+                attend_len,
             )
             if new_caches is not None:
                 new_caches.append(c)

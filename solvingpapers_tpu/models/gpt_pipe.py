@@ -139,10 +139,14 @@ class GPTPipe:
     # ----------------------------------------------------------------- apply
 
     def _stage_fn(self, stage_params, x):
+        def one(p, x):
+            y, _ = self._block.apply({"params": p}, x, None, None, True)
+            return y
+
+        if self.cfg.remat:
+            one = jax.checkpoint(one)
         for j in range(self.cfg.layers_per_stage):
-            x, _ = self._block.apply(
-                {"params": stage_params[f"block_{j}"]}, x, None, None, True
-            )
+            x = one(stage_params[f"block_{j}"], x)
         return x
 
     def apply(

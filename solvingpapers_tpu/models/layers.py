@@ -122,6 +122,7 @@ class Attention(nn.Module):
         positions: jax.Array | None = None,
         cache: KVCache | None = None,
         deterministic: bool = True,
+        attend_len: int | None = None,
     ) -> tuple[jax.Array, KVCache | None]:
         b, s, _ = x.shape
         n_kv = self.n_kv_heads or self.n_heads
@@ -160,11 +161,30 @@ class Attention(nn.Module):
         if cache is not None:
             # single contiguous segment per step: write at the first position
             cache = update_kv_cache(cache, k, v, positions[0, 0])
-            k_full, v_full = cache.k, cache.v
-            kv_idx = jnp.arange(cache.max_len)
-            # (B, 1, S, max_len): query at position p sees kv slots <= p
-            mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
-            out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
+            if attend_len is not None:
+                # PREFILL contract: this chunk occupies cache slots
+                # [attend_len - S, attend_len) and every earlier slot is
+                # written — so attention is exactly end-aligned causal over
+                # the first attend_len slots (a STATIC slice: no
+                # (S, max_len) mask/prob tensor ever exists, which is what
+                # makes 16k-prompt prefill fit in HBM). use_flash runs the
+                # Pallas kernel's seq_q != seq_k end-aligned causal mode.
+                k_att = jax.lax.slice_in_dim(cache.k, 0, attend_len, axis=1)
+                v_att = jax.lax.slice_in_dim(cache.v, 0, attend_len, axis=1)
+                if self.use_flash:
+                    from solvingpapers_tpu.kernels import flash_attention
+
+                    out = flash_attention(q, k_att, v_att, causal=True)
+                else:
+                    out = ops.dot_product_attention(
+                        q, k_att, v_att, causal=True
+                    )
+            else:
+                k_full, v_full = cache.k, cache.v
+                kv_idx = jnp.arange(cache.max_len)
+                # (B, 1, S, max_len): query at position p sees kv slots <= p
+                mask = kv_idx[None, None, None, :] <= positions[:, None, :, None]
+                out = ops.dot_product_attention(q, k_full, v_full, mask=mask)
         elif self.context_parallel:
             from solvingpapers_tpu.sharding.ring_attention import (
                 ring_attention_local,
@@ -172,21 +192,46 @@ class Attention(nn.Module):
                 ulysses_attention_local,
             )
 
-            if self.dropout > 0.0 and not deterministic:
+            from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
+
+            drop_active = self.dropout > 0.0 and not deterministic
+            if drop_active and not (
+                self.use_flash and self.context_impl == "ring"
+                and is_tpu_backend()
+            ):
                 raise NotImplementedError(
-                    "attention-prob dropout is not implemented under "
-                    "context_parallel attention; set dropout=0.0"
+                    "attention-prob dropout under context parallelism "
+                    "requires the ring-flash path on real TPU (in-kernel "
+                    "masks salted per (owner, chunk) — "
+                    "sharding/ring_attention._chunk_seed); set dropout=0.0 "
+                    "or use_flash=True with context_impl='ring'"
                 )
             if self.context_impl == "ring":
                 # GQA kv heads stay un-repeated: the ring repeats them after
                 # each transfer so ppermute carries only n_kv heads.
                 # use_flash swaps the per-chunk jnp einsum core for the
                 # Pallas kernel (custom-VJP ring backward).
-                ring = (
-                    ring_flash_attention_local if self.use_flash
-                    else ring_attention_local
-                )
-                out = ring(q, k, v, self.context_axis, causal=self.causal)
+                if self.use_flash:
+                    kwargs = {}
+                    if drop_active:
+                        # per-shard decorrelation comes from _chunk_seed's
+                        # (owner, chunk) salt; the rng seed is shared so
+                        # the same (owner, chunk) mask is used by fwd+bwd
+                        kwargs = dict(
+                            dropout_rate=self.dropout,
+                            dropout_seed=jax.random.randint(
+                                self.make_rng("dropout"), (), 0,
+                                jnp.iinfo(jnp.int32).max,
+                            ),
+                        )
+                    out = ring_flash_attention_local(
+                        q, k, v, self.context_axis, causal=self.causal,
+                        **kwargs,
+                    )
+                else:
+                    out = ring_attention_local(
+                        q, k, v, self.context_axis, causal=self.causal
+                    )
             elif self.context_impl == "ulysses":
                 if self.use_flash:
                     from solvingpapers_tpu.kernels import flash_attention

@@ -74,7 +74,8 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions=None, cache=None, deterministic=True):
+    def __call__(self, x, positions=None, cache=None, deterministic=True,
+                 attend_len=None):
         cfg = self.cfg
         h, cache = Attention(
             dim=cfg.dim,
@@ -96,6 +97,7 @@ class LlamaBlock(nn.Module):
             positions=positions,
             cache=cache,
             deterministic=deterministic,
+            attend_len=attend_len,
         )
         x = x + h
         h = GLUFFN(
@@ -121,6 +123,7 @@ class Llama(nn.Module):
         positions: jax.Array | None = None,
         caches: list[KVCache] | None = None,
         deterministic: bool = True,
+        attend_len: int | None = None,
     ) -> tuple[jax.Array, list[KVCache] | None]:
         cfg = self.cfg
         b, s = tokens.shape
@@ -136,6 +139,7 @@ class Llama(nn.Module):
                 positions,
                 None if caches is None else caches[i],
                 deterministic,
+                attend_len,
             )
             if new_caches is not None:
                 new_caches.append(c)

@@ -1,0 +1,187 @@
+"""Pipeline-parallel LLaMA3: decoder blocks staged over the 'pipe' axis
+with the shared staged-LM machinery (models/staged.py) and the GPipe
+ppermute schedule (sharding/pipeline.py).
+
+No counterpart in the reference (SURVEY.md §2.3 PP row). Blocks are the
+exact LlamaBlock modules of models/llama3.py — GQA + RoPE + SwiGLU — so
+staged == dense is a restack away (`to_dense`), which is also the decode
+path (PP has no cache support). Stateless blocks make this the simple
+instantiation of the pattern; the flagship's stateful-MoE version is
+models/deepseekv3_pipe.py. Dropout is structurally 0 (pure stage_fn
+re-runs across schedule ticks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from solvingpapers_tpu.models.llama3 import LlamaBlock, LlamaConfig
+from solvingpapers_tpu.models.layers import RMSNorm, default_positions
+from solvingpapers_tpu.models.staged import init_stage_stack, restack_to_dense
+from solvingpapers_tpu.sharding.pipeline import pipeline_local_apply
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaPipeConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 128
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    hidden_dim: int | None = None
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-6
+    dtype: str = "float32"
+    use_flash: bool = False
+    remat: bool = False  # jax.checkpoint each block inside the stage_fn
+    n_stages: int = 2
+    n_microbatches: int = 2
+    pipeline_parallel: bool = False
+    context_parallel: bool = False
+    context_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers {self.n_layers} not divisible by n_stages "
+                f"{self.n_stages}"
+            )
+
+    @property
+    def layers_per_stage(self) -> int:
+        return self.n_layers // self.n_stages
+
+    @property
+    def compute_dtype(self) -> jnp.dtype:
+        return jnp.dtype(self.dtype)
+
+    def block_cfg(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, max_seq_len=self.max_seq_len,
+            dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads, hidden_dim=self.hidden_dim,
+            rope_theta=self.rope_theta, norm_eps=self.norm_eps,
+            dropout=0.0, dtype=self.dtype, use_flash=self.use_flash,
+            context_parallel=self.context_parallel,
+            context_impl=self.context_impl,
+        )
+
+
+class LlamaPipe:
+    """init/apply surface compatible with Trainer + lm_loss_fn."""
+
+    def __init__(self, cfg: LlamaPipeConfig):
+        self.cfg = cfg
+        self._block = LlamaBlock(cfg.block_cfg())
+
+    def init(self, rngs: dict, tokens: jax.Array) -> dict:
+        cfg = self.cfg
+        rng = rngs["params"] if isinstance(rngs, dict) else rngs
+        k_emb, k_blocks, k_ln, k_head = jax.random.split(rng, 4)
+        dummy = jnp.zeros(
+            (1, min(tokens.shape[1], cfg.max_seq_len), cfg.dim),
+            cfg.compute_dtype,
+        )
+        if cfg.context_parallel:
+            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+        stacked = init_stage_stack(
+            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage
+        )
+        params = {
+            "tok_emb": {
+                "embedding": nn.initializers.variance_scaling(
+                    1.0, "fan_in", "normal", out_axis=0
+                )(k_emb, (cfg.vocab_size, cfg.dim), jnp.float32)
+            },
+            "stages": stacked["params"],
+            "norm_f": RMSNorm(eps=cfg.norm_eps).init(k_ln, dummy)["params"],
+            "lm_head": {
+                "kernel": nn.initializers.lecun_normal()(
+                    k_head, (cfg.dim, cfg.vocab_size), jnp.float32
+                )
+            },
+        }
+        return {"params": params}
+
+    def _stage_fn(self, positions):
+        def one(p, x):
+            y, _ = self._block.apply({"params": p}, x, positions, None, True,
+                                     None)
+            return y
+
+        if self.cfg.remat:
+            one = jax.checkpoint(one)
+
+        def stage_fn(sp, x):
+            for j in range(self.cfg.layers_per_stage):
+                x = one(sp[f"block_{j}"], x)
+            return x
+
+        return stage_fn
+
+    def apply(
+        self,
+        variables: dict,
+        tokens: jax.Array,
+        *,
+        positions: jax.Array | None = None,
+        caches=None,
+        deterministic: bool = True,
+        rngs=None,
+    ):
+        if caches is not None:
+            raise NotImplementedError(
+                "decode caches are unsupported under pipeline parallelism; "
+                "to_dense() the params and decode with Llama"
+            )
+        cfg = self.cfg
+        p = variables["params"]
+        b, s = tokens.shape
+        if positions is None:
+            positions = default_positions(
+                b, s, cfg.context_parallel, max_positions=cfg.max_seq_len
+            )
+        x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
+        x = x.astype(cfg.compute_dtype)
+
+        if cfg.pipeline_parallel:
+            mb = x.shape[0] // cfg.n_microbatches
+            stage_fn = self._stage_fn(positions[:mb])
+            x = pipeline_local_apply(
+                p["stages"], x, stage_fn,
+                n_microbatches=cfg.n_microbatches,
+            )
+        else:
+            stage_fn = self._stage_fn(positions)
+            for st in range(cfg.n_stages):
+                x = stage_fn(jax.tree.map(lambda a: a[st], p["stages"]), x)
+
+        x = RMSNorm(eps=cfg.norm_eps).apply({"params": p["norm_f"]}, x)
+        logits = (
+            x.astype(cfg.compute_dtype)
+            @ p["lm_head"]["kernel"].astype(cfg.compute_dtype)
+        )
+        return logits, None
+
+    @property
+    def max_positions(self) -> int:
+        return self.cfg.max_seq_len
+
+    def to_dense(self, params: dict):
+        """Restack into the dense Llama layout (block_{i} keys) — the
+        decode path for pipeline-trained weights."""
+        from solvingpapers_tpu.models.llama3 import Llama
+
+        cfg = self.cfg
+        dense = {k: v for k, v in params.items() if k != "stages"}
+        dense.update(restack_to_dense(
+            params["stages"], cfg.n_stages, cfg.layers_per_stage,
+            lambda i: f"block_{i}",
+        ))
+        dense_cfg = dataclasses.replace(cfg.block_cfg(), context_parallel=False)
+        return Llama(dense_cfg), dense

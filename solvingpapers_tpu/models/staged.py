@@ -1,0 +1,71 @@
+"""Shared staged-model machinery for pipeline-parallel LM families.
+
+The pattern (models/gpt_pipe.py pioneered it; models/deepseekv3_pipe.py and
+models/llama3_pipe.py reuse it): decoder blocks grouped into stages whose
+variables are STORED stacked with a leading stage dim sharded over the
+'pipe' mesh axis, applied with the GPipe ppermute schedule
+(sharding/pipeline.py) inside shard_map. The blocks themselves are the
+exact same Flax modules the dense models use, so staged == dense is a
+restack away (`restack_to_dense`).
+
+No counterpart in the reference (SURVEY.md §2.3 lists PP as a TPU-native
+capability to add; its parallelism ceiling is single-process DataParallel,
+deepseekv3.ipynb cell 37).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_stage_stack(
+    block,
+    key: jax.Array,
+    dummy: jax.Array,
+    n_stages: int,
+    layers_per_stage: int,
+    block_args: tuple = (),
+):
+    """Initialize n_stages x layers_per_stage copies of `block` and stack
+    them into {collection: {block_j: stacked-vars}} with a leading stage
+    dim (shard over 'pipe'). `block_args` are extra positional args for
+    block.init after the dummy activation (e.g. positions)."""
+
+    def stage_init(stage_key):
+        per_col: dict = {}
+        for j in range(layers_per_stage):
+            variables = block.init(
+                jax.random.fold_in(stage_key, j), dummy, *block_args
+            )
+            for col, tree in variables.items():
+                per_col.setdefault(col, {})[f"block_{j}"] = tree
+        return per_col
+
+    stages = [stage_init(jax.random.fold_in(key, s)) for s in range(n_stages)]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def stage_slice(tree, stage_index, keepdims: bool = False):
+    """Index the leading stage dim of a stacked pytree (traced index OK)."""
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(
+            a, stage_index, 0, keepdims=keepdims
+        ),
+        tree,
+    )
+
+
+def restack_to_dense(stages, n_stages: int, layers_per_stage: int,
+                     layer_name):
+    """Stage-stacked {block_j: stacked-vars} -> {layer_name(i): vars} in the
+    dense model's layout. Block j of stage s is dense layer
+    s * layers_per_stage + j; module names inside each block are shared
+    with the dense family, so the forward is bit-identical."""
+    dense = {}
+    for s in range(n_stages):
+        for j in range(layers_per_stage):
+            dense[layer_name(s * layers_per_stage + j)] = jax.tree.map(
+                lambda a: a[s], stages[f"block_{j}"]
+            )
+    return dense

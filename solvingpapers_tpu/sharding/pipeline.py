@@ -23,10 +23,19 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
+def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
+                    with_aux: bool = False):
     """Per-device body. stage_params: this stage's params (leading stage
     axis already stripped to size 1 by shard_map — squeezed here).
-    microbatches: (n_micro, mb, ...) full input, replicated."""
+    microbatches: (n_micro, mb, ...) full input, replicated.
+
+    with_aux: stage_fn returns (y, aux_pytree) and the schedule SUMS aux
+    over this device's VALID ticks only (stage s computes real microbatches
+    at ticks [s, s + n_micro); bubble ticks compute garbage that must not
+    pollute statistics). Returns (out, aux_sum) — aux_sum covers exactly
+    the full batch as seen by THIS device's stage (e.g. MoE routing loads
+    for its layers); callers reduce across other mesh axes themselves.
+    """
     n_stages = jax.lax.psum(1, axis_name)
     stage_id = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda a: a[0], stage_params)
@@ -47,8 +56,18 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
     buf = jnp.zeros_like(microbatches[0])  # current activation on this device
     out = jnp.zeros_like(microbatches)     # collected at the last stage
 
+    def run_stage(params, incoming):
+        res = stage_fn(params, incoming)
+        return res if with_aux else (res, None)
+
+    # aux structure probe (shapes only) for the scan carry init
+    aux_shapes = (
+        jax.eval_shape(lambda p, x: run_stage(p, x)[1], params, buf)
+        if with_aux else None
+    )
+
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         # stage 0 ingests microbatch t (when in range); others use the
         # activation received from the previous stage
         mb_idx = jnp.clip(t, 0, n_micro - 1)
@@ -57,7 +76,14 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
             microbatches[mb_idx].astype(buf.dtype),
             buf,
         )
-        y = stage_fn(params, incoming)
+        y, aux = run_stage(params, incoming)
+        if with_aux:
+            # stage s holds real data at ticks [s, s + n_micro)
+            valid = (t >= stage_id) & (t < stage_id + n_micro)
+            aux_acc = jax.tree.map(
+                lambda acc, a: acc + jnp.where(valid, a, 0.0).astype(acc.dtype),
+                aux_acc, aux,
+            )
         # the microbatch finishing at the last stage this tick is t-(S-1)
         done_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
         is_valid = (stage_id == n_stages - 1) & (t >= n_stages - 1)
@@ -67,12 +93,24 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name):
         out = jnp.where(is_valid, updated, out)
         # rotate activations one stage forward (last->0 wraps; ignored)
         buf = jax.lax.ppermute(y, axis_name, perm)
-        return (buf, out), None
+        return (buf, out, aux_acc), None
 
-    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+    def zero_like_shape(s):
+        # the scan carry's vma type must match what run_stage produces
+        # (varying over the pipe axis via stage params, and over the data
+        # axes via the batch) — eval_shape carries the vma when tracking
+        z = jnp.zeros(s.shape, jnp.float32)
+        vma = tuple(getattr(s, "vma", ()) or ())
+        return jax.lax.pcast(z, vma, to="varying") if vma else z
+
+    aux0 = jax.tree.map(zero_like_shape, aux_shapes) if with_aux else None
+    (_, out, aux_sum), _ = jax.lax.scan(
+        tick, (buf, out, aux0), jnp.arange(ticks)
+    )
     # only the last stage holds real outputs; psum broadcasts them (others zero)
     out = jnp.where(stage_id == n_stages - 1, out, jnp.zeros_like(out))
-    return jax.lax.psum(out, axis_name)
+    out = jax.lax.psum(out, axis_name)
+    return (out, aux_sum) if with_aux else out
 
 
 def pipeline_local_apply(
@@ -82,17 +120,24 @@ def pipeline_local_apply(
     *,
     n_microbatches: int,
     axis_name: str = "pipe",
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Per-device GPipe entry for callers already inside shard_map (e.g. a
     pipeline-parallel model's forward): splits x (batch, ...) into
     microbatches, runs the schedule, and restores the batch shape.
-    stage_params is this device's stage slice (leading stage dim 1)."""
+    stage_params is this device's stage slice (leading stage dim 1).
+    With `with_aux`, stage_fn returns (y, aux) and this returns
+    (out, aux_summed_over_valid_ticks) — see _pipeline_local."""
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
     micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
-    out = _pipeline_local(stage_params, micro, stage_fn, axis_name)
-    return out.reshape(b, *x.shape[1:])
+    res = _pipeline_local(stage_params, micro, stage_fn, axis_name,
+                          with_aux=with_aux)
+    if with_aux:
+        out, aux = res
+        return out.reshape(b, *x.shape[1:]), aux
+    return res.reshape(b, *x.shape[1:])
 
 
 def pipeline_apply(

@@ -126,16 +126,31 @@ def _ring_merge(m, l, acc, o_c, lse_c):
     return m_new, l_new, acc_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(q3, k3, v3, axis_name, heads, scale, causal, blocks,
-                interpret):
-    out, _ = _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale,
-                                  causal, blocks, interpret)
+# Knuth multiplicative stride: distinct (owner, chunk) pairs land far apart
+# in the kernel's seed space (the kernel already offsets by block uid within
+# one call; the pair stride decorrelates masks ACROSS ring steps/devices).
+# Plain python int — a module-level jnp constant would initialize the XLA
+# backend at import time and break jax.distributed.initialize (multi-host).
+_SEED_STRIDE = -1640531527
+
+
+def _chunk_seed(seed, my_idx, src, axis_size):
+    """Per-(q-owner, kv-chunk) dropout seed — the backward ring MUST derive
+    the identical value for the same chunk so masks regenerate exactly."""
+    pair = (my_idx * axis_size + src).astype(jnp.int32)
+    return seed + pair * jnp.int32(_SEED_STRIDE)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _ring_flash(q3, k3, v3, seed, axis_name, heads, scale, causal, blocks,
+                dropout_rate, interpret):
+    out, _ = _ring_flash_fwd_scan(q3, k3, v3, seed, axis_name, heads, scale,
+                                  causal, blocks, dropout_rate, interpret)
     return out
 
 
-def _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale, causal, blocks,
-                         interpret):
+def _ring_flash_fwd_scan(q3, k3, v3, seed, axis_name, heads, scale, causal,
+                         blocks, dropout_rate, interpret):
     """Forward ring: rotate kv chunks via ppermute, run the Pallas flash
     kernel per chunk, merge with the online softmax. The schedule is
     branch-free (a traced branch over pallas calls trips XLA's closed_call
@@ -143,7 +158,13 @@ def _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale, causal, blocks,
     all later steps run the non-causal kernel unconditionally and
     causally-invisible chunks are masked out of the merge — the same
     uniform schedule the jnp ring uses. Returns the normalized local
-    output and its GLOBAL per-row lse (what the backward kernels need)."""
+    output and its GLOBAL per-row lse (what the backward kernels need).
+
+    Dropout (rate > 0, real TPU only): each (owner, chunk) pair gets its
+    own kernel seed via _chunk_seed, so masks are independent across ring
+    steps AND devices; the per-chunk outputs are normalized by the TRUE
+    (pre-dropout) softmax masses, so the merged result is exactly
+    dropout(P_full) @ V — the dense semantics."""
     from solvingpapers_tpu.kernels.flash_attention import _fwd
 
     n_heads, n_kv = heads
@@ -152,27 +173,30 @@ def _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale, causal, blocks,
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-    seed = jnp.zeros((1,), jnp.int32)
 
     m0 = jnp.full_like(q3[..., 0], BIG_NEG, dtype=jnp.float32)  # (bn, s)
     l0 = jnp.zeros_like(m0)
     acc0 = jnp.zeros_like(q3, dtype=jnp.float32)
 
     # step 0: every device holds its own (diagonal) chunk
-    o0, lse0 = _fwd(q3, k3, v3, seed, n_heads, n_kv, scale, causal,
-                    block_q, block_k, 0.0, interpret)
+    o0, lse0 = _fwd(q3, k3, v3,
+                    _chunk_seed(seed, my_idx, my_idx, axis_size),
+                    n_heads, n_kv, scale, causal,
+                    block_q, block_k, dropout_rate, interpret)
     m, l, acc = _ring_merge(m0, l0, acc0, o0.astype(jnp.float32),
                             lse0[:, 0, :])
 
     def step(carry, i):
         m, l, acc, k_cur, v_cur = carry
-        o_c, lse_c = _fwd(q3, k_cur, v_cur, seed, n_heads, n_kv, scale,
-                          False, block_q, block_k, 0.0, interpret)
+        src = (my_idx - i) % axis_size
+        o_c, lse_c = _fwd(q3, k_cur, v_cur,
+                          _chunk_seed(seed, my_idx, src, axis_size),
+                          n_heads, n_kv, scale,
+                          False, block_q, block_k, dropout_rate, interpret)
         lse_c = lse_c[:, 0, :]
         if causal:
             # chunk src = (my - i) % size is visible iff it is globally
             # earlier; invisible chunks contribute zero mass via lse
-            src = (my_idx - i) % axis_size
             lse_c = jnp.where(src < my_idx, lse_c, BIG_NEG)
         m, l, acc = _ring_merge(m, l, acc, o_c.astype(jnp.float32), lse_c)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -191,22 +215,25 @@ def _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale, causal, blocks,
     return out, lse_g
 
 
-def _ring_flash_vjp_fwd(q3, k3, v3, axis_name, heads, scale, causal, blocks,
-                        interpret):
-    out, lse_g = _ring_flash_fwd_scan(q3, k3, v3, axis_name, heads, scale,
-                                      causal, blocks, interpret)
-    return out, (q3, k3, v3, out, lse_g)
+def _ring_flash_vjp_fwd(q3, k3, v3, seed, axis_name, heads, scale, causal,
+                        blocks, dropout_rate, interpret):
+    out, lse_g = _ring_flash_fwd_scan(q3, k3, v3, seed, axis_name, heads,
+                                      scale, causal, blocks, dropout_rate,
+                                      interpret)
+    return out, (q3, k3, v3, seed, out, lse_g)
 
 
-def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks, interpret,
-                        res, do):
+def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks,
+                        dropout_rate, interpret, res, do):
     """Backward ring: rotate (k, v, dk, dv) together; each step runs the
     shared _bwd_chunk pallas sweeps against the resident chunk with the
     GLOBAL lse/delta, accumulating dq locally and dk/dv onto the traveling
-    chunk. After a full cycle the dk/dv land back on their home device."""
+    chunk. After a full cycle the dk/dv land back on their home device.
+    With dropout, each chunk's _chunk_seed matches the forward's, so the
+    backward kernels regenerate the exact forward masks."""
     from solvingpapers_tpu.kernels.flash_attention import _bwd_chunk
 
-    q3, k3, v3, out, lse_g = res
+    q3, k3, v3, seed, out, lse_g = res
     n_heads, n_kv = heads
     group = n_heads // n_kv
     block_q, block_k = blocks
@@ -215,7 +242,6 @@ def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks, interpret,
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
-    seed = jnp.zeros((1,), jnp.int32)
 
     do32 = do.astype(jnp.float32)
     delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)[:, None, :]
@@ -235,31 +261,36 @@ def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks, interpret,
             bkv, s_loc, d
         )
 
-    def chunk_bwd(k_cur, v_cur, is_causal, lse_in):
+    def chunk_bwd(k_cur, v_cur, is_causal, lse_in, chunk_seed):
         dq, dk_r, dv_r = _bwd_chunk(
-            q3, rep(k_cur), rep(v_cur), do, lse_in, delta, seed,
+            q3, rep(k_cur), rep(v_cur), do, lse_in, delta, chunk_seed,
             scale=scale, causal=is_causal, block_q=block_q,
-            block_k=block_k, dropout_rate=0.0, interpret=interpret,
+            block_k=block_k, dropout_rate=dropout_rate, interpret=interpret,
         )
         return (dq.astype(jnp.float32), fold(dk_r).astype(jnp.float32),
                 fold(dv_r).astype(jnp.float32))
 
     # step 0: the diagonal chunk, statically causal — no masking needed
-    dq_acc, dk_cur, dv_cur = chunk_bwd(k3, v3, causal, lse_g)
+    dq_acc, dk_cur, dv_cur = chunk_bwd(
+        k3, v3, causal, lse_g, _chunk_seed(seed, my_idx, my_idx, axis_size)
+    )
 
     def step(carry, i):
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
         lse_in = lse_g
+        src = (my_idx - i) % axis_size
         if causal:
             # invisible chunks (globally later than this q shard) must
             # contribute nothing. Mask BEFORE the kernel's exp(s - lse)
             # (push lse to +huge so p underflows to exactly 0): a post-hoc
             # grad * 0.0 would turn an exp overflow from unmasked outlier
             # scores into inf * 0 = NaN
-            src = (my_idx - i) % axis_size
             lse_in = jnp.where(src < my_idx, lse_g,
                                jnp.full_like(lse_g, -BIG_NEG))
-        dq_c, dk_c, dv_c = chunk_bwd(k_cur, v_cur, False, lse_in)
+        dq_c, dk_c, dv_c = chunk_bwd(
+            k_cur, v_cur, False, lse_in,
+            _chunk_seed(seed, my_idx, src, axis_size),
+        )
         dq_acc = dq_acc + dq_c
         dk_cur = dk_cur + dk_c
         dv_cur = dv_cur + dv_c
@@ -281,7 +312,11 @@ def _ring_flash_vjp_bwd(axis_name, heads, scale, causal, blocks, interpret,
     # rotation count check: 1 pre-rotation + (size-1) end-of-step rotations
     # = size total, so every dk/dv chunk is back on its home device, with
     # the last contribution added before the final rotation
-    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+    import numpy as np
+
+    seed_ct = np.zeros(seed.shape, jax.dtypes.float0)  # int arg: no tangent
+    return (dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype),
+            seed_ct)
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
@@ -297,6 +332,8 @@ def ring_flash_attention_local(
     scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: jax.Array | int = 0,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Ring attention with the Pallas flash kernel as the per-chunk core
@@ -310,6 +347,7 @@ def ring_flash_attention_local(
     from solvingpapers_tpu.kernels.flash_attention import (
         DEFAULT_BLOCK,
         _pick_block,
+        _pick_block_q,
     )
 
     b, s_loc, n, h = q.shape
@@ -328,15 +366,21 @@ def ring_flash_attention_local(
         scale = h**-0.5
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
-    bq = _pick_block(s_loc, block_q or DEFAULT_BLOCK)
+    if dropout_rate > 0.0 and interpret:
+        raise ValueError(
+            "in-kernel dropout requires the hardware PRNG: interpret-mode "
+            "pltpu.prng_random_bits is a zero stub (kernels/flash_attention)"
+        )
+    bq = _pick_block_q(s_loc, block_q or DEFAULT_BLOCK)
     bk = _pick_block(s_loc, block_k or DEFAULT_BLOCK)
 
     q3 = q.transpose(0, 2, 1, 3).reshape(b * n, s_loc, h)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, s_loc, h)
     v3 = v.transpose(0, 2, 1, 3).reshape(b * n_kv, s_loc, h)
+    seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
     o3 = _ring_flash(
-        q3, k3, v3, axis_name, (n, n_kv), float(scale), bool(causal),
-        (bq, bk), interpret,
+        q3, k3, v3, seed, axis_name, (n, n_kv), float(scale), bool(causal),
+        (bq, bk), float(dropout_rate), interpret,
     )
     return o3.reshape(b, n, s_loc, h).transpose(0, 2, 1, 3)
 

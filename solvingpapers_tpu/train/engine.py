@@ -151,7 +151,14 @@ class Trainer:
                     out_specs=P(), check_vma=self._check_vma(),
                 )(rngs, example_batch)
             else:
-                with ambient_mesh(self.mesh):
+                # PP models init their blocks on tiny unsharded dummies —
+                # routing those through the sharded flash wrapper would be
+                # wrong (and the real PP step runs inside shard_map, where
+                # the direct kernel is correct); only GSPMD-partitioned
+                # inits mark the mesh
+                with ambient_mesh(
+                    None if cfg.pipeline_parallel else self.mesh
+                ):
                     out = self.init_fn(self.model, rngs, example_batch)
             # init_fn may return params alone or (params, model_state)
             params, model_state = out if isinstance(out, tuple) else (out, None)
@@ -285,9 +292,14 @@ class Trainer:
         stage dim under 'stages') are sharded over 'pipe'; inside shard_map
         the model runs the GPipe ppermute schedule (models/gpt_pipe.py).
         Every pipe device computes the identical global loss (the pipeline
-        output is psum-broadcast), so the pmean over 'pipe' is exact."""
+        output is psum-broadcast), so the pmean over 'pipe' is exact.
+
+        FSDP composes: non-stage params (embedding/norm/head) enter in
+        their stored fsdp layout and are all-gathered in-step (ZeRO —
+        same mechanism as the CP path); stage params stay 'pipe'-local
+        (the GPipe body wants exactly its own stage)."""
         self._reject_axes(
-            "pipeline_parallel", ("fsdp", "model", "expert", "context"),
+            "pipeline_parallel", ("model", "expert", "context"),
             "replicates non-stage params inside shard_map",
         )
         mcfg = getattr(self.model, "cfg", None)
@@ -303,8 +315,24 @@ class Trainer:
         # invariant over 'pipe' (the pipeline output is psum-broadcast),
         # so only the data axes are reduced.
         return self._shard_map_loss_call(
-            ("data", "fsdp"), _pp_param_spec, rng_axes=("data", "fsdp")
+            ("data", "fsdp"), self._pp_param_specs(),
+            rng_axes=("data", "fsdp"), gather_fsdp=True,
         )
+
+    def _pp_param_specs(self):
+        """(path, leaf) -> P for PP in-specs: the stage-stacked subtree is
+        sharded over 'pipe' (NOT gathered — each device's GPipe body uses
+        its own stage), non-stage params carry their stored fsdp/expert
+        layout and are all-gathered in-step by gather_param (which only
+        touches fsdp/expert names, leaving 'pipe' dims local)."""
+        fsdp = self._fsdp_param_specs()
+
+        def spec(path, leaf):
+            if path and getattr(path[0], "key", None) == "stages":
+                return P("pipe")
+            return fsdp(path, leaf)
+
+        return spec
 
     def _cp_pp_loss_call(self):
         """CP x PP composition: the sequence is sharded over 'context' AND
@@ -371,7 +399,10 @@ class Trainer:
                 if entry is None:
                     continue
                 for name in (entry if isinstance(entry, tuple) else (entry,)):
-                    p = jax.lax.all_gather(p, name, axis=dim, tiled=True)
+                    # only ZeRO axes are gathered in-step; a 'pipe' entry
+                    # (PP stage stacks) marks a dim that must STAY local
+                    if name in ("fsdp", "expert"):
+                        p = jax.lax.all_gather(p, name, axis=dim, tiled=True)
             return p
 
         def pmean(a):

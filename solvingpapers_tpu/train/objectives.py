@@ -74,7 +74,7 @@ def _aggregate_moe_metrics(collection) -> dict:
     layer_stats = [s for s in layer_stats if isinstance(s, dict)]
     if not layer_stats:
         return {}
-    keys = layer_stats[0].keys()
+    keys = [k for k in layer_stats[0] if k != "ci"]  # ci is an (E,) vector
     return {
         f"moe_{k}": jnp.mean(jnp.stack([s[k] for s in layer_stats]))
         for k in keys
@@ -91,6 +91,7 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
     variables = {"params": params, **(model_state or {})}
     kwargs = dict(deterministic=not train, return_mtp=use_mtp)
     moe_metrics = {}
+    balance_terms: list = []
     if train:
         (out, _), mutated = model.apply(
             variables,
@@ -100,7 +101,19 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
             **kwargs,
         )
         new_ms = {"moe_state": mutated["moe_state"]}
-        moe_metrics = _aggregate_moe_metrics(mutated.get("moe_metrics", {}))
+        raw_metrics = mutated.get("moe_metrics", {})
+        moe_metrics = _aggregate_moe_metrics(raw_metrics)
+        if getattr(cfg, "balance_loss_weight", 0.0) > 0.0:
+            # sown per layer by MoELayer (differentiable, unlike the stats)
+            balance_terms = [
+                leaf
+                for path, leaf in jax.tree_util.tree_flatten_with_path(
+                    raw_metrics
+                )[0]
+                if any(
+                    getattr(k, "key", None) == "balance_loss" for k in path
+                )
+            ]
     else:
         out, _ = model.apply(variables, batch["x"], **kwargs)
         new_ms = model_state
@@ -112,6 +125,10 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
     main = ops.cross_entropy(logits, batch["y"])
     aux = {"perplexity": jnp.exp(main), **moe_metrics}
     loss = main
+    if balance_terms:
+        bal = jnp.mean(jnp.stack(balance_terms))
+        aux["balance_loss"] = bal
+        loss = loss + cfg.balance_loss_weight * bal
     if mtp_logits is not None:
         # mtp_loss wants the stream shifted so head j's target is token
         # i+(j+1)+1; y already holds tokens 1..T, pad the unknown tail

@@ -482,3 +482,50 @@ def test_cp_ep_uses_sliced_expert_compute(devices, monkeypatch):
     state, metrics = tr._train_step(state, batch)
     assert calls["sliced"] > 0, "CP step did not take the sliced-EP path"
     assert float(jax.device_get(metrics["train_loss"])) > 0
+
+
+def test_balance_loss_recovers_induced_overload():
+    """VERDICT r2: an induced routing overload must recover. Gate kernel
+    initialized to send ~every token to expert 0 (load_max ~1); training
+    with the sequence-wise balance loss (aux-free bias off, to isolate the
+    mechanism) must spread the load back out."""
+    import dataclasses as dc
+
+    cfg = dc.replace(TINY, use_aux_free=False, balance_loss_weight=0.2)
+    model = DeepSeekV3(cfg)
+    toks = jax.random.randint(jax.random.key(0), (8, 16), 0, cfg.vocab_size)
+    batch = {"x": toks, "y": jnp.roll(toks, -1, axis=1)}
+    variables = model.init({"params": jax.random.key(1)}, toks)
+    params = variables["params"]
+    # induce collapse: every layer's gate strongly prefers expert 0
+    for lname in [k for k in params if k.startswith("layer_")]:
+        kern = params[lname]["moe"]["gate"]["kernel"]
+        biased = jnp.zeros_like(kern).at[:, 0].set(2.0)
+        params[lname]["moe"]["gate"]["kernel"] = biased
+    ms = {"moe_state": variables["moe_state"]}
+
+    import optax
+
+    tx = optax.adam(2e-2)
+    opt_state = tx.init(params)
+
+    def step(params, opt_state, key):
+        def loss_fn(p):
+            loss, aux, _ = dsv3_loss_fn(model, p, batch, key, ms, True)
+            return loss, aux
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, aux
+
+    _, aux0, _ = dsv3_loss_fn(model, params, batch, jax.random.key(2), ms, True)
+    assert float(aux0["moe_load_max_fraction"]) > 0.9  # overload induced
+    for i in range(120):
+        params, opt_state, aux = step(params, opt_state, jax.random.key(i))
+    # meaningful recovery (full rebalance is asymptotic through the
+    # top-k renormalization): max load sheds >= 0.2, entropy rises, the
+    # balance objective itself decreases
+    assert float(aux["moe_load_max_fraction"]) < float(
+        aux0["moe_load_max_fraction"]) - 0.2, aux
+    assert float(aux["moe_load_entropy"]) > float(
+        aux0["moe_load_entropy"]) + 0.2, aux
+    assert float(aux["balance_loss"]) < float(aux0["balance_loss"]) - 0.2

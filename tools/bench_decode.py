@@ -29,27 +29,48 @@ def main() -> None:
     p.add_argument("--new", type=int, default=256)
     p.add_argument("--dim", type=int, default=1024)
     p.add_argument("--layers", type=int, default=24)
+    p.add_argument("--model", choices=("llama3", "dsv3"), default="llama3",
+                   help="dsv3 = flash-MLA long-context decode (16k prompts "
+                        "prefill through the Pallas kernel end-aligned mode)")
+    p.add_argument("--prefill-chunk", type=int, default=None)
     p.add_argument("--skip-recompute", action="store_true",
                    help="only measure the cached arm")
     args = p.parse_args()
 
     from solvingpapers_tpu import ops
     from solvingpapers_tpu.infer import generate
-    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
 
     total = args.prompt + args.new
-    cfg = LlamaConfig(
-        vocab_size=32000, dim=args.dim, n_layers=args.layers,
-        n_heads=args.dim // 64, n_kv_heads=args.dim // 128,
-        max_seq_len=total, dropout=0.0, dtype="bfloat16",
-    )
-    model = Llama(cfg)
+    extra_variables = None
+    if args.model == "dsv3":
+        from solvingpapers_tpu.models.deepseekv3 import (
+            DeepSeekV3, DeepSeekV3Config,
+        )
+
+        cfg = DeepSeekV3Config(
+            vocab_size=32000, block_size=total, dtype="bfloat16",
+            use_flash=True, pe_scale=0.02, rope_dim=64,
+            dropout=0.0, attn_dropout=0.0,
+        )
+        model = DeepSeekV3(cfg)
+    else:
+        from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+        cfg = LlamaConfig(
+            vocab_size=32000, dim=args.dim, n_layers=args.layers,
+            n_heads=args.dim // 64, n_kv_heads=args.dim // 128,
+            max_seq_len=total, dropout=0.0, dtype="bfloat16",
+        )
+        model = Llama(cfg)
     prompt = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size,
                                           (args.bs, args.prompt)),
         jnp.int32,
     )
-    params = model.init({"params": jax.random.key(0)}, prompt)["params"]
+    variables = model.init({"params": jax.random.key(0)}, prompt)
+    params = variables["params"]
+    if args.model == "dsv3":
+        extra_variables = {"moe_state": variables["moe_state"]}
     rng = jax.random.key(1)
 
     def timed(fn, *a, reps=3):
@@ -73,9 +94,19 @@ def main() -> None:
     # compiler indefinitely (observed >25 min vs 27 s unwrapped)
     cached = lambda p_, r: generate(  # noqa: E731
         model, params, p_, r, max_new_tokens=args.new,
-        sampler=ops.sample_greedy,
+        sampler=ops.sample_greedy, extra_variables=extra_variables,
+        prefill_chunk=args.prefill_chunk,
     )
     t_cached, out = timed(cached, prompt, rng)
+
+    # prefill-only arm (max_new_tokens=1): isolates the end-aligned
+    # flash/causal prefill from the scan decode
+    prefill_only = lambda p_, r: generate(  # noqa: E731
+        model, params, p_, r, max_new_tokens=1,
+        sampler=ops.sample_greedy, extra_variables=extra_variables,
+        prefill_chunk=args.prefill_chunk,
+    )
+    t_prefill, _ = timed(prefill_only, prompt, rng)
 
     # arm 2: reference-style — a full forward over the final-length prefix
     # per new token. Measured as one jitted full-length forward x `new`
@@ -91,11 +122,19 @@ def main() -> None:
         t_full = t_one * args.new
 
     new_toks = args.bs * args.new
-    out = {
-        "model": f"llama3-d{args.dim}-L{args.layers}", "bs": args.bs,
+    name = (
+        f"dsv3-flash-mla" if args.model == "dsv3"
+        else f"llama3-d{args.dim}-L{args.layers}"
+    )
+    decode_s = max(t_cached - t_prefill, 1e-9)
+    decoded = max(args.new - 1, 1)  # prefill emits token 0; --new 1 is
+    out = {                         # effectively a prefill-only run
+        "model": name, "bs": args.bs,
         "prompt": args.prompt, "new": args.new,
-        "cached_tokens_per_sec": round(new_toks / t_cached),
-        "cached_ms_per_token": round(t_cached / args.new * 1e3, 3),
+        "prefill_s": round(t_prefill, 3),
+        "prefill_tokens_per_sec": round(args.bs * args.prompt / t_prefill),
+        "cached_tokens_per_sec": round(args.bs * decoded / decode_s),
+        "cached_ms_per_token": round(decode_s / decoded * 1e3, 3),
     }
     if t_full is not None:
         out["recompute_tokens_per_sec"] = round(new_toks / t_full)

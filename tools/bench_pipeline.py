@@ -1,0 +1,117 @@
+"""GPipe bubble-overhead measurement (BENCHMARKS.md PP row).
+
+The ppermute schedule runs `m + S - 1` ticks for m microbatches over S
+stages; (S-1) of them are bubbles, so the analytic bubble fraction is
+(S-1)/(m+S-1) of every step — amortized away as m grows at fixed global
+batch (each tick's compute shrinks by the same factor the tick count
+grows, up to per-tick overheads).
+
+Multi-chip hardware is not attached here, so this measures on the virtual
+CPU mesh (same schedule, same collectives, host math): the MEASURED
+step-time trend vs m validates the schedule's amortization shape, while
+the analytic fraction is the hardware-independent number. Run with
+JAX_PLATFORMS=cpu and XLA_FLAGS=--xla_force_host_platform_device_count=8
+(tests/conftest.py's recipe), or let this script set them via a subprocess
+re-exec (default when the attached platform has <8 devices).
+
+Usage: python tools/bench_pipeline.py [--stages 4] [--batch 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+
+def _body(n_stages: int, batch: int) -> None:
+    import jax
+    import numpy as np
+
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipe, GPTPipeConfig
+    from solvingpapers_tpu.sharding import MeshConfig, PP_RULES, batch_sharding, create_mesh
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    mesh_cfg = MeshConfig(data=8 // n_stages, pipe=n_stages)
+    mesh = create_mesh(mesh_cfg, jax.devices()[:8])
+    rows = []
+    for n_micro in (1, 2, 4, 8):
+        if (batch // (8 // n_stages)) % n_micro:
+            continue
+        cfg = GPTPipeConfig(
+            vocab_size=256, block_size=128, dim=128, n_layers=n_stages * 2,
+            n_heads=4, n_stages=n_stages, n_microbatches=n_micro,
+            pipeline_parallel=True,
+        )
+        tcfg = TrainConfig(
+            steps=0, batch_size=batch, log_every=10_000, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True,
+            optimizer=OptimizerConfig(max_lr=1e-3, total_steps=10),
+        )
+        trainer = Trainer(GPTPipe(cfg), tcfg, rules=PP_RULES, mesh=mesh)
+        toks = np.random.default_rng(0).integers(0, 256, size=100_000)
+        it = lm_batch_iterator(toks, batch, cfg.block_size,
+                               sharding=batch_sharding(mesh))
+        b0 = next(it)
+        state = trainer.init_state(b0)
+        trainer._build_steps()
+        for _ in range(3):
+            state, m = trainer._train_step(state, next(it))
+        float(jax.device_get(m["train_loss"]))
+        t0 = time.perf_counter()
+        n = 10
+        for _ in range(n):
+            state, m = trainer._train_step(state, next(it))
+        float(jax.device_get(m["train_loss"]))
+        dt = (time.perf_counter() - t0) / n
+        rows.append({
+            "n_stages": n_stages, "n_micro": n_micro,
+            "ticks": n_micro + n_stages - 1,
+            "bubble_fraction": round((n_stages - 1) / (n_micro + n_stages - 1), 4),
+            "step_time_ms": round(1000 * dt, 2),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    # amortization check: more microbatches must not be slower than m=1
+    if len(rows) >= 2 and rows[-1]["step_time_ms"] > rows[0]["step_time_ms"] * 1.2:
+        print(json.dumps({"warning": "no amortization measured "
+                          "(per-tick overhead dominates at this scale)"}))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--batch", type=int, default=32)
+    args = p.parse_args()
+
+    import jax
+
+    if len(jax.devices()) >= 8:
+        _body(args.stages, args.batch)
+        return 0
+    # re-exec on the virtual CPU mesh (same recipe as __graft_entry__)
+    import re
+
+    env = dict(os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    here = pathlib.Path(__file__).resolve().parent.parent
+    snippet = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        f"import sys; sys.path.insert(0, {str(here)!r}); "
+        "from tools.bench_pipeline import _body; "
+        f"_body({args.stages}, {args.batch})"
+    )
+    proc = subprocess.run([sys.executable, "-c", snippet], env=env,
+                          cwd=str(here))
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
