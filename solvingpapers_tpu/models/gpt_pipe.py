@@ -42,6 +42,17 @@ class GPTPipeConfig:
     dtype: str = "float32"
     n_stages: int = 4
     n_microbatches: int = 4
+    # interleaved (virtual-stage) schedule: each pipe device holds
+    # n_stages/pipe_size thin stages... concretely `virtual_stages` slices
+    # per device (n_stages = pipe_size * virtual_stages), microbatches
+    # enter in groups of pipe_size and loop the ring — bubble shrinks from
+    # (P-1)/(m+P-1) to (P-1)/(m*v+P-1) (sharding/pipeline.py). 1 = GPipe.
+    # Does not compose with context_parallel (slice selection is a
+    # data-dependent branch; the CP ring's collectives can't sit inside it).
+    virtual_stages: int = 1
+    # jax.checkpoint each block inside the stage_fn: the schedule scan then
+    # saves only tick-boundary activations (recompute in backward)
+    remat: bool = False
     # True: apply inside shard_map over the 'pipe' axis with the GPipe
     # schedule; False: sequential scan over stages (dense oracle)
     pipeline_parallel: bool = False
@@ -59,6 +70,39 @@ class GPTPipeConfig:
                 f"n_layers {self.n_layers} not divisible by n_stages "
                 f"{self.n_stages}"
             )
+        if self.n_stages % self.virtual_stages:
+            raise ValueError(
+                f"n_stages {self.n_stages} not divisible by virtual_stages "
+                f"{self.virtual_stages}"
+            )
+        if self.virtual_stages > 1:
+            if self.context_parallel:
+                raise NotImplementedError(
+                    "interleaved schedule x context_parallel: the virtual-"
+                    "slice branch cannot contain the CP ring's collectives"
+                )
+            if self.n_microbatches % self.pipe_size:
+                raise ValueError(
+                    f"interleaved schedule needs n_microbatches "
+                    f"({self.n_microbatches}) divisible by the pipe size "
+                    f"({self.pipe_size}): microbatches enter in groups of P"
+                )
+
+    @property
+    def pipe_size(self) -> int:
+        """Devices on the pipe axis (= n_stages / virtual_stages)."""
+        return self.n_stages // self.virtual_stages
+
+    def storage_index(self, global_stage: int) -> int:
+        """Row of the stacked params holding `global_stage`. GPipe (v=1):
+        identity. Interleaved: device d stores its v slices contiguously
+        (blocked sharding over 'pipe'), so global stage g = j*P + d lives
+        at row d*v + j."""
+        v, p = self.virtual_stages, self.pipe_size
+        if v == 1:
+            return global_stage
+        d, j = global_stage % p, global_stage // p
+        return d * v + j
 
     @property
     def layers_per_stage(self) -> int:
@@ -115,7 +159,14 @@ class GPTPipe:
             stage_init(jax.random.fold_in(k_blocks, s))
             for s in range(cfg.n_stages)
         ]
-        stages = jax.tree.map(lambda *xs: jnp.stack(xs), *stage_list)
+        # storage row r holds global stage global_of(r) (identity for
+        # GPipe; the interleaved permutation for virtual_stages > 1 —
+        # cfg.storage_index documents the layout)
+        v, p = cfg.virtual_stages, cfg.pipe_size
+        order = [(r % v) * p + r // v for r in range(cfg.n_stages)]
+        stages = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *[stage_list[g] for g in order]
+        )
 
         params = {
             "tok_emb": {
@@ -177,7 +228,20 @@ class GPTPipe:
         x = x + jnp.take(p["pos_emb"], positions, axis=0)
         x = x.astype(cfg.compute_dtype)
 
-        if cfg.pipeline_parallel:
+        if cfg.pipeline_parallel and cfg.virtual_stages > 1:
+            # interleaved schedule: local slice holds this device's
+            # virtual_stages rows (blocked 'pipe' sharding of the permuted
+            # stack — cfg.storage_index)
+            from solvingpapers_tpu.sharding.pipeline import (
+                pipeline_local_apply_interleaved,
+            )
+
+            x = pipeline_local_apply_interleaved(
+                p["stages"], x, self._stage_fn,
+                n_microbatches=cfg.n_microbatches,
+                n_virtual=cfg.virtual_stages,
+            )
+        elif cfg.pipeline_parallel:
             # local stage slice has leading dim n_stages/pipe_size == 1
             # (shard_map over in_specs P('pipe'))
             x = pipeline_local_apply(
@@ -185,9 +249,12 @@ class GPTPipe:
                 n_microbatches=cfg.n_microbatches,
             )
         else:
-            for st in range(cfg.n_stages):
+            for g in range(cfg.n_stages):  # GLOBAL stage order
                 x = self._stage_fn(
-                    jax.tree.map(lambda a: a[st], p["stages"]), x
+                    jax.tree.map(
+                        lambda a: a[cfg.storage_index(g)], p["stages"]
+                    ),
+                    x,
                 )
 
         x = LayerNorm().apply({"params": p["ln_f"]}, x)
@@ -215,10 +282,11 @@ class GPTPipe:
 
         cfg = self.cfg
         dense = {k: v for k, v in params.items() if k != "stages"}
-        for s in range(cfg.n_stages):
+        for s in range(cfg.n_stages):  # s = GLOBAL stage index
+            row = cfg.storage_index(s)
             for j in range(cfg.layers_per_stage):
                 dense[f"block_{s * cfg.layers_per_stage + j}"] = jax.tree.map(
-                    lambda a: a[s], params["stages"][f"block_{j}"]
+                    lambda a: a[row], params["stages"][f"block_{j}"]
                 )
         dense_cfg = dataclasses.replace(cfg.block_cfg(), context_parallel=False)
         return GPT(dense_cfg), dense

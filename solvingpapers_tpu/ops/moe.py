@@ -51,12 +51,25 @@ def aux_free_bias_update(
     return bias + rate * jnp.sign(err).astype(bias.dtype)
 
 
+def _psum_axes(x: jax.Array, axis_names) -> tuple:
+    """Restrict a psum to the axes `x` actually varies over — under the
+    shard_map vma checker a psum over an invariant axis is a type error
+    (e.g. CP x PP meshes where 'data' has size 1); without vma tracking
+    the full tuple is kept (the extra psums are numeric no-ops)."""
+    vma = getattr(jax.typeof(x), "vma", None)
+    if vma is None:
+        return tuple(axis_names)
+    return tuple(a for a in axis_names if a in vma)
+
+
 def expert_load(probs: jax.Array, axis_names=None) -> jax.Array:
     """(E,) routed probability mass per expert under stop_gradient,
     psum'd over `axis_names` when inside shard_map."""
     ci = jax.lax.stop_gradient(jnp.sum(probs.astype(jnp.float32), axis=0))
     if axis_names:
-        ci = jax.lax.psum(ci, axis_names)
+        axes = _psum_axes(ci, axis_names)
+        if axes:
+            ci = jax.lax.psum(ci, axes)
     return ci
 
 
@@ -117,8 +130,10 @@ def dispatch_drop_fraction(
     kept = jnp.sum(keep.astype(jnp.float32))
     routed = jnp.sum(sel.astype(jnp.float32))
     if axis_names:
-        kept = jax.lax.psum(kept, axis_names)
-        routed = jax.lax.psum(routed, axis_names)
+        axes = _psum_axes(kept, axis_names)
+        if axes:
+            kept = jax.lax.psum(kept, axes)
+            routed = jax.lax.psum(routed, axes)
     return (routed - kept) / jnp.maximum(routed, 1.0)
 
 

@@ -113,6 +113,87 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
     return (out, aux_sum) if with_aux else out
 
 
+def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
+                                axis_name, n_virtual):
+    """Interleaved (virtual-stage) schedule: device d holds `n_virtual`
+    THIN stages (global stage j*P + d stored at local row j), microbatches
+    enter in groups of P and loop the ring v times consecutively — the
+    Megatron-style bubble shrink, forward-only form. Ticks = m*v + P - 1
+    with every device busy except the P-1 ramp ticks, so the bubble
+    fraction is (P-1)/(m*v + P - 1) — v times smaller than GPipe's at
+    equal microbatch count (each tick does 1/v of a GPipe stage's work).
+
+    Schedule algebra (conflict-free by construction): group g member i
+    enters device 0 at tick g*v*P + i; after s total hops it sits on
+    device s mod P running virtual slice s // P, i.e. device d at tick
+    t holds the unit with (t - d) >= 0, g = (t-d) // (v*P),
+    i = (t-d) % P, slice j = ((t-d) % (v*P)) // P. Device 0's ingest
+    ticks (t % (v*P) < P) never collide with wrapped units, and group
+    g+1's ingest lands exactly as group g's last loop leaves.
+
+    stage_fn(stage_params_slice_j, x) -> y; requires n_micro % P == 0.
+    """
+    n_stages = jax.lax.psum(1, axis_name)  # P devices
+    d_id = jax.lax.axis_index(axis_name)
+    params_v = stage_params  # already this device's (v, ...) local rows
+    n_micro = microbatches.shape[0]
+    vP = n_virtual * n_stages
+    ticks = n_micro * n_virtual + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    probe = jax.tree.leaves(stage_params)[0]
+    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
+    if tracking and axis_name not in jax.typeof(microbatches).vma:
+        microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
+    buf = jnp.zeros_like(microbatches[0])
+    out = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        buf, out = carry
+        rel = t - d_id  # hops since this device's current unit entered
+        g = jnp.maximum(rel, 0) // vP
+        i = jnp.maximum(rel, 0) % n_stages
+        j = (jnp.maximum(rel, 0) % vP) // n_stages  # virtual slice index
+        # device 0 ingests a NEW microbatch whenever its unit is at hop 0
+        ingest = (d_id == 0) & (t % vP < n_stages)
+        mb_idx = jnp.clip(g * n_stages + i, 0, n_micro - 1)
+        incoming = jnp.where(
+            ingest, microbatches[mb_idx].astype(buf.dtype), buf
+        )
+        y = _apply_virtual(params_v, j, incoming, stage_fn, n_virtual)
+        # unit completes at device P-1 on its last slice
+        done = (
+            (d_id == n_stages - 1)
+            & (rel >= 0)
+            & (rel % vP >= (n_virtual - 1) * n_stages)
+            & (g * n_stages + i < n_micro)
+        )
+        updated = jax.lax.dynamic_update_index_in_dim(
+            out, y.astype(out.dtype), mb_idx, 0
+        )
+        out = jnp.where(done, updated, out)
+        buf = jax.lax.ppermute(y, axis_name, perm)
+        return (buf, out), None
+
+    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+    out = jnp.where(d_id == n_stages - 1, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def _apply_virtual(params_v, j, x, stage_fn, n_virtual):
+    """Run stage_fn with this device's virtual-slice-j params. j is traced,
+    so slice with lax.switch over the (python-static) v rows — a dynamic
+    gather of a whole param subtree would copy it; switch lets XLA keep
+    each branch's weights in place."""
+    branches = [
+        lambda x, jj=jj: stage_fn(
+            jax.tree.map(lambda a: a[jj], params_v), x
+        )
+        for jj in range(n_virtual)
+    ]
+    return jax.lax.switch(j, branches, x)
+
+
 def pipeline_local_apply(
     stage_params,
     x: jax.Array,
@@ -138,6 +219,30 @@ def pipeline_local_apply(
         out, aux = res
         return out.reshape(b, *x.shape[1:]), aux
     return res.reshape(b, *x.shape[1:])
+
+
+def pipeline_local_apply_interleaved(
+    stage_params,
+    x: jax.Array,
+    stage_fn,
+    *,
+    n_microbatches: int,
+    n_virtual: int,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Per-device interleaved-schedule entry (see
+    _pipeline_local_interleaved). stage_params: this device's (v, ...)
+    virtual-slice rows. Does not compose with collectives inside stage_fn
+    (slice selection is a data-dependent branch), so CP x interleaved is
+    rejected at the model layer."""
+    b = x.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
+    micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
+    out = _pipeline_local_interleaved(
+        stage_params, micro, stage_fn, axis_name, n_virtual
+    )
+    return out.reshape(b, *x.shape[1:])
 
 
 def pipeline_apply(

@@ -361,11 +361,12 @@ class Trainer:
 
     def _check_pp_stages(self, mcfg) -> None:
         pipe = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("pipe", 1)
-        if getattr(mcfg, "n_stages", None) != pipe:
+        v = getattr(mcfg, "virtual_stages", 1)
+        if getattr(mcfg, "n_stages", None) != pipe * v:
             raise ValueError(
                 f"model n_stages ({getattr(mcfg, 'n_stages', None)}) must "
-                f"equal the mesh 'pipe' axis size ({pipe}): the GPipe body "
-                "holds exactly one stage per device"
+                f"equal the mesh 'pipe' axis size ({pipe}) x virtual_stages "
+                f"({v}): each device holds exactly virtual_stages slices"
             )
 
     def _reject_axes(self, mode: str, axes: tuple, why: str) -> None:

@@ -47,8 +47,12 @@ def main() -> None:
             DeepSeekV3, DeepSeekV3Config,
         )
 
+        # --dim/--layers apply to the dsv3 arm too (heads scale with dim)
         cfg = DeepSeekV3Config(
             vocab_size=32000, block_size=total, dtype="bfloat16",
+            dim=args.dim if args.dim != 1024 else 512,
+            n_layers=args.layers if args.layers != 24 else 6,
+            n_heads=max((args.dim if args.dim != 1024 else 512) // 64, 1),
             use_flash=True, pe_scale=0.02, rope_dim=64,
             dropout=0.0, attn_dropout=0.0,
         )
@@ -123,7 +127,7 @@ def main() -> None:
 
     new_toks = args.bs * args.new
     name = (
-        f"dsv3-flash-mla" if args.model == "dsv3"
+        f"dsv3-flash-mla-d{cfg.dim}-L{cfg.n_layers}" if args.model == "dsv3"
         else f"llama3-d{args.dim}-L{args.layers}"
     )
     decode_s = max(t_cached - t_prefill, 1e-9)

@@ -40,13 +40,18 @@ def _body(n_stages: int, batch: int) -> None:
     mesh_cfg = MeshConfig(data=8 // n_stages, pipe=n_stages)
     mesh = create_mesh(mesh_cfg, jax.devices()[:8])
     rows = []
-    for n_micro in (1, 2, 4, 8):
+    # (n_micro, virtual_stages): v > 1 = interleaved schedule, bubble
+    # (P-1)/(m*v + P - 1) — same total layers, thinner stages
+    plan = [(1, 1), (2, 1), (4, 1), (8, 1), (4, 2), (8, 2)]
+    for n_micro, v in plan:
         if (batch // (8 // n_stages)) % n_micro:
+            continue
+        if v > 1 and n_micro % n_stages:  # interleaved: groups of P
             continue
         cfg = GPTPipeConfig(
             vocab_size=256, block_size=128, dim=128, n_layers=n_stages * 2,
-            n_heads=4, n_stages=n_stages, n_microbatches=n_micro,
-            pipeline_parallel=True,
+            n_heads=4, n_stages=n_stages * v, n_microbatches=n_micro,
+            virtual_stages=v, pipeline_parallel=True,
         )
         tcfg = TrainConfig(
             steps=0, batch_size=batch, log_every=10_000, eval_every=0,
@@ -69,10 +74,11 @@ def _body(n_stages: int, batch: int) -> None:
             state, m = trainer._train_step(state, next(it))
         float(jax.device_get(m["train_loss"]))
         dt = (time.perf_counter() - t0) / n
+        ticks = n_micro * v + n_stages - 1
         rows.append({
-            "n_stages": n_stages, "n_micro": n_micro,
-            "ticks": n_micro + n_stages - 1,
-            "bubble_fraction": round((n_stages - 1) / (n_micro + n_stages - 1), 4),
+            "n_stages": n_stages, "n_micro": n_micro, "virtual": v,
+            "ticks": ticks,
+            "bubble_fraction": round((n_stages - 1) / ticks, 4),
             "step_time_ms": round(1000 * dt, 2),
         })
         print(json.dumps(rows[-1]), flush=True)
