@@ -435,6 +435,10 @@ def _dsv3_pp() -> RunConfig:
             latent_dim=64, rope_dim=32, pe_scale=0.02, n_experts=8,
             top_experts=2, dtype="bfloat16", n_stages=4, n_microbatches=8,
             pipeline_parallel=True,
+            # the reference recipe's dropout 0.1 (deepseekv3.ipynb cell 4)
+            # now trains under the schedule (per-(stage, microbatch, layer)
+            # mask keys)
+            dropout=0.1, attn_dropout=0.1,
         ),
         train=TrainConfig(
             steps=10_000, batch_size=32, log_every=100, eval_every=500,

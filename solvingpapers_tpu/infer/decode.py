@@ -71,6 +71,12 @@ def generate(
 
     caches = model.init_caches(b, max_len)
     variables = {"params": params, **(extra_variables or {})}
+    if prefill_chunk is None and s0 > 4096:
+        # auto-chunk long prompts (the CLI's cmd_sample default): a single
+        # >4096-token prefill would raise from the flash kernel's
+        # _pick_block_q when s0 has no 128-divisible block, and unchunked
+        # activation memory grows with s0 regardless
+        prefill_chunk = 2048
     if prefill_chunk is None or s0 <= prefill_chunk:
         positions = jnp.broadcast_to(jnp.arange(s0), (b, s0))
         logits, caches = model.apply(

@@ -175,12 +175,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, seed_ref, o_ref, lse_ref,
             s = jnp.where(cols <= rows + offset, s, BIG_NEG)
         m_i, l_i, acc = m_scr[...], l_scr[...], acc_scr[...]
         m_new = jnp.maximum(m_i, jnp.max(s, axis=1, keepdims=True))
-        # fully-masked rows inside a LIVE block (seq_q > seq_k end-aligned
-        # causal, e.g. a single-q-block fallback) have every s == BIG_NEG
-        # and m_new == BIG_NEG: exp(s - m_new) would be 1, crediting unit
-        # mass to invisible keys. Zero masked entries explicitly so those
-        # rows keep l == 0 and hit the empty-row guard at _finish.
-        p = jnp.where(s <= BIG_NEG * 0.5, 0.0, jnp.exp(s - m_new))
+        if causal and offset < 0:
+            # seq_q > seq_k end-aligned causal only (e.g. a single-q-block
+            # fallback): rows with r + offset < 0 see NO key in any block,
+            # so m_new == BIG_NEG and exp(s - m_new) would be 1, crediting
+            # unit mass to invisible keys. Zero masked entries so those rows
+            # keep l == 0 and hit the empty-row guard at _finish. With
+            # offset >= 0 every row is valid in kv block 0, after which
+            # exp(BIG_NEG - m_new) underflows to 0 on its own — keep the
+            # select off the seq_q == seq_k training hot path.
+            p = jnp.where(s <= BIG_NEG * 0.5, 0.0, jnp.exp(s - m_new))
+        else:
+            p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_i - m_new)
         # l accumulates the UNdropped mass (the softmax denominator);
         # dropout applies to the normalized probs, i.e. to acc only

@@ -21,9 +21,12 @@ layers is scattered into a zero stack and psum'd over 'pipe' — every
 device applies the identical full-stack update, so out_specs P() holds by
 construction (verified under the vma checker for non-flash configs).
 
-Dropout is structurally 0 for the same reason as GPTPipe: the stage_fn is
-pure and re-runs across ticks, so per-tick mask threading would be
-required for well-defined dropout.
+Dropout trains under the schedule (the reference flagship's recipe is
+dropout 0.1, deepseekv3.ipynb cell 4): the GPipe tick derives a
+per-(stage, microbatch) key (sharding/pipeline.py rng kwarg), the stage_fn
+folds in the layer index, and the post-stack dropout runs replicated
+outside the schedule — every mask is a pure function of the base key and
+regenerates identically across remat/backward (same recipe as GPTPipe).
 """
 
 from __future__ import annotations
@@ -66,6 +69,12 @@ class DSV3PipeConfig:
     aux_free_bias_update_rate: float = 0.001
     moe_impl: str = "dispatch"  # dispatch | dense
     capacity_factor: float = 2.0
+    # the reference recipe's dropout 0.1 (cell 4): residual/out-proj and
+    # attention-prob dropout inside the staged layers via per-(stage,
+    # microbatch, layer) keys, plus the post-stack dropout (cell 31)
+    # applied replicated outside the schedule
+    dropout: float = 0.0
+    attn_dropout: float = 0.0
     dtype: str = "float32"
     use_flash: bool = False
     remat: bool = False  # jax.checkpoint each block inside the stage_fn
@@ -118,7 +127,8 @@ class DSV3PipeConfig:
             use_aux_free=self.use_aux_free,
             aux_free_bias_update_rate=self.aux_free_bias_update_rate,
             moe_impl=self.moe_impl, capacity_factor=self.capacity_factor,
-            dropout=0.0, attn_dropout=0.0, dtype=self.dtype,
+            dropout=self.dropout, attn_dropout=self.attn_dropout,
+            dtype=self.dtype,
             use_flash=self.use_flash,
             context_parallel=self.context_parallel,
         )
@@ -168,24 +178,30 @@ class DSV3Pipe:
         index under PP, python int under the dense oracle)."""
         cfg = self.cfg
 
-        def one(block_params, bias_j, x):
+        def one(block_params, bias_j, x, key):
+            det = key is None
             (y, _), mut = self._block.apply(
                 {"params": block_params, "moe_state": bias_j},
-                x, positions, None, True, None,
+                x, positions, None, det, None,
                 mutable=["moe_metrics"],
+                **({} if det else {"rngs": {"dropout": key}}),
             )
             stats = mut["moe_metrics"]["moe"]["stats"][0]
             return y, {k: stats[k] for k in (*_STAT_KEYS, "ci")}
 
         if cfg.remat:
+            # same key on the remat replay -> identical masks in backward
             one = jax.checkpoint(one)
 
-        def stage_fn(sp, x):
+        def stage_fn(sp, x, rng=None):
             sid = stage_index_fn()
             aux_layers = []
             for j in range(cfg.layers_per_stage):
                 bias_j = stage_slice(bias_stack[f"block_{j}"], sid)
-                x, layer_aux = one(sp[f"block_{j}"], bias_j, x)
+                x, layer_aux = one(
+                    sp[f"block_{j}"], bias_j, x,
+                    None if rng is None else jax.random.fold_in(rng, j),
+                )
                 aux_layers.append(layer_aux)
             aux = {
                 k: jnp.stack([a[k] for a in aux_layers])
@@ -223,9 +239,26 @@ class DSV3Pipe:
                 b, s, cfg.context_parallel, max_positions=cfg.block_size
             )
         pe = ops.sinusoidal_position_encoding(cfg.block_size, cfg.dim)
-        x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
-        x = x + cfg.pe_scale * jnp.take(pe, positions, axis=0)
-        x = x.astype(cfg.compute_dtype)
+        # cast-then-add, matching the dense DeepSeekV3 (its nn.Embed emits
+        # compute_dtype before the PE add) so staged and restacked-dense
+        # forwards agree bit-for-bit in bf16
+        x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0).astype(
+            cfg.compute_dtype
+        )
+        x = x + cfg.pe_scale * jnp.take(pe, positions, axis=0).astype(
+            cfg.compute_dtype
+        )
+
+        train_drop = (not deterministic) and (
+            cfg.dropout > 0.0 or cfg.attn_dropout > 0.0
+        )
+        sched_rng = k_out = None
+        if train_drop:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError(
+                    "dropout > 0 training requires rngs={'dropout': key}"
+                )
+            k_out, sched_rng = jax.random.split(rngs["dropout"])
 
         if cfg.pipeline_parallel:
             mb = x.shape[0] // cfg.n_microbatches
@@ -236,6 +269,7 @@ class DSV3Pipe:
             x, aux = pipeline_local_apply(
                 p["stages"], x, stage_fn,
                 n_microbatches=cfg.n_microbatches, with_aux=True,
+                rng=sched_rng,
             )
             # aux sums over this device's n_microbatches valid ticks
             n_ticks = cfg.n_microbatches
@@ -247,11 +281,20 @@ class DSV3Pipe:
                     bias_stack, positions, lambda st=st: st
                 )
                 x, aux_s = stage_fn(
-                    jax.tree.map(lambda a: a[st], p["stages"]), x
+                    jax.tree.map(lambda a: a[st], p["stages"]), x,
+                    None if sched_rng is None
+                    else jax.random.fold_in(sched_rng, st),
                 )
                 aux_stages.append(aux_s)
             n_ticks = 1
 
+        if train_drop and cfg.dropout > 0.0:
+            # the post-stack dropout (cell 31) — replicated on every pipe
+            # device with the same key, keeping the psum-broadcast output
+            # identical across the axis
+            keep = 1.0 - cfg.dropout
+            mask = jax.random.bernoulli(k_out, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
         x = 2.0 * cfg.n_layers**-0.5 * x  # deepseek depth scaling (cell 31)
         x = RMSNorm().apply({"params": p["norm_f"]}, x)
         logits = (
@@ -325,10 +368,29 @@ class DSV3Pipe:
 
         if "moe_metrics" in wants:
             if pp:
-                # own-stage scalar sums over valid ticks -> global means:
-                # /ticks, sum over own layers, psum over pipe, /n_layers
-                stats = {}
-                for k in _STAT_KEYS:
+                # load_entropy/load_max_fraction are recomputed from the
+                # GLOBAL per-layer ci (tick-summed + data-psum'd above) —
+                # averaging the per-tick device-local stats understates
+                # routing collapse vs the dense family, which computes them
+                # on the globally reduced load (advisor r3). drop_fraction
+                # averages exactly (equal-size microbatches share the
+                # denominator); bias_norm is tick-invariant, so its mean
+                # over ticks is the value itself.
+                e = float(cfg.n_experts)
+                load = ci / jnp.maximum(
+                    jnp.sum(ci, axis=-1, keepdims=True), 1e-9
+                )  # (layers_per_stage, E), rows are global loads
+                ent = -jnp.sum(
+                    load * jnp.log(load + 1e-9), axis=-1
+                ) / jnp.log(e)
+                stats = {
+                    "load_entropy":
+                        jax.lax.psum(jnp.sum(ent), "pipe") / cfg.n_layers,
+                    "load_max_fraction":
+                        jax.lax.psum(jnp.sum(jnp.max(load, axis=-1)),
+                                     "pipe") / cfg.n_layers,
+                }
+                for k in ("drop_fraction", "bias_norm"):
                     v = jnp.sum(aux[k]) / n_ticks
                     stats[k] = jax.lax.psum(v, "pipe") / cfg.n_layers
             else:

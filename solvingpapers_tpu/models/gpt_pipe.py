@@ -39,6 +39,12 @@ class GPTPipeConfig:
     n_layers: int = 8
     n_heads: int = 4
     mlp_mult: int = 4
+    # dropout trains under the schedule via the regenerable-seed recipe:
+    # the GPipe/interleaved tick derives a per-(stage, microbatch) key
+    # (sharding/pipeline.py rng kwarg) and the stage_fn folds in the layer
+    # index, so every mask is a pure function of (base key, stage, layer,
+    # microbatch) and regenerates identically across remat/backward
+    dropout: float = 0.0
     dtype: str = "float32"
     n_stages: int = 4
     n_microbatches: int = 4
@@ -113,13 +119,10 @@ class GPTPipeConfig:
         return jnp.dtype(self.dtype)
 
     def block_cfg(self) -> GPTConfig:
-        # dropout is structurally 0: the GPipe stage_fn is pure (params, x)
-        # and re-runs across schedule ticks, so per-tick rng threading would
-        # be required for well-defined masks
         return GPTConfig(
             vocab_size=self.vocab_size, block_size=self.block_size,
             dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
-            mlp_mult=self.mlp_mult, dropout=0.0, dtype=self.dtype,
+            mlp_mult=self.mlp_mult, dropout=self.dropout, dtype=self.dtype,
             use_flash=self.use_flash,
             context_parallel=self.context_parallel,
             context_impl=self.context_impl,
@@ -189,15 +192,25 @@ class GPTPipe:
 
     # ----------------------------------------------------------------- apply
 
-    def _stage_fn(self, stage_params, x):
-        def one(p, x):
-            y, _ = self._block.apply({"params": p}, x, None, None, True)
+    def _stage_fn(self, stage_params, x, rng=None):
+        def one(p, x, key):
+            if key is None:
+                y, _ = self._block.apply({"params": p}, x, None, None, True)
+            else:
+                y, _ = self._block.apply(
+                    {"params": p}, x, None, None, False, None,
+                    rngs={"dropout": key},
+                )
             return y
 
         if self.cfg.remat:
+            # same key on the remat replay -> identical masks in backward
             one = jax.checkpoint(one)
         for j in range(self.cfg.layers_per_stage):
-            x = one(stage_params[f"block_{j}"], x)
+            x = one(
+                stage_params[f"block_{j}"], x,
+                None if rng is None else jax.random.fold_in(rng, j),
+            )
         return x
 
     def apply(
@@ -228,6 +241,21 @@ class GPTPipe:
         x = x + jnp.take(p["pos_emb"], positions, axis=0)
         x = x.astype(cfg.compute_dtype)
 
+        train_drop = (not deterministic) and cfg.dropout > 0.0
+        sched_rng = None
+        if train_drop:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError(
+                    "dropout > 0 training requires rngs={'dropout': key}"
+                )
+            k_emb, sched_rng = jax.random.split(rngs["dropout"])
+            # embedding dropout (models/gpt.py's nn.Dropout site) applied
+            # manually — it runs replicated on every pipe device with the
+            # same key, so all devices agree on the schedule's stage-0 input
+            keep = 1.0 - cfg.dropout
+            mask = jax.random.bernoulli(k_emb, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
         if cfg.pipeline_parallel and cfg.virtual_stages > 1:
             # interleaved schedule: local slice holds this device's
             # virtual_stages rows (blocked 'pipe' sharding of the permuted
@@ -240,6 +268,7 @@ class GPTPipe:
                 p["stages"], x, self._stage_fn,
                 n_microbatches=cfg.n_microbatches,
                 n_virtual=cfg.virtual_stages,
+                rng=sched_rng,
             )
         elif cfg.pipeline_parallel:
             # local stage slice has leading dim n_stages/pipe_size == 1
@@ -247,6 +276,7 @@ class GPTPipe:
             x = pipeline_local_apply(
                 p["stages"], x, self._stage_fn,
                 n_microbatches=cfg.n_microbatches,
+                rng=sched_rng,
             )
         else:
             for g in range(cfg.n_stages):  # GLOBAL stage order
@@ -255,6 +285,8 @@ class GPTPipe:
                         lambda a: a[cfg.storage_index(g)], p["stages"]
                     ),
                     x,
+                    None if sched_rng is None
+                    else jax.random.fold_in(sched_rng, g),
                 )
 
         x = LayerNorm().apply({"params": p["ln_f"]}, x)

@@ -7,8 +7,9 @@ exact LlamaBlock modules of models/llama3.py — GQA + RoPE + SwiGLU — so
 staged == dense is a restack away (`to_dense`), which is also the decode
 path (PP has no cache support). Stateless blocks make this the simple
 instantiation of the pattern; the flagship's stateful-MoE version is
-models/deepseekv3_pipe.py. Dropout is structurally 0 (pure stage_fn
-re-runs across schedule ticks).
+models/deepseekv3_pipe.py. Dropout trains under the schedule via
+per-(stage, microbatch, layer) keys (sharding/pipeline.py rng kwarg —
+the same regenerable-seed recipe as GPTPipe).
 """
 
 from __future__ import annotations
@@ -36,6 +37,10 @@ class LlamaPipeConfig:
     hidden_dim: int | None = None
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
+    # block-level dropout (the reference's transformer_block Bernoulli
+    # masks, LLaMA-jax.ipynb cell 26) via per-(stage, microbatch, layer)
+    # schedule keys
+    dropout: float = 0.0
     dtype: str = "float32"
     use_flash: bool = False
     remat: bool = False  # jax.checkpoint each block inside the stage_fn
@@ -66,7 +71,7 @@ class LlamaPipeConfig:
             dim=self.dim, n_layers=self.n_layers, n_heads=self.n_heads,
             n_kv_heads=self.n_kv_heads, hidden_dim=self.hidden_dim,
             rope_theta=self.rope_theta, norm_eps=self.norm_eps,
-            dropout=0.0, dtype=self.dtype, use_flash=self.use_flash,
+            dropout=self.dropout, dtype=self.dtype, use_flash=self.use_flash,
             context_parallel=self.context_parallel,
             context_impl=self.context_impl,
         )
@@ -109,17 +114,27 @@ class LlamaPipe:
         return {"params": params}
 
     def _stage_fn(self, positions):
-        def one(p, x):
-            y, _ = self._block.apply({"params": p}, x, positions, None, True,
-                                     None)
+        def one(p, x, key):
+            if key is None:
+                y, _ = self._block.apply({"params": p}, x, positions, None,
+                                         True, None)
+            else:
+                y, _ = self._block.apply(
+                    {"params": p}, x, positions, None, False, None,
+                    rngs={"dropout": key},
+                )
             return y
 
         if self.cfg.remat:
+            # same key on the remat replay -> identical masks in backward
             one = jax.checkpoint(one)
 
-        def stage_fn(sp, x):
+        def stage_fn(sp, x, rng=None):
             for j in range(self.cfg.layers_per_stage):
-                x = one(sp[f"block_{j}"], x)
+                x = one(
+                    sp[f"block_{j}"], x,
+                    None if rng is None else jax.random.fold_in(rng, j),
+                )
             return x
 
         return stage_fn
@@ -149,17 +164,31 @@ class LlamaPipe:
         x = jnp.take(p["tok_emb"]["embedding"], tokens, axis=0)
         x = x.astype(cfg.compute_dtype)
 
+        train_drop = (not deterministic) and cfg.dropout > 0.0
+        sched_rng = None
+        if train_drop:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError(
+                    "dropout > 0 training requires rngs={'dropout': key}"
+                )
+            sched_rng = rngs["dropout"]
+
         if cfg.pipeline_parallel:
             mb = x.shape[0] // cfg.n_microbatches
             stage_fn = self._stage_fn(positions[:mb])
             x = pipeline_local_apply(
                 p["stages"], x, stage_fn,
                 n_microbatches=cfg.n_microbatches,
+                rng=sched_rng,
             )
         else:
             stage_fn = self._stage_fn(positions)
             for st in range(cfg.n_stages):
-                x = stage_fn(jax.tree.map(lambda a: a[st], p["stages"]), x)
+                x = stage_fn(
+                    jax.tree.map(lambda a: a[st], p["stages"]), x,
+                    None if sched_rng is None
+                    else jax.random.fold_in(sched_rng, st),
+                )
 
         x = RMSNorm(eps=cfg.norm_eps).apply({"params": p["norm_f"]}, x)
         logits = (

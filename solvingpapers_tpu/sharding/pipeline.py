@@ -24,7 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
-                    with_aux: bool = False):
+                    with_aux: bool = False, rng=None):
     """Per-device body. stage_params: this stage's params (leading stage
     axis already stripped to size 1 by shard_map — squeezed here).
     microbatches: (n_micro, mb, ...) full input, replicated.
@@ -35,6 +35,14 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
     pollute statistics). Returns (out, aux_sum) — aux_sum covers exactly
     the full batch as seen by THIS device's stage (e.g. MoE routing loads
     for its layers); callers reduce across other mesh axes themselves.
+
+    rng: when given, stage_fn is called as stage_fn(params, x, unit_rng)
+    with unit_rng = fold_in(fold_in(rng, stage_id), microbatch_index) —
+    the regenerable-seed recipe that makes DROPOUT well-defined under the
+    schedule: at tick t stage s processes microbatch t - s, so the mask a
+    (stage, microbatch) unit sees is a pure function of the fold chain and
+    regenerates identically in the backward/remat replay (the same salting
+    idea as the CP ring's per-(owner, chunk) kernel seeds).
     """
     n_stages = jax.lax.psum(1, axis_name)
     stage_id = jax.lax.axis_index(axis_name)
@@ -42,6 +50,7 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
     n_micro = microbatches.shape[0]
     ticks = n_micro + n_stages - 1
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    stage_rng = None if rng is None else jax.random.fold_in(rng, stage_id)
 
     # shard_map vma typing: carriers and the replicated input must be marked
     # varying over the pipe axis before mixing with per-device values — but
@@ -56,13 +65,18 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
     buf = jnp.zeros_like(microbatches[0])  # current activation on this device
     out = jnp.zeros_like(microbatches)     # collected at the last stage
 
-    def run_stage(params, incoming):
-        res = stage_fn(params, incoming)
+    def run_stage(params, incoming, unit_rng=None):
+        if rng is None:
+            res = stage_fn(params, incoming)
+        else:
+            res = stage_fn(params, incoming, unit_rng)
         return res if with_aux else (res, None)
 
     # aux structure probe (shapes only) for the scan carry init
     aux_shapes = (
-        jax.eval_shape(lambda p, x: run_stage(p, x)[1], params, buf)
+        jax.eval_shape(
+            lambda p, x: run_stage(p, x, stage_rng)[1], params, buf
+        )
         if with_aux else None
     )
 
@@ -76,7 +90,14 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
             microbatches[mb_idx].astype(buf.dtype),
             buf,
         )
-        y, aux = run_stage(params, incoming)
+        unit_rng = None
+        if rng is not None:
+            # the microbatch THIS stage processes at tick t is t - stage_id
+            # (bubble ticks clip to a valid index; their output is garbage
+            # and masked at collection regardless)
+            mb_cur = jnp.clip(t - stage_id, 0, n_micro - 1)
+            unit_rng = jax.random.fold_in(stage_rng, mb_cur)
+        y, aux = run_stage(params, incoming, unit_rng)
         if with_aux:
             # stage s holds real data at ticks [s, s + n_micro)
             valid = (t >= stage_id) & (t < stage_id + n_micro)
@@ -114,7 +135,7 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
 
 
 def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
-                                axis_name, n_virtual):
+                                axis_name, n_virtual, rng=None):
     """Interleaved (virtual-stage) schedule: device d holds `n_virtual`
     THIN stages (global stage j*P + d stored at local row j), microbatches
     enter in groups of P and loop the ring v times consecutively — the
@@ -160,7 +181,15 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
         incoming = jnp.where(
             ingest, microbatches[mb_idx].astype(buf.dtype), buf
         )
-        y = _apply_virtual(params_v, j, incoming, stage_fn, n_virtual)
+        unit_rng = None
+        if rng is not None:
+            # global stage of virtual slice j on device d is j*P + d;
+            # fold (global stage, microbatch) exactly like _pipeline_local
+            unit_rng = jax.random.fold_in(
+                jax.random.fold_in(rng, j * n_stages + d_id), mb_idx
+            )
+        y = _apply_virtual(params_v, j, incoming, stage_fn, n_virtual,
+                           unit_rng)
         # unit completes at device P-1 on its last slice
         done = (
             (d_id == n_stages - 1)
@@ -180,18 +209,26 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
     return jax.lax.psum(out, axis_name)
 
 
-def _apply_virtual(params_v, j, x, stage_fn, n_virtual):
+def _apply_virtual(params_v, j, x, stage_fn, n_virtual, unit_rng=None):
     """Run stage_fn with this device's virtual-slice-j params. j is traced,
     so slice with lax.switch over the (python-static) v rows — a dynamic
     gather of a whole param subtree would copy it; switch lets XLA keep
     each branch's weights in place."""
+    if unit_rng is None:
+        branches = [
+            lambda x, jj=jj: stage_fn(
+                jax.tree.map(lambda a: a[jj], params_v), x
+            )
+            for jj in range(n_virtual)
+        ]
+        return jax.lax.switch(j, branches, x)
     branches = [
-        lambda x, jj=jj: stage_fn(
-            jax.tree.map(lambda a: a[jj], params_v), x
+        lambda x, r, jj=jj: stage_fn(
+            jax.tree.map(lambda a: a[jj], params_v), x, r
         )
         for jj in range(n_virtual)
     ]
-    return jax.lax.switch(j, branches, x)
+    return jax.lax.switch(j, branches, x, unit_rng)
 
 
 def pipeline_local_apply(
@@ -202,19 +239,22 @@ def pipeline_local_apply(
     n_microbatches: int,
     axis_name: str = "pipe",
     with_aux: bool = False,
+    rng=None,
 ):
     """Per-device GPipe entry for callers already inside shard_map (e.g. a
     pipeline-parallel model's forward): splits x (batch, ...) into
     microbatches, runs the schedule, and restores the batch shape.
     stage_params is this device's stage slice (leading stage dim 1).
     With `with_aux`, stage_fn returns (y, aux) and this returns
-    (out, aux_summed_over_valid_ticks) — see _pipeline_local."""
+    (out, aux_summed_over_valid_ticks) — see _pipeline_local.
+    With `rng`, stage_fn is called as (params, x, unit_rng) — per-(stage,
+    microbatch) dropout keys (see _pipeline_local)."""
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
     micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
     res = _pipeline_local(stage_params, micro, stage_fn, axis_name,
-                          with_aux=with_aux)
+                          with_aux=with_aux, rng=rng)
     if with_aux:
         out, aux = res
         return out.reshape(b, *x.shape[1:]), aux
@@ -229,18 +269,20 @@ def pipeline_local_apply_interleaved(
     n_microbatches: int,
     n_virtual: int,
     axis_name: str = "pipe",
+    rng=None,
 ) -> jax.Array:
     """Per-device interleaved-schedule entry (see
     _pipeline_local_interleaved). stage_params: this device's (v, ...)
     virtual-slice rows. Does not compose with collectives inside stage_fn
     (slice selection is a data-dependent branch), so CP x interleaved is
-    rejected at the model layer."""
+    rejected at the model layer. With `rng`, stage_fn is called as
+    (params, x, unit_rng) keyed by (global stage, microbatch)."""
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
     micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
     out = _pipeline_local_interleaved(
-        stage_params, micro, stage_fn, axis_name, n_virtual
+        stage_params, micro, stage_fn, axis_name, n_virtual, rng=rng
     )
     return out.reshape(b, *x.shape[1:])
 

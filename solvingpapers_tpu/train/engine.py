@@ -20,6 +20,7 @@ from typing import Any, Callable, Iterator
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -75,6 +76,15 @@ class TrainConfig:
     # trainer's key stream only; None = the jax default (threefry). Note:
     # checkpoints store key data, so resume with the impl that wrote them.
     prng_impl: str | None = "rbg"
+    # on-device training window: lax.scan `scan_steps` train steps per
+    # dispatch (one host->device batch transfer of K stacked batches, one
+    # fused XLA program). Amortizes per-step dispatch latency — the
+    # dominant cost for small models and high-latency transports (the
+    # tunnelled bench chip: ~12% of the reference-GPT step). Semantically
+    # identical to K sequential steps (tests/test_engine.py pins equality);
+    # log/eval/ckpt cadences must be multiples of scan_steps since the
+    # host only sees window boundaries.
+    scan_steps: int = 1
     # aux subsystems (SURVEY.md §5)
     debug_nans: bool = False  # jax_debug_nans: fail fast at the faulting op
     profile_dir: str | None = None  # jax.profiler trace output (TensorBoard)
@@ -129,6 +139,7 @@ class Trainer:
             lambda model, rngs, batch: model.init(rngs, batch["x"])["params"]
         )
         self._train_step = None
+        self._train_step_scan = None
         self._eval_step = None
         self._state_shardings = None
         self._batch_shardings = None
@@ -530,6 +541,27 @@ class Trainer:
             out_shardings=replicated,
         )
 
+        if self.config.scan_steps > 1:
+            def train_step_scan(state: TrainState, batches: dict):
+                # batches: the per-step batch pytree with a stacked leading
+                # K dim. Each scan iteration is bit-identical to one
+                # _train_step call (same per-step rng fold on state.step);
+                # returned metrics are the LAST step's — what a per-step
+                # loop would log at the window boundary.
+                new_state, ms = jax.lax.scan(train_step, state, batches)
+                return new_state, jax.tree.map(lambda x: x[-1], ms)
+
+            scan_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, P(None, *s.spec)),
+                data_sharding,
+            )
+            self._train_step_scan = jax.jit(
+                train_step_scan,
+                in_shardings=(self._state_shardings, scan_shardings),
+                out_shardings=(self._state_shardings, replicated),
+                donate_argnums=0,
+            )
+
     # ------------------------------------------------------------------ fit
 
     def fit(
@@ -591,37 +623,103 @@ class Trainer:
             jax.config.update("jax_debug_nans", True)
         t_prev = time.perf_counter()
         last_log_step = start_step
+        scan_k = max(cfg.scan_steps, 1)
+        if scan_k > 1:
+            cadences = [("log_every", cfg.log_every),
+                        ("eval_every", cfg.eval_every),
+                        ("ckpt_every", cfg.ckpt_every)]
+            cadences += [
+                (f"callbacks[{i}].every", every)
+                for i, (every, _) in enumerate(callbacks or [])
+            ]
+            for nm, ev in cadences:
+                if ev > 0 and ev % scan_k:
+                    raise ValueError(
+                        f"{nm}={ev} must be a multiple of scan_steps="
+                        f"{scan_k}: the host only sees window boundaries"
+                    )
+        profile_stopped = False
+        tail_warmed = False
         try:
-            for step in range(start_step, cfg.steps):
+            step = start_step
+            while step < cfg.steps:
+                # full scan windows on scan_k-aligned steps; single-step to
+                # re-align (a checkpoint resume can start mid-window) and
+                # through the ragged tail, so cfg.steps is hit exactly and
+                # window ends stay multiples of scan_k (the cadence checks
+                # depend on that)
+                if step % scan_k or step + scan_k > cfg.steps:
+                    kk = 1
+                else:
+                    kk = scan_k
+                end = step + kk
                 if preempted["flag"]:
                     ckpt.maybe_save(step, _pure_state(state), force=True)
                     writer.write(step, {"preempted": 1.0})
                     break
-                if cfg.profile_dir and step - start_step == cfg.profile_steps[0]:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
-                if profiling and step - start_step == cfg.profile_steps[1]:
+                # stop BEFORE the start check: when the profile window fits
+                # inside one scan window, checking start first would open
+                # and immediately close an empty trace in the same iteration
+                if profiling and step - start_step >= cfg.profile_steps[1]:
                     jax.profiler.stop_trace()
                     profiling = False
-                batch = first if (first is not None and step == start_step) else next(batch_iter)
-                first_used = first is not None and step == start_step
-                if first_used:
-                    first = None
-                state, metrics = self._train_step(state, batch)
+                    profile_stopped = True
+                if cfg.profile_dir and not profiling and not profile_stopped \
+                        and step - start_step >= cfg.profile_steps[0]:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                if kk == 1:
+                    batch = first if (first is not None and step == start_step) \
+                        else next(batch_iter)
+                    if first is not None and step == start_step:
+                        first = None
+                    exclude_compile = (
+                        scan_k > 1 and not tail_warmed and step != start_step
+                    )
+                    if exclude_compile:
+                        # first single-step call of a scan-windowed run (the
+                        # ragged tail or a resume re-align): _train_step has
+                        # not been traced yet, so fence and keep its compile
+                        # out of the step timing, like eval/checkpoint
+                        jax.device_get(metrics["train_loss"])
+                        t_tail = time.perf_counter()
+                    state, metrics = self._train_step(state, batch)
+                    if exclude_compile:
+                        jax.device_get(metrics["train_loss"])
+                        t_prev += time.perf_counter() - t_tail
+                    tail_warmed = True
+                else:
+                    window = []
+                    if first is not None and step == start_step:
+                        window.append(first)
+                        first = None
+                    while len(window) < kk:
+                        window.append(next(batch_iter))
+                    # device arrays (e.g. lm_batch_iterator's on-device
+                    # crops) stack with jnp — np.stack would force K
+                    # synchronous D2H pulls per window, catastrophic on
+                    # high-latency transports; host arrays stack on host so
+                    # the window ships as ONE transfer
+                    batch = jax.tree.map(
+                        lambda *xs: (jnp.stack(xs) if isinstance(xs[0], jax.Array)
+                                     else np.stack(xs)),
+                        *window,
+                    )
+                    state, metrics = self._train_step_scan(state, batch)
                 if step == start_step:
                     # fence the first step so compile time never pollutes
                     # step_time/tokens_per_sec/MFU metrics; the timed window
                     # therefore starts at the NEXT step
                     jax.device_get(metrics["train_loss"])
                     t_prev = time.perf_counter()
-                    last_log_step = start_step + 1
+                    last_log_step = end
 
                 run_eval = (
                     cfg.eval_every > 0 and eval_iter_fn
-                    and (step + 1) % cfg.eval_every == 0
+                    and end % cfg.eval_every == 0
                 )
                 run_cbs = callbacks and any(
-                    every > 0 and (step + 1) % every == 0 for every, _ in callbacks
+                    every > 0 and end % every == 0 for every, _ in callbacks
                 )
                 if run_eval or run_cbs:
                     # fence queued async train steps BEFORE starting the
@@ -635,17 +733,17 @@ class Trainer:
                 if run_eval:
                     t_eval = time.perf_counter()
                     val = self.evaluate(state, eval_iter_fn())
-                    writer.write(step + 1, {k: float(v) for k, v in val.items()})
+                    writer.write(end, {k: float(v) for k, v in val.items()})
                     t_prev += time.perf_counter() - t_eval  # keep eval out of step timing
 
                 if run_cbs:
                     t_cb = time.perf_counter()
                     for every, fn in callbacks:
-                        if every > 0 and (step + 1) % every == 0:
-                            fn(state, step + 1)
+                        if every > 0 and end % every == 0:
+                            fn(state, end)
                     t_prev += time.perf_counter() - t_cb
 
-                if (step + 1) % max(cfg.log_every, 1) == 0 or step == cfg.steps - 1:
+                if end % max(cfg.log_every, 1) == 0 or end == cfg.steps:
                     metrics = jax.device_get(metrics)  # blocks; also fences timing
                     if step == start_step:
                         # the compile step is excluded from the timed window;
@@ -653,13 +751,13 @@ class Trainer:
                         pass
                     else:
                         now = time.perf_counter()
-                        dt = (now - t_prev) / max(step + 1 - last_log_step, 1)
+                        dt = (now - t_prev) / max(end - last_log_step, 1)
                         t_prev = now
-                        last_log_step = step + 1
+                        last_log_step = end
                         metrics["step_time_s"] = dt
                         if cfg.tokens_per_step:
                             metrics["tokens_per_sec"] = cfg.tokens_per_step / dt
-                            metrics["tokens"] = (step + 1) * cfg.tokens_per_step
+                            metrics["tokens"] = end * cfg.tokens_per_step
                             if cfg.flops_per_token:
                                 from solvingpapers_tpu.metrics.mfu import chip_peak_flops
 
@@ -668,16 +766,17 @@ class Trainer:
                                     metrics["tokens_per_sec"] * cfg.flops_per_token
                                     / (chip_peak_flops() * n_chips)
                                 )
-                    writer.write(step + 1, {k: float(v) for k, v in metrics.items()})
+                    writer.write(end, {k: float(v) for k, v in metrics.items()})
 
                 if ckpt is not None and ckpt.save_every > 0 \
-                        and (step + 1) % ckpt.save_every == 0:
+                        and end % ckpt.save_every == 0:
                     # keep the save (fence + D2H snapshot; the disk write is
                     # already async) out of step timing, like eval/callbacks
                     jax.device_get(metrics["train_loss"])
                     t_save = time.perf_counter()
-                    ckpt.maybe_save(step + 1, _pure_state(state))
+                    ckpt.maybe_save(end, _pure_state(state))
                     t_prev += time.perf_counter() - t_save
+                step = end
 
             # unconditional: maybe_save dedupes existing steps, and a signal
             # landing during the final iteration must not lose the run

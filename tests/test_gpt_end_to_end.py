@@ -49,6 +49,78 @@ def test_gpt_loss_decreases():
     assert losses[-1] < losses[0] - 0.5, losses[:3] + losses[-3:]
 
 
+def test_scan_steps_window_equals_sequential_steps():
+    """TrainConfig.scan_steps runs K steps per dispatch via lax.scan; the
+    result must be bit-identical to K sequential _train_step calls (same
+    per-step rng fold on state.step)."""
+    _, train_toks, _ = tiny_corpus()
+    K = 4
+
+    def make(scan_steps):
+        cfg = TrainConfig(
+            steps=0, batch_size=8, log_every=100, eval_every=0,
+            scan_steps=scan_steps,
+            optimizer=OptimizerConfig(max_lr=1e-2, warmup_steps=5, total_steps=30),
+        )
+        t = Trainer(GPT(TINY), cfg)
+        it = lm_batch_iterator(train_toks, 8, TINY.block_size, seed=0)
+        s = t.init_state(next(it))
+        t._build_steps()
+        return t, s
+
+    it = lm_batch_iterator(train_toks, 8, TINY.block_size, seed=0)
+    next(it)  # consumed by init in both trainers
+    batches = [next(it) for _ in range(K)]
+
+    t_seq, s_seq = make(1)
+    for b in batches:
+        s_seq, m_seq = t_seq._train_step(s_seq, b)
+
+    t_scan, s_scan = make(K)
+    window = jax.tree.map(lambda *xs: np.stack(xs), *batches)
+    s_scan, m_scan = t_scan._train_step_scan(s_scan, window)
+
+    assert int(s_scan.step) == int(s_seq.step)
+    np.testing.assert_allclose(
+        float(m_scan["train_loss"]), float(m_seq["train_loss"]), rtol=1e-6
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6),
+        jax.device_get(s_scan.params), jax.device_get(s_seq.params),
+    )
+
+
+def test_fit_with_scan_steps_smoke():
+    """fit() drives scan windows (incl. a ragged single-step tail) and the
+    loss still goes down."""
+    _, train_toks, _ = tiny_corpus()
+    cfg = TrainConfig(
+        steps=22, batch_size=8, log_every=4, eval_every=0, scan_steps=4,
+        optimizer=OptimizerConfig(max_lr=1e-2, warmup_steps=5, total_steps=30),
+    )
+    trainer = Trainer(GPT(TINY), cfg)
+    it = lm_batch_iterator(train_toks, 8, TINY.block_size, seed=0)
+    rows = []
+
+    class Cap:
+        def write(self, step, metrics):
+            rows.append((step, metrics))
+
+    state = trainer.fit(it, writer=Cap())
+    assert int(state.step) == 22
+    losses = [m["train_loss"] for _, m in rows if "train_loss" in m]
+    assert losses[-1] < losses[0], rows
+
+
+def test_fit_rejects_misaligned_scan_cadence():
+    cfg = TrainConfig(steps=8, batch_size=8, log_every=3, eval_every=0,
+                      scan_steps=4)
+    trainer = Trainer(GPT(TINY), cfg)
+    it = lm_batch_iterator(tiny_corpus()[1], 8, TINY.block_size, seed=0)
+    with pytest.raises(ValueError, match="multiple of scan_steps"):
+        trainer.fit(it)
+
+
 def test_cached_decode_equals_full_forward():
     """Greedy decode through the KV cache must match recompute-from-scratch."""
     model = GPT(TINY)

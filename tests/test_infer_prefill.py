@@ -105,6 +105,25 @@ def test_cp_trained_weights_export_to_plain_decode(devices):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_generate_autochunks_long_flash_prefill():
+    """A use_flash model prefilling a >4096 prompt with no prefill_chunk
+    must auto-chunk instead of raising from the kernel's block picker mid-
+    trace (advisor r3: only the CLI auto-chunked; direct generate() callers
+    hit an avoidable ValueError on e.g. a 4500-token prompt)."""
+    cfg = dc.replace(
+        GPT_TINY, block_size=4608, n_layers=1, use_flash=True
+    )
+    model = GPT(cfg)
+    prompt = jax.random.randint(jax.random.key(0), (1, 4500), 0,
+                                cfg.vocab_size)
+    params = model.init({"params": jax.random.key(1)}, prompt[:, :8])["params"]
+    out = generate(model, params, prompt, jax.random.key(2),
+                   max_new_tokens=2)
+    assert out.shape == (1, 4502)
+    np.testing.assert_array_equal(np.asarray(out[:, :4500]),
+                                  np.asarray(prompt))
+
+
 def test_llama_prefill_matches_full_forward():
     cfg = LlamaConfig(vocab_size=64, max_seq_len=64, dim=32, n_layers=2,
                       n_heads=4, n_kv_heads=2, dropout=0.0)
