@@ -122,6 +122,8 @@ def build_image_run(cfg: RunConfig, mesh=None):
         side=d.get("side", 28),
         n_classes=d.get("n_classes", 10),
         seed=cfg.train.seed,
+        source=d.get("source", "separable"),
+        snr=d.get("snr", 2.8),
     )
     flatten = d.get("flatten", False)
     bsz = cfg.train.batch_size

@@ -615,7 +615,10 @@ def _dsv3_long_cp() -> RunConfig:
     """The flagship at 65,536-token context via context parallelism: MLA
     rings over the latent stream across a 4-way 'context' axis (flash
     kernel per chunk), MoE routing state psum'd shard-invariant — 4x the
-    single-chip dsv3_long ceiling, 256x the reference's maximum context."""
+    single-chip dsv3_long ceiling, 256x the reference's maximum context.
+    MTP (2 heads) composes: the i+k shift is a ppermute halo from the
+    right neighbor (sharding.cp_halo_right), so long-context CP and the
+    reference's MTP training feature are no longer mutually exclusive."""
     from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3Config
 
     return RunConfig(
@@ -625,6 +628,7 @@ def _dsv3_long_cp() -> RunConfig:
             vocab_size=50257, block_size=65_536, dtype="bfloat16",
             use_flash=True, remat=True, context_parallel=True,
             dropout=0.0, attn_dropout=0.0, pe_scale=0.02, rope_dim=64,
+            mtp_heads=2,
         ),
         train=TrainConfig(
             steps=10_000, batch_size=4, log_every=50, eval_every=500,
@@ -698,6 +702,66 @@ def _vit_mnist() -> RunConfig:
         ),
         data={"kind": "images", "path": None, "side": 28, "n_classes": 10},
         notes="ViT.ipynb; MNIST via local npz path, else synthetic fallback",
+    )
+
+
+@register("vit_bayes")
+def _vit_bayes() -> RunConfig:
+    """vit_mnist on the Bayes-calibrated Gaussian image set
+    (data/synthetic.GaussianImageSource): Bayes-optimal accuracy 0.8703 at
+    snr 2.8 / 10 classes, computed exactly from the generative model — the
+    vision analogue of the Markov corpus's entropy floor. val_accuracy has
+    an absolute ceiling no model beats and a calibrated target a good one
+    approaches; the separable set saturates at 1.0 and can't fail for the
+    interesting reason (VERDICT r3)."""
+    from solvingpapers_tpu.models.vit import ViTConfig
+
+    return RunConfig(
+        name="vit_bayes",
+        model_family="vit",
+        model=ViTConfig(),
+        train=TrainConfig(
+            # weight decay + cosine decay matter here: the Bayes rule is a
+            # matched filter and unregularized nets overfit the per-pixel
+            # noise (measured: wd 0.1 closes the val gap 0.085 -> 0.022 on
+            # the MLP); 32k train samples bound the estimation error
+            steps=2000, batch_size=128, log_every=100, eval_every=500,
+            eval_batches=16,
+            optimizer=OptimizerConfig(
+                name="adamw", max_lr=1e-3, warmup_steps=0, total_steps=2000,
+                min_lr_ratio=0.1, weight_decay=0.1, grad_clip=1.0,
+            ),
+        ),
+        data={"kind": "images", "path": None, "side": 28, "n_classes": 10,
+              "source": "bayes", "snr": 2.8, "n_train": 32768},
+        notes="ViT on the computable-Bayes Gaussian set (ceiling 0.8703)",
+    )
+
+
+@register("kd_bayes")
+def _kd_bayes() -> RunConfig:
+    """kd_mnist on the Bayes-calibrated Gaussian set (see vit_bayes): the
+    distilled student's accuracy is measured against the computable 0.8703
+    Bayes ceiling instead of a saturating 1.0."""
+    from solvingpapers_tpu.models.kd import student_config
+
+    return RunConfig(
+        name="kd_bayes",
+        model_family="kd",
+        model=student_config(),
+        train=TrainConfig(
+            # see vit_bayes: wd + cosine + 32k samples keep the student at
+            # the matched filter instead of the training noise
+            steps=4000, batch_size=64, log_every=200, eval_every=1000,
+            eval_batches=16,
+            optimizer=OptimizerConfig(name="adamw", max_lr=1e-3, warmup_steps=0,
+                                      total_steps=4000, weight_decay=0.1,
+                                      grad_clip=1.0, min_lr_ratio=0.1),
+        ),
+        data={"kind": "images", "path": None, "flatten": True,
+              "teacher_steps": 1200, "temperature": 7.0, "alpha": 0.3,
+              "source": "bayes", "snr": 2.8, "n_train": 32768},
+        notes="KD on the computable-Bayes Gaussian set (ceiling 0.8703)",
     )
 
 

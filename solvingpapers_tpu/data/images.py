@@ -26,8 +26,24 @@ def load_image_dataset(
     side: int = 28,
     n_classes: int = 10,
     seed: int = 0,
+    source: str = "separable",
+    snr: float = 2.8,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Returns (train_x, train_y, test_x, test_y); x is NHWC float32 in [0,1]."""
+    """Returns (train_x, train_y, test_x, test_y); x is NHWC float32.
+
+    source: "separable" = the class-separable grating set ([0,1] pixels,
+    accuracy saturates at 1.0); "bayes" = the Gaussian set with an exactly
+    computable Bayes-optimal accuracy < 1 (synthetic.GaussianImageSource —
+    calibrated targets for the vision stack; pixels are unbounded floats).
+    """
+    if source == "bayes" and path is None:
+        from solvingpapers_tpu.data.synthetic import GaussianImageSource
+
+        src = GaussianImageSource(n_classes=n_classes, side=side, snr=snr,
+                                  seed=seed + 7)
+        train_x, train_y = src.sample(n_train, seed=0)
+        test_x, test_y = src.sample(n_test, seed=1)
+        return train_x, train_y, test_x, test_y
     if path is not None and os.path.exists(path):
         with np.load(path) as z:
             images = z["images"].astype(np.float32)

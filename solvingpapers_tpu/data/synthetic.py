@@ -165,6 +165,74 @@ def markov_text(data_cfg: dict) -> str:
     )
 
 
+class GaussianImageSource:
+    """Class-conditional Gaussian image set with an exactly computable
+    Bayes-optimal accuracy < 1 — the Markov corpus idea (absolute targets
+    for held-out metrics) applied to the vision stack.
+
+    Class c's mean image is ``0.5 + snr * e_c`` with ``{e_c}`` orthonormal
+    2-D DCT patterns; samples add iid N(0, 1) per-pixel noise (pixel values
+    are unbounded floats — clipping would break the Gaussian geometry).
+    With orthonormal means the Bayes rule is the matched filter
+    ``argmax_c <x - 0.5, e_c>`` and, writing z_c = <eps, e_c> ~ iid N(0,1),
+    a class-0 sample classifies correctly iff z_c < z_0 + snr for all c —
+    so the Bayes accuracy reduces to the 1-D integral
+
+        P* = E_z[ Phi(z + snr)^(K-1) ]
+
+    evaluated numerically to machine precision. ``bayes_accuracy`` is an
+    absolute ceiling no model can beat (up to test-set sampling noise) and
+    a calibrated target a good model should approach; the saturating
+    separable set (synthetic_images) can't fail for that reason.
+    """
+
+    def __init__(self, n_classes: int = 10, side: int = 28,
+                 snr: float = 2.8, seed: int = 7):
+        self.n_classes = n_classes
+        self.side = side
+        self.snr = snr
+        self.seed = seed
+        # orthonormal DCT-II product patterns, skipping the DC term so
+        # every mean is zero-sum (brightness carries no label signal)
+        pats = []
+        u = (np.arange(side) + 0.5) / side
+        k = 1
+        while len(pats) < n_classes:
+            p, q = k % (side - 1) + 1, k // (side - 1)
+            pat = np.outer(np.cos(np.pi * q * u), np.cos(np.pi * p * u))
+            pats.append(pat / np.linalg.norm(pat))
+            k += 1
+        self.means = np.stack(pats).astype(np.float64)  # (K, side, side)
+
+    @functools.cached_property
+    def bayes_accuracy(self) -> float:
+        """P* = ∫ phi(z) Phi(z + snr)^(K-1) dz on a fine grid (the tails
+        beyond |z| = 8 contribute < 1e-15)."""
+        from math import erf
+
+        z = np.linspace(-8.0, 8.0, 160_001)
+        phi = np.exp(-0.5 * z**2) / np.sqrt(2 * np.pi)
+        Phi = 0.5 * (1.0 + np.vectorize(erf)((z + self.snr) / np.sqrt(2.0)))
+        trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2
+        return float(trapezoid(phi * Phi ** (self.n_classes - 1), z))
+
+    def sample(self, n: int, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """(images (n, side, side, 1) float32, labels (n,) int32)."""
+        rng = np.random.default_rng((self.seed, seed))
+        labels = rng.integers(0, self.n_classes, size=n).astype(np.int32)
+        eps = rng.standard_normal((n, self.side, self.side))
+        x = 0.5 + self.snr * self.means[labels] + eps
+        return x[..., None].astype(np.float32), labels
+
+    def matched_filter_accuracy(self, images: np.ndarray,
+                                labels: np.ndarray) -> float:
+        """Accuracy of the Bayes rule itself on a finite sample — the
+        empirical check that bayes_accuracy describes this data."""
+        flat = (images[..., 0].astype(np.float64) - 0.5).reshape(len(images), -1)
+        scores = flat @ self.means.reshape(self.n_classes, -1).T
+        return float(np.mean(np.argmax(scores, axis=1) == labels))
+
+
 def synthetic_images(
     n: int = 2048, side: int = 28, n_classes: int = 10, seed: int = 0
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -36,7 +36,9 @@ from flax import linen as nn
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.infer.cache import LatentCache, update_latent_cache
-from solvingpapers_tpu.models.layers import GLUFFN, RMSNorm, LayerNorm, swiglu_hidden_dim, maybe_remat
+from solvingpapers_tpu.models.layers import (
+    GLUFFN, RMSNorm, LayerNorm, maybe_remat, swiglu_hidden_dim,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -502,11 +504,6 @@ class DeepSeekV3(nn.Module):
         return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V))."""
         cfg = self.cfg
         b, s = tokens.shape
-        if cfg.context_parallel and return_mtp and cfg.mtp_heads > 0:
-            raise NotImplementedError(
-                "MTP under context parallelism: the i+k target shift "
-                "crosses shard boundaries; train MTP on a non-CP config"
-            )
         if positions is None:
             from solvingpapers_tpu.models.layers import default_positions
 
@@ -554,8 +551,18 @@ class DeepSeekV3(nn.Module):
         h_prev = x
         for k in range(1, cfg.mtp_heads + 1):
             # embedding of token at position i+k (zero-padded past the end;
-            # the loss masks those targets out)
-            shifted = jnp.pad(tokens[:, k:], ((0, 0), (0, k)))
+            # the loss masks those targets out). Under CP the shift crosses
+            # shard boundaries: a k-token halo from the right neighbor
+            # (ppermute) makes it local — same global stream, shard-local
+            # view (sharding.cp_halo_right)
+            if cfg.context_parallel:
+                from solvingpapers_tpu.sharding import cp_halo_right
+
+                shifted = jnp.concatenate(
+                    [tokens[:, k:], cp_halo_right(tokens, k, fill=0)], axis=1
+                )
+            else:
+                shifted = jnp.pad(tokens[:, k:], ((0, 0), (0, k)))
             emb_k = embed(shifted)
             merged = jnp.concatenate(
                 [

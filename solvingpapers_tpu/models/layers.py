@@ -195,16 +195,21 @@ class Attention(nn.Module):
             from solvingpapers_tpu.kernels.flash_attention import is_tpu_backend
 
             drop_active = self.dropout > 0.0 and not deterministic
-            if drop_active and not (
-                self.use_flash and self.context_impl == "ring"
-                and is_tpu_backend()
+            if drop_active and self.context_impl == "ring" and not (
+                self.use_flash and is_tpu_backend()
             ):
                 raise NotImplementedError(
-                    "attention-prob dropout under context parallelism "
-                    "requires the ring-flash path on real TPU (in-kernel "
-                    "masks salted per (owner, chunk) — "
-                    "sharding/ring_attention._chunk_seed); set dropout=0.0 "
-                    "or use_flash=True with context_impl='ring'"
+                    "attention-prob dropout under ring context parallelism "
+                    "requires the flash path on real TPU (in-kernel masks "
+                    "salted per (owner, chunk) — "
+                    "sharding/ring_attention._chunk_seed); set dropout=0.0, "
+                    "use_flash=True, or context_impl='ulysses'"
+                )
+            if drop_active and self.context_impl == "ulysses" \
+                    and self.use_flash and not is_tpu_backend():
+                raise NotImplementedError(
+                    "in-kernel dropout needs the hardware PRNG: off-TPU "
+                    "Ulysses dropout runs the dense core (use_flash=False)"
                 )
             if self.context_impl == "ring":
                 # GQA kv heads stay un-repeated: the ring repeats them after
@@ -233,15 +238,38 @@ class Attention(nn.Module):
                         q, k, v, self.context_axis, causal=self.causal
                     )
             elif self.context_impl == "ulysses":
+                # dropout: after the all_to_all each member computes FULL
+                # attention for its own head group, so every (head, block)
+                # mask is produced by exactly one member — the engine's
+                # per-('context') rng fold already decorrelates members,
+                # and the cores decorrelate heads internally (the kernel's
+                # per-(bn, block) uid salt / the dense mask shape)
                 if self.use_flash:
                     from solvingpapers_tpu.kernels import flash_attention
 
+                    kwargs = {}
+                    if drop_active:
+                        kwargs = dict(
+                            dropout_rate=self.dropout,
+                            dropout_seed=jax.random.randint(
+                                self.make_rng("dropout"), (), 0,
+                                jnp.iinfo(jnp.int32).max,
+                            ),
+                        )
                     core = functools.partial(
-                        flash_attention, causal=self.causal
+                        flash_attention, causal=self.causal, **kwargs
                     )
                 else:
+                    kwargs = {}
+                    if drop_active:
+                        kwargs = dict(
+                            dropout_rate=self.dropout,
+                            dropout_rng=self.make_rng("dropout"),
+                            deterministic=False,
+                        )
                     core = functools.partial(
-                        ops.dot_product_attention, causal=self.causal
+                        ops.dot_product_attention, causal=self.causal,
+                        **kwargs,
                     )
                 out = ulysses_attention_local(q, k, v, self.context_axis, core)
             else:

@@ -164,12 +164,19 @@ def mtp_loss(
     tokens: jax.Array,
     num_heads: int,
     ignore_index: int | None = None,
+    axis_names: tuple | None = None,
 ) -> jax.Array:
     """Multi-token-prediction loss (deepseekv3/deepseekv3.ipynb cell 46).
 
     logits: (B, T, K, V) where head k at position i predicts token i+k+1.
     tokens: (B, T + K) raw token stream providing the shifted targets.
     Flat mean CE over all (position, head) pairs with valid targets.
+
+    axis_names: inside shard_map (context parallelism, T = local shard),
+    psum the masked nll SUM and the valid COUNT across the axes before
+    dividing — shards hold different valid counts (only the last shard
+    loses the k tail targets), so a pmean of local means would weight the
+    tail shard's targets differently from the dense computation.
     """
     b, t, k, v = logits.shape
     assert k == num_heads
@@ -181,4 +188,21 @@ def mtp_loss(
     # targets[b, i, k] = tokens[b, i + k + 1]
     idx = jnp.arange(t)[:, None] + jnp.arange(1, k + 1)[None, :]
     targets = tokens[:, idx]  # (B, T, K)
-    return cross_entropy(logits.reshape(b * t * k, v), targets.reshape(-1), ignore_index)
+    if axis_names is None:
+        return cross_entropy(
+            logits.reshape(b * t * k, v), targets.reshape(-1), ignore_index
+        )
+    log_probs = jax.nn.log_softmax(
+        logits.reshape(b * t * k, v).astype(jnp.float32), axis=-1
+    )
+    flat = targets.reshape(-1)
+    valid = (
+        flat != ignore_index if ignore_index is not None
+        else jnp.ones_like(flat, jnp.bool_)
+    )
+    safe = jnp.where(valid, flat, 0)
+    nll = -jnp.take_along_axis(log_probs, safe[:, None], axis=-1)[:, 0]
+    mask = valid.astype(jnp.float32)
+    s = jax.lax.psum(jnp.sum(nll * mask), axis_names)
+    c = jax.lax.psum(jnp.sum(mask), axis_names)
+    return s / jnp.maximum(c, 1.0)

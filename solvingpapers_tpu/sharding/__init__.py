@@ -26,6 +26,7 @@ from solvingpapers_tpu.sharding.rules import (
     param_shardings,
 )
 from solvingpapers_tpu.sharding.ring_attention import (
+    cp_halo_right,
     ring_attention,
     ring_attention_local,
     ulysses_attention,

@@ -461,3 +461,29 @@ def ulysses_attention(
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
+
+
+def cp_halo_right(
+    x: jax.Array,
+    k: int,
+    axis_name: str = "context",
+    fill=0,
+):
+    """The first k sequence columns (dim 1) of the RIGHT neighbor's shard —
+    a k-token halo exchange over the context axis via one ppermute. The
+    last shard, whose halo would wrap around to shard 0, gets `fill`
+    instead (the global sequence ends there).
+
+    This is the collective that makes MTP's i+k target shift
+    (deepseekv3.ipynb cell 46) local under context parallelism: shard-local
+    `concat([x[:, k:], cp_halo_right(x, k)], 1)` equals the global
+    left-shift-by-k of the full sequence, zero/fill-padded at the end.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    head = jax.lax.slice_in_dim(x, 0, k, axis=1)
+    # source i delivers to dest i-1: every shard receives its RIGHT
+    # neighbor's head
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    halo = jax.lax.ppermute(head, axis_name, perm)
+    return jnp.where(idx == n - 1, jnp.full_like(halo, fill), halo)

@@ -32,8 +32,15 @@ LM_RULES: list[tuple[str, P]] = [
     (r"(qkv|q|kv|gate|up|fc|w_dkv|w_q)/kernel$", P("fsdp", "model")),
     (r"(out|down|proj|w_o)/kernel$", P("model", "fsdp")),
     (r"lm_head/kernel$", P("fsdp", "model")),
-    (r"(tok_emb|embedding)/embedding$", P(None, "fsdp")),
-    (r"pos_emb$", P(None, "fsdp")),
+    # vocab-dim ZeRO for embedding tables: feature-dim sharding propagates
+    # a feature-sharded residual stream out of the lookup, which collides
+    # with the batch sharding downstream and trips GSPMD's involuntary
+    # full-rematerialization fallback (spmd_partitioner.cc:652) on the
+    # lookup gather and its scatter transpose. Vocab-dim sharding keeps the
+    # same 1/fsdp storage while the gather output is born unsharded on
+    # features (partitioner masks + psums over the vocab shards).
+    (r"(tok_emb|embedding)/embedding$", P("fsdp", None)),
+    (r"pos_emb$", P("fsdp", None)),
     (r".*", P()),  # norms, biases, scalars: replicated
 ]
 

@@ -101,6 +101,17 @@ class TrainConfig:
     # mesh 'pipe' axis and the loss runs inside shard_map with the GPipe
     # microbatch schedule. Composes with the data axis; use rules=PP_RULES.
     pipeline_parallel: bool = False
+    # memory-bounded PP training: split the batch into `pp_grad_groups`
+    # groups and run loss+backward PER GROUP in a lax.scan, accumulating
+    # gradients — each group is one pipeline flush, so the backward's live
+    # residuals cover ONE group's schedule ticks instead of the whole
+    # batch's. With the model's n_microbatches set to the pipe size, live
+    # activation memory scales with n_stages rather than the total
+    # microbatch count (GPipe's weakness at depth); the price is one
+    # fill+drain bubble per group. Gradients equal the single-flush step
+    # up to fp reassociation (tests/test_pipeline_model.py pins this);
+    # model_state (MoE routing bias) threads through groups sequentially.
+    pp_grad_groups: int = 1
 
 
 def lm_loss_fn(model, params, batch, rng, model_state, train):
@@ -494,18 +505,83 @@ class Trainer:
                 with ambient_mesh(self.mesh):
                     return self.loss_fn(self.model, params, batch, rng, ms, train)
 
+        pp_groups = (
+            self.config.pp_grad_groups if self.config.pipeline_parallel else 1
+        )
+
+        def grouped_value_and_grad(state, batch, step_rng):
+            """Scan loss+backward over pp_grad_groups batch groups,
+            accumulating grads — one pipeline flush per group, so the
+            backward holds one group's residuals at a time (see
+            TrainConfig.pp_grad_groups)."""
+            bsz = jax.tree.leaves(batch)[0].shape[0]
+            if bsz % pp_groups:
+                raise ValueError(
+                    f"batch {bsz} not divisible by pp_grad_groups {pp_groups}"
+                )
+            gbatch = jax.tree.map(
+                lambda a: a.reshape(pp_groups, a.shape[0] // pp_groups,
+                                    *a.shape[1:]),
+                batch,
+            )
+
+            def body(carry, inp):
+                ms, acc_loss, acc_aux, acc_g = carry
+                gidx, grp = inp
+
+                def loss_wrap(params):
+                    loss, aux, new_ms = loss_call(
+                        params, ms,
+                        grp, jax.random.fold_in(step_rng, gidx), True,
+                    )
+                    return loss, (aux, new_ms)
+
+                (l, (aux, new_ms)), g = jax.value_and_grad(
+                    loss_wrap, has_aux=True
+                )(state.params)
+                acc_g = jax.tree.map(lambda a, b: a + b / pp_groups, acc_g, g)
+                acc_aux = jax.tree.map(
+                    lambda a, b: a + b / pp_groups, acc_aux, aux
+                )
+                return (new_ms, acc_loss + l / pp_groups, acc_aux, acc_g), None
+
+            aux_shape = jax.eval_shape(
+                lambda p: loss_call(p, state.model_state,
+                                    jax.tree.map(lambda a: a[0], gbatch),
+                                    step_rng, True)[1],
+                state.params,
+            )
+            carry0 = (
+                state.model_state,
+                jnp.zeros(()),
+                jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shape),
+                jax.tree.map(jnp.zeros_like, state.params),
+            )
+            (new_ms, loss, aux, grads), _ = jax.lax.scan(
+                body, carry0, (jnp.arange(pp_groups), gbatch)
+            )
+            if "perplexity" in aux:
+                # exp of the mean loss, not the mean of per-group exps
+                aux = dict(aux, perplexity=jnp.exp(loss))
+            return loss, aux, new_ms, grads
+
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
-            def loss_wrap(params):
-                loss, aux, new_ms = loss_call(
-                    params, state.model_state, batch, step_rng, True
+            if pp_groups > 1:
+                loss, aux, new_ms, grads = grouped_value_and_grad(
+                    state, batch, step_rng
                 )
-                return loss, (aux, new_ms)
+            else:
+                def loss_wrap(params):
+                    loss, aux, new_ms = loss_call(
+                        params, state.model_state, batch, step_rng, True
+                    )
+                    return loss, (aux, new_ms)
 
-            (loss, (aux, new_ms)), grads = jax.value_and_grad(
-                loss_wrap, has_aux=True
-            )(state.params)
+                (loss, (aux, new_ms)), grads = jax.value_and_grad(
+                    loss_wrap, has_aux=True
+                )(state.params)
             grad_norm = optax.global_norm(grads)
             new_state = state.apply_gradients(grads, new_ms)
             metrics = {
@@ -640,6 +716,7 @@ class Trainer:
                     )
         profile_stopped = False
         tail_warmed = False
+        excluded_steps = 0  # steps whose wall time was excluded since last log
         try:
             step = start_step
             while step < cfg.steps:
@@ -687,6 +764,10 @@ class Trainer:
                     if exclude_compile:
                         jax.device_get(metrics["train_loss"])
                         t_prev += time.perf_counter() - t_tail
+                        # the step's time is excluded, so drop it from the
+                        # next log row's denominator too (else step_time /
+                        # tokens_per_sec overstate by the excluded step)
+                        excluded_steps += 1
                     tail_warmed = True
                 else:
                     window = []
@@ -751,9 +832,12 @@ class Trainer:
                         pass
                     else:
                         now = time.perf_counter()
-                        dt = (now - t_prev) / max(end - last_log_step, 1)
+                        dt = (now - t_prev) / max(
+                            end - last_log_step - excluded_steps, 1
+                        )
                         t_prev = now
                         last_log_step = end
+                        excluded_steps = 0
                         metrics["step_time_s"] = dt
                         if cfg.tokens_per_step:
                             metrics["tokens_per_sec"] = cfg.tokens_per_step / dt

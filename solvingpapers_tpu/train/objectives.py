@@ -131,15 +131,31 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
         loss = loss + cfg.balance_loss_weight * bal
     if mtp_logits is not None:
         # mtp_loss wants the stream shifted so head j's target is token
-        # i+(j+1)+1; y already holds tokens 1..T, pad the unknown tail
+        # i+(j+1)+1; y already holds tokens 1..T, pad the unknown tail.
+        # Under CP the tail of a shard is the HEAD of the right neighbor:
+        # a k-token halo (ppermute) replaces the pad except on the last
+        # shard, and the loss psums sum/count over 'context' so the global
+        # mean matches the dense computation exactly.
         k = cfg.mtp_heads
-        pad = jnp.full((batch["y"].shape[0], k), -1, batch["y"].dtype)
-        mtp = ops.mtp_loss(
-            mtp_logits, jnp.concatenate([batch["y"], pad], axis=1), k,
-            ignore_index=-1,
-        )
+        if getattr(cfg, "context_parallel", False):
+            from solvingpapers_tpu.sharding import cp_halo_right
+
+            stream = jnp.concatenate(
+                [batch["y"], cp_halo_right(batch["y"], k, fill=-1)], axis=1
+            )
+            mtp = ops.mtp_loss(mtp_logits, stream, k, ignore_index=-1,
+                               axis_names=("context",))
+        else:
+            pad = jnp.full((batch["y"].shape[0], k), -1, batch["y"].dtype)
+            mtp = ops.mtp_loss(
+                mtp_logits, jnp.concatenate([batch["y"], pad], axis=1), k,
+                ignore_index=-1,
+            )
         aux["mtp_loss"] = mtp
-        loss = main + cfg.mtp_loss_weight * mtp
+        # add to the accumulated loss (main + any balance term), not to
+        # `main` — overwriting silently dropped the balance loss whenever
+        # MTP was on
+        loss = loss + cfg.mtp_loss_weight * mtp
     return loss, aux, new_ms
 
 

@@ -13,6 +13,9 @@ import pytest
 from solvingpapers_tpu.data.bpe import ByteBPETokenizer, bytes_to_unicode
 from solvingpapers_tpu.data.synthetic import synthetic_text
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 
 def test_bytes_to_unicode_bijective():
     m = bytes_to_unicode()

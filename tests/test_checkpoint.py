@@ -34,6 +34,9 @@ def make_trainer(steps, ckdir=None, ckpt_every=0, total_steps=4, async_ckpt=True
 
 import pytest  # noqa: E402
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 
 @pytest.mark.parametrize("async_ckpt", [True, False], ids=["async", "sync"])
 def test_resume_matches_uninterrupted(tmp_path, async_ckpt):

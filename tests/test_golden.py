@@ -10,6 +10,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 GOLDEN = {
     "gpt": [-0.113971, -0.417388, 1.489783, -0.145843],
     "llama3": [1.271275, 0.720245, 1.602395, -0.731151],

@@ -10,6 +10,10 @@ demonstrates with real-data val losses (gpt-jax.ipynb cell 18).
 import numpy as np
 
 from solvingpapers_tpu.data.synthetic import MarkovSource, markov_entropy_nats
+import pytest
+
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
 
 
 def test_uniform_chain_entropy_is_log_vocab():

@@ -7,6 +7,9 @@ import warnings
 import jax.numpy as jnp
 import pytest
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 # the package re-exports an `mfu` *function*; grab the module itself
 mfu_mod = importlib.import_module("solvingpapers_tpu.metrics.mfu")
 

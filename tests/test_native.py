@@ -9,9 +9,13 @@ from solvingpapers_tpu import native
 from solvingpapers_tpu.data.bpe import ByteBPETokenizer
 from solvingpapers_tpu.data.synthetic import synthetic_text
 
-pytestmark = pytest.mark.skipif(
-    not native.available(), reason=f"native lib unavailable: {native.load_error()}"
-)
+pytestmark = [
+    pytest.mark.skipif(
+        not native.available(),
+        reason=f"native lib unavailable: {native.load_error()}",
+    ),
+    pytest.mark.fast,
+]
 
 
 def _python_only_tokenizer(tok: ByteBPETokenizer) -> ByteBPETokenizer:

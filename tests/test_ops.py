@@ -11,6 +11,9 @@ import pytest
 
 from solvingpapers_tpu import ops
 
+# sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
+pytestmark = pytest.mark.fast
+
 
 def test_rms_norm_matches_numpy():
     x = np.random.default_rng(0).normal(size=(4, 7, 16)).astype(np.float32)

@@ -166,3 +166,57 @@ def test_sharded_vit_matches_single_device(devices):
     single = run(MeshConfig(data=1, fsdp=1, model=1), devices[:1])
     sharded = run(MeshConfig(data=4, fsdp=2, model=1), devices)
     np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-5)
+
+
+def test_gaussian_source_bayes_accuracy_is_exact():
+    """The computable ceiling (VERDICT r3 missing #5's calibrated vision
+    benchmark): the 1-D integral matches the K=2 closed form, the class
+    means are orthonormal, and the matched filter's empirical accuracy on
+    a fresh sample lands on the integral (so the ceiling describes the
+    actual data, not an idealization)."""
+    from math import erf, sqrt
+
+    from solvingpapers_tpu.data.synthetic import GaussianImageSource
+
+    two = GaussianImageSource(n_classes=2, snr=1.7)
+    closed = 0.5 * (1 + erf(1.7 / 2.0))  # Phi(snr/sqrt(2))
+    np.testing.assert_allclose(two.bayes_accuracy, closed, atol=1e-6)
+
+    src = GaussianImageSource()
+    m = src.means.reshape(src.n_classes, -1)
+    np.testing.assert_allclose(m @ m.T, np.eye(src.n_classes), atol=1e-12)
+    x, y = src.sample(20_000, seed=3)
+    emp = src.matched_filter_accuracy(x, y)
+    assert abs(emp - src.bayes_accuracy) < 0.01, (emp, src.bayes_accuracy)
+    assert 0.8 < src.bayes_accuracy < 0.95  # genuinely non-saturating
+
+
+def test_bayes_set_classifier_approaches_ceiling_not_one():
+    """A small classifier on the Bayes set must climb toward the ceiling
+    and CANNOT reach 1.0 — the property the separable set lacks. Short
+    schedule on the MLP (the fastest learner of the matched filter);
+    within 0.12 of the ceiling is enough to show calibrated learning (the
+    parity suite runs the full schedules against the 0.05 absolute
+    target)."""
+    import dataclasses
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_image_run
+    from solvingpapers_tpu.data.synthetic import GaussianImageSource
+    from solvingpapers_tpu.models.kd import MLPClassifier, teacher_config
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+    from solvingpapers_tpu.train import Trainer
+
+    cfg = get_config("kd_bayes", steps=600)
+    cfg = dataclasses.replace(cfg, data={**cfg.data, "n_train": 16384})
+    mesh = create_mesh(MeshConfig(data=1), jax.devices()[:1])
+    _, train_iter, eval_iter_fn, cls_loss = build_image_run(cfg, mesh=mesh)
+    tcfg = dataclasses.replace(cfg.train, steps=600, eval_every=0)
+    trainer = Trainer(MLPClassifier(teacher_config(dtype=cfg.model.dtype)),
+                      tcfg, loss_fn=cls_loss, mesh=mesh)
+    state = trainer.fit(train_iter)
+    val = trainer.evaluate(state, eval_iter_fn())
+    acc = float(val["val_accuracy"])
+    ceiling = GaussianImageSource(snr=2.8, seed=cfg.train.seed + 7).bayes_accuracy
+    assert acc <= ceiling + 0.03, (acc, ceiling)  # can't beat Bayes
+    assert acc > ceiling - 0.12, (acc, ceiling)   # but does approach it

@@ -87,6 +87,59 @@ def _body(n_stages: int, batch: int) -> None:
         print(json.dumps({"warning": "no amortization measured "
                           "(per-tick overhead dominates at this scale)"}))
 
+    _memory_body(n_stages)
+
+
+def _memory_body(n_stages: int) -> None:
+    """Live-memory study (BENCHMARKS.md PP memory table): XLA's compiled
+    memory_analysis for the PP train step — temp_size is the peak live
+    temp-buffer footprint per device, which is where the backward's saved
+    activations land. Compares one full-batch GPipe flush against
+    pp_grad_groups sequential flushes (loss+backward per group, grads
+    accumulated): with n_microbatches = pipe size per flush, residual
+    memory covers one group's ticks instead of the whole batch's —
+    live activations scale with n_stages, not total microbatches."""
+    import jax
+    import numpy as np
+
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipe, GPTPipeConfig
+    from solvingpapers_tpu.sharding import MeshConfig, PP_RULES, create_mesh
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    batch, seq, dim = 64, 512, 256
+    n_micro_total = 16
+    mesh_cfg = MeshConfig(data=1, pipe=n_stages)
+    mesh = create_mesh(mesh_cfg, jax.devices()[:n_stages])
+    x = np.random.default_rng(0).integers(0, 256, size=(batch, seq))
+    b0 = {"x": x.astype(np.int32), "y": np.roll(x, -1, 1).astype(np.int32)}
+
+    for groups in (1, n_micro_total // n_stages):
+        cfg = GPTPipeConfig(
+            vocab_size=256, block_size=seq, dim=dim, n_layers=n_stages * 2,
+            n_heads=4, n_stages=n_stages,
+            n_microbatches=n_micro_total // groups,
+            pipeline_parallel=True, remat=True,
+        )
+        tcfg = TrainConfig(
+            steps=0, batch_size=batch, log_every=10_000, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True, pp_grad_groups=groups,
+            optimizer=OptimizerConfig(max_lr=1e-3, total_steps=10),
+        )
+        trainer = Trainer(GPTPipe(cfg), tcfg, rules=PP_RULES, mesh=mesh)
+        state = trainer.init_state(b0)
+        trainer._build_steps()
+        stats = trainer._train_step.lower(state, b0).compile().memory_analysis()
+        print(json.dumps({
+            "memory_study": {
+                "pp_grad_groups": groups,
+                "n_microbatches_per_flush": n_micro_total // groups,
+                "temp_bytes_per_device": int(stats.temp_size_in_bytes),
+                "temp_mb_per_device":
+                    round(stats.temp_size_in_bytes / 2**20, 1),
+                "argument_mb": round(stats.argument_size_in_bytes / 2**20, 1),
+            }
+        }), flush=True)
+
 
 def main() -> int:
     p = argparse.ArgumentParser()
