@@ -102,6 +102,9 @@ def _gpt_shakespeare() -> RunConfig:
             log_every=50,
             eval_every=100,
             eval_batches=20,
+            # 10 on-device steps per dispatch (lax.scan window): amortizes
+            # host dispatch latency, bit-identical to sequential stepping
+            scan_steps=10,
             optimizer=OptimizerConfig(
                 name="adamw", max_lr=1e-3, warmup_steps=0, total_steps=1000,
                 weight_decay=0.1, grad_clip=1.0,
@@ -311,11 +314,16 @@ def _dsv3_markov() -> RunConfig:
                                rope_dim=32, pe_scale=0.02,
                                n_experts=8, top_experts=2, dropout=0.0,
                                attn_dropout=0.0, dtype="bfloat16"),
-        # 1200 steps: the 3.2M-param model starts memorizing the corpus past
-        # ~2k steps (train loss dips below H); the quality row wants the
-        # generalizing regime
-        train=_markov_train(1200, 64, 256),
-        data=dict(_MARKOV_DATA),
+        train=_markov_train(3000, 64, 256),
+        # capacity-matched corpus: the MoE carries ~5x the dense peers'
+        # params (8 experts x SwiGLU per layer) and memorizes the shared
+        # 4M-char corpus past ~2k steps (r4 measured gap 0.335 at 3000
+        # steps there — the r3 1200-step pin was hiding this). The chain
+        # is an unbounded synthetic source, so the honest fix is more
+        # held-out-equivalent data, not a shorter schedule: at 16M chars
+        # the same 3000-step run generalizes (gap 0.032, load entropy
+        # 0.996, zero drops).
+        data={**_MARKOV_DATA, "n_chars": 16_000_000},
         notes="entropy-calibrated quality row; target val_loss -> H ~= 2.362",
     )
 
@@ -370,6 +378,10 @@ def _gpt_pp() -> RunConfig:
             vocab_size=65, block_size=256, dim=256, n_layers=8, n_heads=4,
             dtype="bfloat16", n_stages=4, n_microbatches=8,
             pipeline_parallel=True,
+            # the reference GPT recipe's dropout (gpt-jax.ipynb cell 8)
+            # trains under the schedule via per-(stage, microbatch, layer)
+            # keys
+            dropout=0.1,
         ),
         train=TrainConfig(
             steps=1000, batch_size=64, log_every=50, eval_every=200,

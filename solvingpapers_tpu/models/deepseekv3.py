@@ -547,6 +547,9 @@ class DeepSeekV3(nn.Module):
             return logits, new_caches
 
         # ---- MTP: vectorized version of cell 33's per-position loop ----
+        # TWIN of DSV3Pipe.apply's functional MTP branch: changes here must
+        # be mirrored there (test_dsv3_pipe_mtp_export_matches_dense_family
+        # pins the equality).
         mtp_logits = []
         h_prev = x
         for k in range(1, cfg.mtp_heads + 1):
@@ -556,11 +559,9 @@ class DeepSeekV3(nn.Module):
             # (ppermute) makes it local — same global stream, shard-local
             # view (sharding.cp_halo_right)
             if cfg.context_parallel:
-                from solvingpapers_tpu.sharding import cp_halo_right
+                from solvingpapers_tpu.sharding import cp_shift_left
 
-                shifted = jnp.concatenate(
-                    [tokens[:, k:], cp_halo_right(tokens, k, fill=0)], axis=1
-                )
+                shifted = cp_shift_left(tokens, k, fill=0)
             else:
                 shifted = jnp.pad(tokens[:, k:], ((0, 0), (0, k)))
             emb_k = embed(shifted)

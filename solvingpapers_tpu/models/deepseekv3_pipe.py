@@ -86,19 +86,19 @@ class DSV3PipeConfig:
     # compose with context parallelism (sequence over 'context'; each
     # stage's MLA rings within its pipe coordinate's context group)
     context_parallel: bool = False
-    mtp_heads: int = 0  # MTP is not staged; kept for init_fn compatibility
+    # MTP (deepseekv3.ipynb cells 33/46) composes with PP: the schedule's
+    # output is psum-broadcast to every pipe device, so the MTP branch
+    # (merge + extra decoder layer + proj per head) runs REPLICATED after
+    # the staged stack, exactly like the final norm/head — its params and
+    # routing bias are plain (unstaged) entries
+    mtp_heads: int = 0
+    mtp_loss_weight: float = 0.3
 
     def __post_init__(self):
         if self.n_layers % self.n_stages:
             raise ValueError(
                 f"n_layers {self.n_layers} not divisible by n_stages "
                 f"{self.n_stages}"
-            )
-        if self.mtp_heads:
-            raise NotImplementedError(
-                "MTP under pipeline parallelism is not supported: the i+k "
-                "shift needs the full hidden stream at the last stage; "
-                "train MTP on the dense family"
             )
 
     @property
@@ -128,6 +128,7 @@ class DSV3PipeConfig:
             aux_free_bias_update_rate=self.aux_free_bias_update_rate,
             moe_impl=self.moe_impl, capacity_factor=self.capacity_factor,
             dropout=self.dropout, attn_dropout=self.attn_dropout,
+            mtp_heads=self.mtp_heads, mtp_loss_weight=self.mtp_loss_weight,
             dtype=self.dtype,
             use_flash=self.use_flash,
             context_parallel=self.context_parallel,
@@ -167,7 +168,29 @@ class DSV3Pipe:
             "stages": stacked["params"],
             "norm_f": RMSNorm().init(k_ln, dummy)["params"],
         }
-        return {"params": params, "moe_state": {"stages": stacked["moe_state"]}}
+        moe_state = {"stages": stacked["moe_state"]}
+        if cfg.mtp_heads > 0:
+            # dense DeepSeekV3's MTP machinery under the dense family's
+            # exact param names, so to_dense export is a plain key copy
+            from solvingpapers_tpu.models.layers import LayerNorm
+
+            k_mtp = jax.random.fold_in(k_blocks, 10_000)
+            lecun = nn.initializers.lecun_normal()
+            for h in range(1, cfg.mtp_heads + 1):
+                kh = jax.random.fold_in(k_mtp, h)
+                k1, k2, k3, k4, k5 = jax.random.split(kh, 5)
+                params[f"mtp_norm_h_{h}"] = LayerNorm().init(k1, dummy)["params"]
+                params[f"mtp_norm_e_{h}"] = LayerNorm().init(k2, dummy)["params"]
+                params[f"mtp_merge_{h}"] = {
+                    "kernel": lecun(k3, (2 * cfg.dim, cfg.dim), jnp.float32)
+                }
+                lv = self._block.init(k4, dummy)
+                params[f"mtp_layer_{h}"] = lv["params"]
+                moe_state[f"mtp_layer_{h}"] = lv["moe_state"]
+                params[f"mtp_proj_{h}"] = {
+                    "kernel": lecun(k5, (cfg.dim, cfg.dim), jnp.float32)
+                }
+        return {"params": params, "moe_state": moe_state}
 
     # ----------------------------------------------------------------- apply
 
@@ -228,10 +251,12 @@ class DSV3Pipe:
                 "decode caches are unsupported under pipeline parallelism; "
                 "to_dense() the params and decode with DeepSeekV3"
             )
-        if return_mtp:
-            raise NotImplementedError("MTP is not staged; use DeepSeekV3")
         cfg = self.cfg
+        use_mtp = return_mtp and cfg.mtp_heads > 0
+        if return_mtp and cfg.mtp_heads == 0:
+            raise ValueError("return_mtp=True but cfg.mtp_heads == 0")
         p = variables["params"]
+        ms_all = variables["moe_state"]
         bias_stack = variables["moe_state"]["stages"]
         b, s = tokens.shape
         if positions is None:
@@ -297,29 +322,81 @@ class DSV3Pipe:
             x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
         x = 2.0 * cfg.n_layers**-0.5 * x  # deepseek depth scaling (cell 31)
         x = RMSNorm().apply({"params": p["norm_f"]}, x)
-        logits = (
-            x.astype(cfg.compute_dtype)
-            @ p["tok_emb"]["embedding"].T.astype(cfg.compute_dtype)
-        )
+        emb = p["tok_emb"]["embedding"]
+        dt = cfg.compute_dtype
+        logits = x.astype(dt) @ emb.T.astype(dt)
 
+        mtp_aux: list = []
+        mtp_logits = None
+        if use_mtp:
+            # replicated MTP branch on the psum-broadcast stream (every
+            # pipe device computes the identical heads, like norm_f/head);
+            # dense DeepSeekV3's cell-33 machinery with the same param
+            # names. Under CP the i+k shift is the cp_shift_left ppermute.
+            # TWIN of DeepSeekV3.__call__'s MTP branch (flax-module form —
+            # the two can't share code across the module/functional
+            # boundary): any change there must be mirrored here;
+            # test_dsv3_pipe_mtp_export_matches_dense_family pins equality.
+            from solvingpapers_tpu.models.layers import LayerNorm
+
+            h_prev = x
+            outs = []
+            for h in range(1, cfg.mtp_heads + 1):
+                if cfg.context_parallel:
+                    from solvingpapers_tpu.sharding import cp_shift_left
+
+                    shifted = cp_shift_left(tokens, h, fill=0)
+                else:
+                    shifted = jnp.pad(tokens[:, h:], ((0, 0), (0, h)))
+                emb_h = jnp.take(emb, shifted, axis=0).astype(dt)
+                nh = LayerNorm().apply({"params": p[f"mtp_norm_h_{h}"]}, h_prev)
+                ne = LayerNorm().apply({"params": p[f"mtp_norm_e_{h}"]}, emb_h)
+                merged = jnp.concatenate([nh, ne], axis=-1).astype(dt)
+                merged = merged @ p[f"mtp_merge_{h}"]["kernel"].astype(dt)
+                key = None
+                if train_drop:
+                    # replicated across pipe (same key on every device)
+                    key = jax.random.fold_in(rngs["dropout"], 20_000 + h)
+                (y, _), mut = self._block.apply(
+                    {"params": p[f"mtp_layer_{h}"],
+                     "moe_state": ms_all[f"mtp_layer_{h}"]},
+                    merged, positions, None, key is None, None,
+                    mutable=["moe_metrics"],
+                    **({} if key is None else {"rngs": {"dropout": key}}),
+                )
+                stats = mut["moe_metrics"]["moe"]["stats"][0]
+                mtp_aux.append(
+                    (f"mtp_layer_{h}",
+                     {k: stats[k] for k in (*_STAT_KEYS, "ci")})
+                )
+                proj = y.astype(dt) @ p[f"mtp_proj_{h}"]["kernel"].astype(dt)
+                outs.append(proj @ emb.T.astype(dt))
+                h_prev = y
+            mtp_logits = jnp.stack(outs, axis=2)
+
+        out = (logits, mtp_logits) if use_mtp else logits
         mutated = {}
         wants = set(mutable if not isinstance(mutable, str) else [mutable])
         if wants:
             mutated = self._mutate(
                 bias_stack,
                 aux if cfg.pipeline_parallel else aux_stages,
-                n_ticks, wants, deterministic,
+                n_ticks, wants, deterministic, ms_all, mtp_aux,
             )
-            return (logits, None), mutated
-        return logits, None
+            return (out, None), mutated
+        return out, None
 
     # --------------------------------------------------------- state updates
 
-    def _mutate(self, bias_stack, aux, n_ticks, wants, deterministic):
+    def _mutate(self, bias_stack, aux, n_ticks, wants, deterministic,
+                ms_all=None, mtp_aux=()):
         """Recombine per-device aux into the shard-invariant moe_state
         update + scalar metrics. Under PP, `aux` holds THIS device's stage
         sums; the update is scattered into a zero stack and psum'd over
-        'pipe'. Under the dense oracle, `aux` is a per-stage list."""
+        'pipe'. Under the dense oracle, `aux` is a per-stage list.
+        `mtp_aux`: [(state key, stats)] for the replicated MTP layers —
+        their biases update in place (no pipe scatter: every device
+        computed the identical global stats)."""
         cfg = self.cfg
         pp = cfg.pipeline_parallel
         mutated: dict = {}
@@ -334,8 +411,19 @@ class DSV3Pipe:
         else:
             ci = jnp.stack([a["ci"] for a in aux])  # (n_stages, lps, E)
 
+        def global_ci(raw):
+            # mtp layers run replicated per device over the local batch
+            # shard; outside shard_map (dense oracle) there is no axis
+            if pp and not cfg.context_parallel:
+                return jax.lax.psum(raw, ("data", "fsdp"))
+            return raw
+
+        mtp_ci = {name: global_ci(a["ci"]) for name, a in mtp_aux}
+
         if "moe_state" in wants:
             new_stack = bias_stack
+            new_state: dict = {}
+            rate = cfg.aux_free_bias_update_rate
             if cfg.use_aux_free and not deterministic:
                 def upd(bias_j, delta_j):
                     # bias_j: (n_stages, E); delta_j: (E,) for own stage
@@ -345,7 +433,6 @@ class DSV3Pipe:
                     )
                     return bias_j + jax.lax.psum(full, "pipe")
 
-                rate = cfg.aux_free_bias_update_rate
                 new_stack = dict(bias_stack)
                 for j in range(cfg.layers_per_stage):
                     key = f"block_{j}"
@@ -364,9 +451,34 @@ class DSV3Pipe:
                             lambda b: b + jnp.stack(deltas).astype(b.dtype),
                             bias_stack[key],
                         )
-            mutated["moe_state"] = {"stages": new_stack}
+                for name, ci_m in mtp_ci.items():
+                    err = jnp.mean(ci_m) - ci_m
+                    delta = rate * jnp.sign(err)
+                    new_state[name] = jax.tree.map(
+                        lambda b: b + delta.astype(b.dtype), ms_all[name]
+                    )
+            # entries not updated this step (eval, or aux-free off) pass
+            # through unchanged so the state tree keeps its structure
+            passthrough = {
+                k: v for k, v in (ms_all or {}).items()
+                if k != "stages" and k not in new_state
+            }
+            mutated["moe_state"] = {"stages": new_stack, **new_state,
+                                    **passthrough}
 
         if "moe_metrics" in wants:
+            n_total = cfg.n_layers + len(mtp_aux)
+
+            def ci_stats(rows):
+                # rows: (..., E) global loads -> summed entropy / max over
+                # the leading dims
+                load = rows / jnp.maximum(
+                    jnp.sum(rows, axis=-1, keepdims=True), 1e-9
+                )
+                ent = -jnp.sum(load * jnp.log(load + 1e-9), axis=-1) \
+                    / jnp.log(float(cfg.n_experts))
+                return jnp.sum(ent), jnp.sum(jnp.max(load, axis=-1))
+
             if pp:
                 # load_entropy/load_max_fraction are recomputed from the
                 # GLOBAL per-layer ci (tick-summed + data-psum'd above) —
@@ -375,27 +487,31 @@ class DSV3Pipe:
                 # on the globally reduced load (advisor r3). drop_fraction
                 # averages exactly (equal-size microbatches share the
                 # denominator); bias_norm is tick-invariant, so its mean
-                # over ticks is the value itself.
-                e = float(cfg.n_experts)
-                load = ci / jnp.maximum(
-                    jnp.sum(ci, axis=-1, keepdims=True), 1e-9
-                )  # (layers_per_stage, E), rows are global loads
-                ent = -jnp.sum(
-                    load * jnp.log(load + 1e-9), axis=-1
-                ) / jnp.log(e)
+                # over ticks is the value itself. MTP layers are replicated
+                # per device — added OUTSIDE the pipe psum (a psum would
+                # count them n_stages times).
+                ent_s, max_s = ci_stats(ci)
+                ent_m = max_m = drop_m = bias_m = 0.0
+                for name, a in mtp_aux:
+                    em, mm = ci_stats(mtp_ci[name])
+                    ent_m += em
+                    max_m += mm
+                    drop_m += a["drop_fraction"]
+                    bias_m += a["bias_norm"]
                 stats = {
                     "load_entropy":
-                        jax.lax.psum(jnp.sum(ent), "pipe") / cfg.n_layers,
+                        (jax.lax.psum(ent_s, "pipe") + ent_m) / n_total,
                     "load_max_fraction":
-                        jax.lax.psum(jnp.sum(jnp.max(load, axis=-1)),
-                                     "pipe") / cfg.n_layers,
+                        (jax.lax.psum(max_s, "pipe") + max_m) / n_total,
                 }
-                for k in ("drop_fraction", "bias_norm"):
+                for k, extra in (("drop_fraction", drop_m),
+                                 ("bias_norm", bias_m)):
                     v = jnp.sum(aux[k]) / n_ticks
-                    stats[k] = jax.lax.psum(v, "pipe") / cfg.n_layers
+                    stats[k] = (jax.lax.psum(v, "pipe") + extra) / n_total
             else:
                 stats = {
-                    k: jnp.mean(jnp.stack([a[k] for a in aux]))
+                    k: (jnp.sum(jnp.stack([a[k] for a in aux]))
+                        + sum(a[k] for _, a in mtp_aux)) / n_total
                     for k in _STAT_KEYS
                 }
             mutated["moe_metrics"] = {"pipeline": {"stats": (stats,)}}
@@ -417,14 +533,18 @@ class DSV3Pipe:
         cfg = self.cfg
         name = lambda i: f"layer_{i}"  # noqa: E731
         dense_params = {
-            "tok_emb": params["tok_emb"],
-            "norm_f": params["norm_f"],
+            # mtp_* entries (stored under the dense family's exact names)
+            # and tok_emb/norm_f copy straight across
+            **{k: v for k, v in params.items() if k != "stages"},
             **restack_to_dense(params["stages"], cfg.n_stages,
                                cfg.layers_per_stage, name),
         }
-        dense_state = restack_to_dense(
-            moe_state["stages"], cfg.n_stages, cfg.layers_per_stage, name
-        )
+        dense_state = {
+            **{k: v for k, v in moe_state.items() if k != "stages"},
+            **restack_to_dense(
+                moe_state["stages"], cfg.n_stages, cfg.layers_per_stage, name
+            ),
+        }
         dense_cfg = dataclasses.replace(
             cfg.layer_cfg(), context_parallel=False
         )

@@ -27,6 +27,7 @@ from solvingpapers_tpu.sharding.rules import (
 )
 from solvingpapers_tpu.sharding.ring_attention import (
     cp_halo_right,
+    cp_shift_left,
     ring_attention,
     ring_attention_local,
     ulysses_attention,

@@ -487,3 +487,20 @@ def cp_halo_right(
     perm = [(i, (i - 1) % n) for i in range(n)]
     halo = jax.lax.ppermute(head, axis_name, perm)
     return jnp.where(idx == n - 1, jnp.full_like(halo, fill), halo)
+
+
+def cp_shift_left(
+    x: jax.Array,
+    k: int,
+    axis_name: str = "context",
+    fill=0,
+) -> jax.Array:
+    """Shard-local view of the GLOBAL left-shift-by-k of the sequence
+    (dim 1): local columns [k:] followed by the right neighbor's first k
+    columns (cp_halo_right), `fill` past the global end. The one shared
+    implementation of MTP's i+k shift under context parallelism — used by
+    the dense family's shifted-embedding stream, the staged family's MTP
+    branch, and the loss's target stream."""
+    return jnp.concatenate(
+        [x[:, k:], cp_halo_right(x, k, axis_name, fill)], axis=1
+    )

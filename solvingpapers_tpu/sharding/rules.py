@@ -7,7 +7,8 @@ by relying on the shared layer naming from models/layers.py:
       (in, out) -> P('fsdp', 'model')   — out features over TP axis
   row-parallel kernels (out / down / proj):
       (in, out) -> P('model', 'fsdp')   — in features over TP axis
-  embeddings: (vocab, dim) -> P(None, 'fsdp')
+  embeddings: (vocab, dim) -> P('fsdp', None)  — vocab-dim ZeRO (feature-dim
+      sharding would propagate into the residual stream; see the table note)
   everything else (norm scales, biases, pos tables): replicated
 
 With mesh sizes fsdp=model=1 every spec degenerates to replication; with

@@ -277,21 +277,24 @@ class Trainer:
             rng_axes=("data", "fsdp", "context"), gather_fsdp=True,
         )
 
-    def _fsdp_param_specs(self):
+    def _fsdp_param_specs(self, axes: tuple = ("fsdp", "expert")):
         """(path, leaf) -> P giving each param's STORED layout restricted
-        to the 'fsdp' and 'expert' axes — derived from the same rule
+        to `axes` (default 'fsdp' + 'expert') — derived from the same rule
         table/mesh as the state shardings, so it needs no init_state
         precondition (evaluate / fit with an external state build steps
-        without one). Both axes' dims are gathered in-step (ZeRO layout at
-        rest). model/pipe are rejected above; their size-1 names in the
-        rule table would otherwise mark values conservatively varying over
-        those axes."""
+        without one). The kept axes' dims are gathered in-step (ZeRO
+        layout at rest). model/pipe are rejected above; their size-1 names
+        in the rule table would otherwise mark values conservatively
+        varying over those axes — the same reason the PP path passes
+        axes=('fsdp',): its mesh rejects 'expert', and an all_gather over
+        the size-1 axis would still type every expert-weight consumer as
+        expert-varying, failing the out_specs P() contract."""
         from solvingpapers_tpu.sharding.rules import leaf_spec
 
         def keep(spec):
             def f(entry):
                 names = entry if isinstance(entry, tuple) else (entry,)
-                kept = tuple(n for n in names if n in ("fsdp", "expert"))
+                kept = tuple(n for n in names if n in axes)
                 if len(kept) > 1:
                     # gather_param reassembles one name at a time, which
                     # would interleave a jointly-sharded dim's chunks in
@@ -346,8 +349,10 @@ class Trainer:
         sharded over 'pipe' (NOT gathered — each device's GPipe body uses
         its own stage), non-stage params carry their stored fsdp/expert
         layout and are all-gathered in-step by gather_param (which only
-        touches fsdp/expert names, leaving 'pipe' dims local)."""
-        fsdp = self._fsdp_param_specs()
+        touches the kept names, leaving 'pipe' dims local). 'expert' is
+        excluded: the PP mesh rejects that axis (size 1), and gathering
+        over it would only poison the vma typing (see _fsdp_param_specs)."""
+        fsdp = self._fsdp_param_specs(axes=("fsdp",))
 
         def spec(path, leaf):
             if path and getattr(path[0], "key", None) == "stages":
@@ -469,10 +474,15 @@ class Trainer:
                     self.model, params, batch, rng, ms, train
                 )
                 loss = pmean(loss)
+                if "perplexity" in aux:
+                    # reduce in log space: exp of the global-mean MAIN CE
+                    # (the loss fn's exp(main)), not the pmean of local
+                    # exps — and not exp(total loss), which would fold MTP
+                    # and balance aux terms into the reported perplexity
+                    aux = dict(aux, perplexity=jnp.log(aux["perplexity"]))
                 aux = jax.tree.map(pmean, aux)
                 if "perplexity" in aux:
-                    # exp of the global mean, not the pmean of local exps
-                    aux["perplexity"] = jnp.exp(loss)
+                    aux = dict(aux, perplexity=jnp.exp(aux["perplexity"]))
                 return loss, aux, new_ms
 
             # model_state (e.g. the MoE routing bias) enters replicated and
@@ -539,6 +549,12 @@ class Trainer:
                 (l, (aux, new_ms)), g = jax.value_and_grad(
                     loss_wrap, has_aux=True
                 )(state.params)
+                if "perplexity" in aux:
+                    # accumulate mean MAIN-CE (log of per-group ppl), not
+                    # mean-of-exps — exponentiated back after the scan.
+                    # exp(total loss) would be wrong for objectives whose
+                    # total carries aux terms (MTP, balance)
+                    aux = dict(aux, perplexity=jnp.log(aux["perplexity"]))
                 acc_g = jax.tree.map(lambda a, b: a + b / pp_groups, acc_g, g)
                 acc_aux = jax.tree.map(
                     lambda a, b: a + b / pp_groups, acc_aux, aux
@@ -561,8 +577,8 @@ class Trainer:
                 body, carry0, (jnp.arange(pp_groups), gbatch)
             )
             if "perplexity" in aux:
-                # exp of the mean loss, not the mean of per-group exps
-                aux = dict(aux, perplexity=jnp.exp(loss))
+                # exp of the accumulated mean main-CE (see body)
+                aux = dict(aux, perplexity=jnp.exp(aux["perplexity"]))
             return loss, aux, new_ms, grads
 
         def train_step(state: TrainState, batch: dict):

@@ -140,6 +140,8 @@ def dsv3_loss_fn(model, params, batch, rng, model_state, train):
         if getattr(cfg, "context_parallel", False):
             from solvingpapers_tpu.sharding import cp_halo_right
 
+            # append (not shift): mtp_loss wants the local T columns PLUS
+            # the k halo columns as the target stream
             stream = jnp.concatenate(
                 [batch["y"], cp_halo_right(batch["y"], k, fill=-1)], axis=1
             )

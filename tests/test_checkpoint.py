@@ -64,6 +64,54 @@ def test_resume_matches_uninterrupted(tmp_path, async_ckpt):
     assert int(resumed.step) == 4
 
 
+def test_resume_realigns_scan_windows(tmp_path):
+    """A checkpoint resume can land mid scan-window (TrainConfig.scan_steps):
+    fit must single-step back to alignment, keep window ends on multiples
+    of scan_steps, and track the uninterrupted run (scan-compiled and
+    single-step programs fuse differently, so the two runs' different
+    window mixes diverge at float-epsilon level — same tolerance as the
+    sharded-equality tests)."""
+    import dataclasses
+
+    _, toks, _ = load_char_corpus(synthetic_chars=5_000)
+    it_fn = lambda: lm_batch_iterator(toks, 4, TINY.block_size, seed=0)  # noqa: E731
+
+    def scanify(t, steps):
+        # sgd, not adam: the two runs mix scan-compiled and single-step
+        # programs at different steps, and adam's normalizer amplifies the
+        # resulting float-epsilon differences into lr-scale sign flips
+        # (same reasoning as the PP equality tests)
+        t.config = dataclasses.replace(
+            t.config, scan_steps=4, steps=steps, log_every=1000,
+            optimizer=OptimizerConfig(name="sgd", max_lr=1e-2,
+                                      warmup_steps=0, total_steps=16),
+        )
+        t.tx, t.schedule = __import__(
+            "solvingpapers_tpu.train.engine", fromlist=["make_optimizer"]
+        ).make_optimizer(t.config.optimizer)
+        return t
+
+    straight = scanify(make_trainer(14, total_steps=16), 14).fit(it_fn())
+
+    ckdir = str(tmp_path / "ck")
+    # stop at 6 (not a multiple of 4; the forced final save records it):
+    # the resume starts mid-window, must single-step to re-align, then run
+    # the 8-12 window and the ragged 12-14 tail
+    scanify(make_trainer(6, ckdir, ckpt_every=4, total_steps=16), 6).fit(it_fn())
+    it = it_fn()
+    for _ in range(6):
+        next(it)
+    resumed = scanify(
+        make_trainer(14, ckdir, ckpt_every=100, total_steps=16), 14
+    ).fit(it)
+
+    for a, b in zip(jax.tree.leaves(straight.params),
+                    jax.tree.leaves(resumed.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+    assert int(resumed.step) == 14
+
+
 def test_async_save_overlaps_and_is_durable(tmp_path):
     """An async periodic save must return before the write is durable (the
     step loop keeps running) yet be fully restorable after close(). The

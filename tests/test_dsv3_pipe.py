@@ -179,7 +179,7 @@ def test_dsv3_pipe_export_decodes():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_dsv3_pipe_rejects_caches_and_mtp():
+def test_dsv3_pipe_rejects_caches_and_headless_mtp():
     cfg = DSV3PipeConfig(vocab_size=64, block_size=32, dim=32, n_layers=2,
                          n_heads=2, latent_dim=8, n_experts=2, top_experts=1,
                          n_stages=2)
@@ -188,10 +188,63 @@ def test_dsv3_pipe_rejects_caches_and_mtp():
     variables = model.init({"params": jax.random.key(0)}, toks)
     with pytest.raises(NotImplementedError, match="decode caches"):
         model.apply(variables, toks, caches=[])
-    with pytest.raises(NotImplementedError, match="MTP"):
+    with pytest.raises(ValueError, match="mtp_heads"):
         model.apply(variables, toks, return_mtp=True)
-    with pytest.raises(NotImplementedError, match="MTP"):
-        DSV3PipeConfig(n_layers=2, n_stages=2, mtp_heads=1)
+
+
+def test_dsv3_pp_mtp_trainer_matches_dense(devices):
+    """MTP under pipeline parallelism: the schedule's output is
+    psum-broadcast, so the MTP heads run replicated after the staged stack
+    — the PP step (loss, params, routing state incl. the MTP layer's own
+    bias) must equal the dense-oracle step."""
+    batch = _batch(jax.random.key(5))
+    mesh_cfg = MeshConfig(data=2, pipe=2)
+
+    d_model, d_train = _cfgs(False, MeshConfig(data=1), mtp_heads=1)
+    d_state, d_metrics = _run(
+        d_model, d_train, MeshConfig(data=1), devices[:1], batch
+    )
+
+    p_model, p_train = _cfgs(True, mesh_cfg, mtp_heads=1)
+    p_state, p_metrics = _run(p_model, p_train, mesh_cfg, devices[:4], batch)
+
+    for key in ("train_loss", "train_mtp_loss"):
+        np.testing.assert_allclose(
+            float(jax.device_get(p_metrics[key])),
+            float(jax.device_get(d_metrics[key])), rtol=2e-5,
+        )
+    for a, b in zip(jax.tree.leaves(jax.device_get(p_state.model_state)),
+                    jax.tree.leaves(jax.device_get(d_state.model_state))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(jax.device_get(p_state.params)),
+                    jax.tree.leaves(jax.device_get(d_state.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_dsv3_pipe_mtp_export_matches_dense_family():
+    """to_dense with MTP heads: the restacked params/state under the dense
+    family must reproduce the staged dense-oracle's (logits, mtp_logits)."""
+    cfg = DSV3PipeConfig(vocab_size=64, block_size=32, dim=32, n_layers=4,
+                         n_heads=4, latent_dim=8, rope_dim=8, n_experts=4,
+                         top_experts=2, n_stages=2, mtp_heads=2)
+    model = DSV3Pipe(cfg)
+    toks = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)
+    variables = model.init({"params": jax.random.key(1)}, toks)
+    (logits, mtp_logits), _ = model.apply(variables, toks, return_mtp=True)
+
+    dense, dparams, dstate = model.to_dense(
+        variables["params"], variables["moe_state"]
+    )
+    (ref, ref_mtp), _ = dense.apply(
+        {"params": dparams, "moe_state": dstate}, toks,
+        deterministic=True, return_mtp=True,
+    )
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mtp_logits), np.asarray(ref_mtp),
+                               rtol=2e-5, atol=2e-5)
 
 
 # ----------------------------------------------------------- llama3 staging

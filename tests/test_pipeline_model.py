@@ -166,6 +166,41 @@ def test_pp_dropout_remat_grads_match(devices):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_interleaved_dropout_deterministic_and_active(devices):
+    """Dropout under the interleaved (virtual-stage) schedule: the tick
+    folds (global stage = j*P + d, microbatch) into the key, so repeated
+    steps are bit-identical and masks are applied."""
+    batch = _batch(jax.random.key(7))
+    mesh_cfg = MeshConfig(data=2, pipe=2)
+
+    def run():
+        model = GPTPipeConfig(
+            vocab_size=64, block_size=32, dim=32, n_layers=4, n_heads=2,
+            n_stages=4, virtual_stages=2, n_microbatches=4,
+            pipeline_parallel=True, dropout=0.3,
+        )
+        train = TrainConfig(
+            steps=2, batch_size=8, log_every=1, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True, seed=3,
+            optimizer=OptimizerConfig(name="sgd", max_lr=1e-1, warmup_steps=0,
+                                      total_steps=4, grad_clip=1.0),
+        )
+        t = Trainer(GPTPipe(model), train, rules=PP_RULES,
+                    mesh=create_mesh(mesh_cfg, devices[:4]))
+        state = t.init_state(batch)
+        t._build_steps()
+        state, metrics = t._train_step(state, batch)
+        val = t._eval_step(state, batch)
+        return (float(jax.device_get(metrics["train_loss"])),
+                float(jax.device_get(val["val_loss"])))
+
+    l1, v1 = run()
+    l2, v2 = run()
+    assert (l1, v1) == (l2, v2)
+    assert np.isfinite(l1)
+    assert abs(v1 - l1) > 1e-3  # masks applied
+
+
 def test_pp_dropout_units_decorrelated():
     """With every microbatch given IDENTICAL content, per-(stage,
     microbatch) keys must still produce different masks — logits differ
