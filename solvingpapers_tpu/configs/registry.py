@@ -412,6 +412,7 @@ def _gpt_pp_smoke() -> RunConfig:
             vocab_size=256, block_size=64, dim=32, n_layers=4, n_heads=2,
             dtype="float32", n_stages=4, n_microbatches=4,
             pipeline_parallel=True,
+            dropout=0.1,  # smoke the schedule-keyed dropout path too
         ),
         train=TrainConfig(
             steps=20, batch_size=8, log_every=5, eval_every=10,
@@ -483,6 +484,8 @@ def _dsv3_pp_smoke() -> RunConfig:
             latent_dim=8, rope_dim=8, pe_scale=0.02, n_experts=4,
             top_experts=2, n_stages=2, n_microbatches=2,
             pipeline_parallel=True,
+            # smoke the r4 paths: schedule-keyed dropout + replicated MTP
+            dropout=0.1, attn_dropout=0.1, mtp_heads=1,
         ),
         train=TrainConfig(
             steps=20, batch_size=8, log_every=5, eval_every=10,

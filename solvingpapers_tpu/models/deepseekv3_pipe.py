@@ -452,10 +452,14 @@ class DSV3Pipe:
                             bias_stack[key],
                         )
                 for name, ci_m in mtp_ci.items():
-                    err = jnp.mean(ci_m) - ci_m
-                    delta = rate * jnp.sign(err)
+                    # the canonical update rule (cell 23), from the
+                    # already-psum'd load — no pipe scatter needed
+                    # (replicated compute)
                     new_state[name] = jax.tree.map(
-                        lambda b: b + delta.astype(b.dtype), ms_all[name]
+                        lambda b, c=ci_m: ops.moe.aux_free_bias_update(
+                            None, b, rate, ci=c
+                        ),
+                        ms_all[name],
                     )
             # entries not updated this step (eval, or aux-free off) pass
             # through unchanged so the state tree keeps its structure

@@ -76,6 +76,16 @@ def _chunked_cross_entropy(
 ) -> jax.Array:
     """Scan over row chunks; each chunk's f32 softmax is rematerialized in
     the backward (jax.checkpoint), so only the source-dtype logits persist."""
+    tot, num = _chunked_nll_sum_count(logits, labels, ignore_index, chunk)
+    return tot / jnp.maximum(num, 1.0)
+
+
+def _chunked_nll_sum_count(
+    logits: jax.Array, labels: jax.Array, ignore_index: int | None, chunk: int
+) -> tuple[jax.Array, jax.Array]:
+    """(masked nll SUM, valid COUNT) over rows via the chunked checkpoint
+    scan — shared by cross_entropy (which divides here) and mtp_loss's CP
+    path (which psums sum/count across shards before dividing)."""
     v = logits.shape[-1]
     flat = logits.reshape(-1, v)
     lab = labels.reshape(-1)
@@ -110,9 +120,14 @@ def _chunked_cross_entropy(
         tot, num = carry
         return (tot + nll_sum, num + cnt), None
 
-    (tot, num), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.float32(0.0)),
-                                 (flat, lab))
-    return tot / jnp.maximum(num, 1.0)
+    # under shard_map with vma tracking, the carry must match the body
+    # output's varying axes (the logits are shard-varying on CP paths)
+    zero = jnp.float32(0.0)
+    vma = tuple(getattr(jax.typeof(flat), "vma", ()) or ())
+    if vma:
+        zero = jax.lax.pcast(zero, vma, to="varying")
+    (tot, num), _ = jax.lax.scan(body, (zero, zero), (flat, lab))
+    return tot, num
 
 
 def distillation_loss(
@@ -192,49 +207,18 @@ def mtp_loss(
         return cross_entropy(
             logits.reshape(b * t * k, v), targets.reshape(-1), ignore_index
         )
-    # CP path: masked-nll SUM and valid COUNT, chunked exactly like
-    # cross_entropy's auto mode (rows processed under jax.checkpoint so
-    # only one chunk's f32 log-softmax ever exists — long-context configs
-    # like dsv3_long_cp have 131k local rows x 50k vocab, which unchunked
-    # would be ~26 GB of f32), then psum'd before dividing.
-    s, c = _masked_nll_sum(
-        logits.reshape(b * t * k, v), targets.reshape(-1),
-        -1 if ignore_index is None else ignore_index,
+    # CP path: masked-nll SUM and valid COUNT via cross_entropy's chunked
+    # checkpoint scan (one chunk's f32 log-probs at a time — long-context
+    # configs like dsv3_long_cp have 131k local rows x 50k vocab, which
+    # unchunked would be ~26 GB of f32), then psum'd before dividing.
+    rows = b * t * k
+    chunk = (
+        min(_AUTO_CHUNK_ROWS, rows)
+        if logits.size > _AUTO_CHUNK_ELEMENTS else rows
+    )
+    s, c = _chunked_nll_sum_count(
+        logits.reshape(rows, v), targets.reshape(-1), ignore_index, chunk
     )
     s = jax.lax.psum(s, axis_names)
     c = jax.lax.psum(c, axis_names)
     return s / jnp.maximum(c, 1.0)
-
-
-def _masked_nll_sum(flat_logits, flat_labels, ignore_index):
-    """(sum of masked nll, valid count) over rows, chunked at the same
-    auto thresholds as cross_entropy (jax.checkpoint per chunk)."""
-    n, v = flat_logits.shape
-    chunk = _AUTO_CHUNK_ROWS if flat_logits.size > _AUTO_CHUNK_ELEMENTS else n
-    pad = (-n) % chunk
-    if pad:
-        flat_logits = jnp.pad(flat_logits, ((0, pad), (0, 0)))
-        flat_labels = jnp.pad(flat_labels, (0, pad),
-                              constant_values=ignore_index)
-    flat_logits = flat_logits.reshape(-1, chunk, v)
-    flat_labels = flat_labels.reshape(-1, chunk)
-
-    @jax.checkpoint
-    def body(carry, xs):
-        s_acc, c_acc = carry
-        lg, lb = xs
-        log_probs = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
-        valid = lb != ignore_index
-        safe = jnp.where(valid, lb, 0)
-        nll = -jnp.take_along_axis(log_probs, safe[:, None], axis=-1)[:, 0]
-        mask = valid.astype(jnp.float32)
-        return (s_acc + jnp.sum(nll * mask), c_acc + jnp.sum(mask)), None
-
-    # under shard_map with vma tracking, the carry must match the body
-    # output's varying axes (the logits are shard-varying)
-    zero = jnp.zeros((), jnp.float32)
-    vma = tuple(getattr(jax.typeof(flat_logits), "vma", ()) or ())
-    if vma:
-        zero = jax.lax.pcast(zero, vma, to="varying")
-    (s, c), _ = jax.lax.scan(body, (zero, zero), (flat_logits, flat_labels))
-    return s, c
