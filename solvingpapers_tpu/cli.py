@@ -302,7 +302,27 @@ def cmd_sample(args) -> int:
     # uncached forward over a 16k prompt just to initialize would run the
     # single-shot attention the chunked prefill exists to avoid
     init_toks = prompt[:, : min(prompt.shape[1], 128)]
-    variables = model.init({"params": rng}, init_toks)
+    init_kwargs = {}
+    if getattr(args, "speculative", False):
+        if getattr(cfg.model, "mtp_heads", 0) < 1:
+            print(
+                "--speculative needs a model with mtp_heads >= 1 "
+                f"(config {cfg.name!r} has {getattr(cfg.model, 'mtp_heads', 0)})",
+                file=sys.stderr,
+            )
+            return 1
+        if not args.greedy:
+            print(
+                "--speculative decodes greedily (exact-match draft "
+                "verification); pass --greedy — temperature/top-k are "
+                "not supported",
+                file=sys.stderr,
+            )
+            return 1
+        # trace the MTP branch so the head params / routing state exist
+        # even without a checkpoint
+        init_kwargs["return_mtp"] = True
+    variables = model.init({"params": rng}, init_toks, **init_kwargs)
     params = variables["params"]
     extra = {k: v for k, v in variables.items() if k != "params"}
 
@@ -328,10 +348,26 @@ def cmd_sample(args) -> int:
     chunk = args.prefill_chunk
     if chunk is None and prompt.shape[1] > 4096:
         chunk = 2048
-    out = generate(
-        model, params, prompt, rng, max_new_tokens=args.max_new_tokens,
-        sampler=sampler, extra_variables=extra or None, prefill_chunk=chunk,
-    )
+    if getattr(args, "speculative", False):
+        # MTP self-speculative greedy decode (infer/speculative.py):
+        # output identical to --greedy, fewer forwards
+        from solvingpapers_tpu.infer import generate_speculative
+
+        out, stats = generate_speculative(
+            model, params, prompt, max_new_tokens=args.max_new_tokens,
+            extra_variables=extra or None, prefill_chunk=chunk,
+        )
+        f, a = int(stats["forwards"]), int(stats["accepted"])
+        print(
+            f"[speculative] forwards={f} accepted={a} "
+            f"tokens/forward={(f + a) / max(f, 1):.2f}",
+            file=sys.stderr,
+        )
+    else:
+        out = generate(
+            model, params, prompt, rng, max_new_tokens=args.max_new_tokens,
+            sampler=sampler, extra_variables=extra or None, prefill_chunk=chunk,
+        )
     print(tok.decode(np.asarray(out[0])))
     return 0
 
@@ -467,6 +503,12 @@ def main(argv=None) -> int:
     p_sample.add_argument("--top-k", type=int, default=50)
     p_sample.add_argument("--temperature", type=float, default=1.0)
     p_sample.add_argument("--greedy", action="store_true")
+    p_sample.add_argument(
+        "--speculative", action="store_true",
+        help="MTP self-speculative greedy decode (models with mtp_heads "
+             ">= 1): identical output to --greedy in fewer forwards; "
+             "prints acceptance stats to stderr",
+    )
     p_sample.add_argument("--seed", type=int, default=0)
 
     p_eval = sub.add_parser("eval")

@@ -7,3 +7,4 @@ from solvingpapers_tpu.infer.cache import (
     update_latent_cache,
 )
 from solvingpapers_tpu.infer.decode import generate
+from solvingpapers_tpu.infer.speculative import generate_speculative  # noqa: E402,F401

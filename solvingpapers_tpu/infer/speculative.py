@@ -1,0 +1,179 @@
+"""MTP self-speculative decoding for the DeepSeek-V3 family.
+
+The reference trains MTP heads (deepseekv3.ipynb cells 33/46) but never
+uses them at inference; real DeepSeek-V3 uses head k=1 for speculative
+decoding, and this module implements that TPU-first: each loop iteration
+runs ONE main forward over a 2-token chunk — the last accepted token plus
+the MTP head's draft of the token after it — and the chunk's first logits
+verify the draft for free. On acceptance the iteration commits TWO tokens
+(the draft plus the chunk's second argmax); on rejection, one (the true
+argmax). Greedy output is therefore IDENTICAL to plain `generate` —
+speculation only changes how many forwards it takes
+(tests/test_speculative.py pins the equality).
+
+Mechanics worth noting:
+  * The MTP head is a little autoregressive model over merged
+    [norm(h_i), norm(emb(token_{i+1}))] reps, so it carries its OWN latent
+    cache, prefilled alongside the main one (models.deepseekv3
+    .mtp_head_apply).
+  * On rejection the chunk's second cache slot (main AND mtp) holds
+    garbage, but the next iteration's chunk starts at exactly that
+    position and overwrites it before any attention can read it —
+    position-based masking never exposes slots beyond the current token.
+  * Greedy only: exact-match verification is lossless for argmax; the
+    stochastic variant needs rejection-sampling corrections and is out of
+    scope. Batch 1 only: rows would otherwise advance at different rates
+    and the contiguous cache write (one position per step) no longer
+    holds.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from solvingpapers_tpu.infer.cache import LatentCache
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "prefill_chunk"),
+)
+def generate_speculative(
+    model,
+    params,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int = 64,
+    extra_variables: dict | None = None,
+    prefill_chunk: int | None = None,
+):
+    """Greedy decode with MTP-draft speculation.
+
+    Returns (tokens (1, S0 + max_new_tokens), stats) where stats carries
+    `forwards` (main model calls in the decode loop) and `accepted`
+    (drafts that verified) — tokens/forward = 1 + accepted/forwards.
+    Requires model.cfg.mtp_heads >= 1 and prompt batch 1.
+    """
+    cfg = model.cfg
+    if getattr(cfg, "mtp_heads", 0) < 1:
+        raise ValueError("speculative decode needs a model with mtp_heads >= 1")
+    b, s0 = prompt.shape
+    if b != 1:
+        raise ValueError(
+            "speculative decode supports batch 1: rows accept drafts at "
+            "different rates, which breaks the contiguous cache write"
+        )
+    if s0 < 2:
+        raise ValueError("prompt must have at least 2 tokens")
+    total = s0 + max_new_tokens + 2  # cache slack: the last chunk touches p+1
+    limit = getattr(model, "max_positions", None)
+    # positions never exceed s0 + max_new - 1 (p = s0 + count - 1 and the
+    # loop stops at count == max_new), so full-context decodes that plain
+    # generate accepts pass here too; only the CACHE carries +2 slack
+    if limit is not None and s0 + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt+new = {s0 + max_new_tokens} exceeds the model's "
+            f"max positions {limit}"
+        )
+    total = min(total, limit) if limit is not None else total
+    if prefill_chunk is None and s0 > 4096:
+        prefill_chunk = 2048  # match generate()'s auto-chunk policy
+
+    variables = {"params": params, **(extra_variables or {})}
+    moe_state = variables.get("moe_state", {})
+    from solvingpapers_tpu.models.deepseekv3 import mtp_head_apply
+
+    caches = model.init_caches(1, total)
+    mtp_cache = LatentCache.init(
+        1, total, cfg.latent_dim + cfg.rope_dim, cfg.compute_dtype
+    )
+
+    # ---- prefill the main caches, collecting the post-norm hiddens
+    hs = []
+    chunk_size = prefill_chunk or s0
+    logits = None
+    for start in range(0, s0, chunk_size):
+        end = min(start + chunk_size, s0)
+        tok = jax.lax.slice_in_dim(prompt, start, end, axis=1)
+        positions = jnp.broadcast_to(jnp.arange(start, end), (1, end - start))
+        (logits, h), caches = model.apply(
+            variables, tok, positions=positions, caches=caches,
+            deterministic=True, attend_len=end, return_hidden=True,
+        )
+        hs.append(h)
+    h_all = jnp.concatenate(hs, axis=1)  # (1, s0, D)
+
+    # ---- prefill the MTP head's cache over positions [0, s0-1) (the
+    # next-token embeddings are the prompt itself there) — chunked like the
+    # main prefill so long prompts neither hit the flash kernel's q-block
+    # limit nor materialize an (s0, s0) dense score tensor
+    for start in range(0, s0 - 1, chunk_size):
+        end = min(start + chunk_size, s0 - 1)
+        _, _, mtp_cache, _ = mtp_head_apply(
+            cfg, params, moe_state, h_all[:, start:end],
+            prompt[:, start + 1 : end + 1],
+            jnp.broadcast_to(jnp.arange(start, end), (1, end - start)),
+            cache=mtp_cache, attend_len=end,
+        )
+
+    t1 = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)  # (1,)
+    # bootstrap draft at position s0-1 (h of the prompt's last token +
+    # the embedding of the just-decoded t1) -> predicts position s0+1
+    g, _, mtp_cache, _ = mtp_head_apply(
+        cfg, params, moe_state, h_all[:, -1:], t1[:, None],
+        jnp.full((1, 1), s0 - 1), cache=mtp_cache,
+    )
+    d0 = jnp.argmax(g[:, -1], axis=-1).astype(prompt.dtype)
+
+    out = jnp.zeros((max_new_tokens + 2,), prompt.dtype)
+    out = out.at[0].set(t1[0])
+
+    def cond(carry):
+        return carry[3] < max_new_tokens
+
+    def body(carry):
+        t, d, p, count, caches, mtp_cache, out, forwards, accepts = carry
+        chunk = jnp.stack([t[0], d[0]])[None, :]  # (1, 2)
+        positions = jnp.stack([p, p + 1])[None, :]
+        (l, h2), caches = model.apply(
+            variables, chunk, positions=positions, caches=caches,
+            deterministic=True, return_hidden=True,
+        )
+        true_next = jnp.argmax(l[:, 0], axis=-1).astype(t.dtype)  # tok @ p+1
+        t2 = jnp.argmax(l[:, 1], axis=-1).astype(t.dtype)  # tok @ p+2 if ok
+        ok = (true_next[0] == d[0])
+
+        out1 = jax.lax.dynamic_update_index_in_dim(out, true_next[0], count, 0)
+        out2 = jax.lax.dynamic_update_index_in_dim(out1, t2[0], count + 1, 0)
+        out = jnp.where(ok, out2, out1)
+
+        # MTP head over the same 2 columns: merged_p uses the TRUE token at
+        # p+1 (true_next); merged_{p+1} uses t2 — garbage on rejection, but
+        # that cache slot is overwritten by the next iteration's chunk
+        next_toks = jnp.stack([true_next[0], t2[0]])[None, :]
+        g2, _, mtp_cache, _ = mtp_head_apply(
+            cfg, params, moe_state, h2, next_toks, positions,
+            cache=mtp_cache,
+        )
+        draft = jnp.where(
+            ok,
+            jnp.argmax(g2[:, 1], axis=-1),
+            jnp.argmax(g2[:, 0], axis=-1),
+        ).astype(t.dtype)
+
+        t_next = jnp.where(ok, t2, true_next)
+        p_next = p + 1 + ok.astype(p.dtype)
+        count_next = count + 1 + ok.astype(count.dtype)
+        return (t_next, draft, p_next, count_next, caches, mtp_cache, out,
+                forwards + 1, accepts + ok.astype(forwards.dtype))
+
+    carry0 = (t1, d0, jnp.asarray(s0), jnp.asarray(1), caches, mtp_cache,
+              out, jnp.asarray(0), jnp.asarray(0))
+    _, _, _, _, _, _, out, forwards, accepts = jax.lax.while_loop(
+        cond, body, carry0
+    )
+    tokens = jnp.concatenate([prompt, out[None, :max_new_tokens]], axis=1)
+    return tokens, {"forwards": forwards, "accepted": accepts}

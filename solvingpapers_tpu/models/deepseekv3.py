@@ -499,9 +499,13 @@ class DeepSeekV3(nn.Module):
         deterministic: bool = True,
         return_mtp: bool = False,
         attend_len: int | None = None,
+        return_hidden: bool = False,
     ):
         """Returns (logits, caches) or ((logits, mtp_logits), caches) when
-        return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V))."""
+        return_mtp=True and mtp_heads > 0 (mtp_logits: (B, T, K, V)).
+        return_hidden: return ((logits, hidden), caches) with the post-
+        norm_f hidden stream — the MTP draft head's input during
+        speculative decoding (infer/speculative.py)."""
         cfg = self.cfg
         b, s = tokens.shape
         if positions is None:
@@ -544,6 +548,8 @@ class DeepSeekV3(nn.Module):
         logits = embed.attend(x.astype(cfg.compute_dtype))  # weight-tied head
 
         if not (return_mtp and cfg.mtp_heads > 0):
+            if return_hidden:
+                return (logits, x), new_caches
             return logits, new_caches
 
         # ---- MTP: vectorized version of cell 33's per-position loop ----
@@ -600,3 +606,57 @@ class DeepSeekV3(nn.Module):
             LatentCache.init(batch, max_len, cfg.latent_dim + cfg.rope_dim, dtype)
             for _ in range(cfg.n_layers)
         ]
+
+
+def mtp_head_apply(cfg, params, moe_state, h, next_tokens, positions,
+                   cache=None, attend_len=None, head=1, rngs=None,
+                   collect_stats=False):
+    """One MTP head applied functionally from the param dict — the ONE
+    functional form of DeepSeekV3.__call__'s flax-module MTP branch (that
+    branch is the only other copy; the module/functional boundary keeps
+    them separate). Used by the staged family's training branch
+    (models/deepseekv3_pipe.py, with `collect_stats`/`rngs`) and by
+    speculative decoding (infer/speculative.py, with `cache`): merged =
+    merge([norm(h), norm(emb of the NEXT token)]) -> mtp_layer (optionally
+    with its OWN latent cache: at decode the head is a little
+    autoregressive model over merged reps) -> proj -> tied head.
+
+    h: (B, S, D) post-norm_f hiddens at `positions` (the previous head's
+    output when chaining heads); next_tokens: (B, S) the token at
+    position+head for each column. Returns (logits, y, cache, stats) —
+    logits[:, i] predicts the token at positions[:, i] + head + 1, y is
+    the head layer's hidden (the next head's h), stats the layer's sown
+    MoE stats dict when collect_stats else None.
+    """
+    from solvingpapers_tpu.models.layers import LayerNorm
+
+    dt = cfg.compute_dtype
+    emb_table = params["tok_emb"]["embedding"]
+    emb = jnp.take(emb_table, next_tokens, axis=0).astype(dt)
+    merged = jnp.concatenate(
+        [
+            LayerNorm().apply({"params": params[f"mtp_norm_h_{head}"]}, h),
+            LayerNorm().apply({"params": params[f"mtp_norm_e_{head}"]}, emb),
+        ],
+        axis=-1,
+    ).astype(dt)
+    merged = merged @ params[f"mtp_merge_{head}"]["kernel"].astype(dt)
+    variables = {
+        "params": params[f"mtp_layer_{head}"],
+        "moe_state": moe_state[f"mtp_layer_{head}"],
+    }
+    det = rngs is None
+    kwargs = {} if det else {"rngs": rngs}
+    stats = None
+    if collect_stats:
+        (y, cache), mut = DSV3DecoderLayer(cfg).apply(
+            variables, merged, positions, cache, det, attend_len,
+            mutable=["moe_metrics"], **kwargs,
+        )
+        stats = mut["moe_metrics"]["moe"]["stats"][0]
+    else:
+        y, cache = DSV3DecoderLayer(cfg).apply(
+            variables, merged, positions, cache, det, attend_len, **kwargs,
+        )
+    proj = y.astype(dt) @ params[f"mtp_proj_{head}"]["kernel"].astype(dt)
+    return proj @ emb_table.T.astype(dt), y, cache, stats

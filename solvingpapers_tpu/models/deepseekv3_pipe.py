@@ -330,14 +330,11 @@ class DSV3Pipe:
         mtp_logits = None
         if use_mtp:
             # replicated MTP branch on the psum-broadcast stream (every
-            # pipe device computes the identical heads, like norm_f/head);
-            # dense DeepSeekV3's cell-33 machinery with the same param
-            # names. Under CP the i+k shift is the cp_shift_left ppermute.
-            # TWIN of DeepSeekV3.__call__'s MTP branch (flax-module form —
-            # the two can't share code across the module/functional
-            # boundary): any change there must be mirrored here;
-            # test_dsv3_pipe_mtp_export_matches_dense_family pins equality.
-            from solvingpapers_tpu.models.layers import LayerNorm
+            # pipe device computes the identical heads, like norm_f/head) —
+            # the shared functional core (models.deepseekv3.mtp_head_apply;
+            # the dense family's flax-module branch is the only other
+            # copy). Under CP the i+k shift is the cp_shift_left ppermute.
+            from solvingpapers_tpu.models.deepseekv3 import mtp_head_apply
 
             h_prev = x
             outs = []
@@ -348,29 +345,20 @@ class DSV3Pipe:
                     shifted = cp_shift_left(tokens, h, fill=0)
                 else:
                     shifted = jnp.pad(tokens[:, h:], ((0, 0), (0, h)))
-                emb_h = jnp.take(emb, shifted, axis=0).astype(dt)
-                nh = LayerNorm().apply({"params": p[f"mtp_norm_h_{h}"]}, h_prev)
-                ne = LayerNorm().apply({"params": p[f"mtp_norm_e_{h}"]}, emb_h)
-                merged = jnp.concatenate([nh, ne], axis=-1).astype(dt)
-                merged = merged @ p[f"mtp_merge_{h}"]["kernel"].astype(dt)
-                key = None
+                head_rngs = None
                 if train_drop:
                     # replicated across pipe (same key on every device)
-                    key = jax.random.fold_in(rngs["dropout"], 20_000 + h)
-                (y, _), mut = self._block.apply(
-                    {"params": p[f"mtp_layer_{h}"],
-                     "moe_state": ms_all[f"mtp_layer_{h}"]},
-                    merged, positions, None, key is None, None,
-                    mutable=["moe_metrics"],
-                    **({} if key is None else {"rngs": {"dropout": key}}),
+                    head_rngs = {"dropout": jax.random.fold_in(
+                        rngs["dropout"], 20_000 + h)}
+                head_logits, y, _, stats = mtp_head_apply(
+                    self._block.cfg, p, ms_all, h_prev, shifted, positions,
+                    head=h, rngs=head_rngs, collect_stats=True,
                 )
-                stats = mut["moe_metrics"]["moe"]["stats"][0]
                 mtp_aux.append(
                     (f"mtp_layer_{h}",
                      {k: stats[k] for k in (*_STAT_KEYS, "ci")})
                 )
-                proj = y.astype(dt) @ p[f"mtp_proj_{h}"]["kernel"].astype(dt)
-                outs.append(proj @ emb.T.astype(dt))
+                outs.append(head_logits)
                 h_prev = y
             mtp_logits = jnp.stack(outs, axis=2)
 
