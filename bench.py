@@ -334,6 +334,89 @@ def bench_decode_16k_prefill():
     }
 
 
+def bench_speculative_decode():
+    """MTP self-speculative decoding vs plain greedy decode on a briefly
+    trained dsv3+MTP model (acceptance tracks model quality, so random
+    params would only measure the fallback path). Output equality is
+    pinned by tests/test_speculative.py; this row records the measured
+    acceptance and the wall-clock ratio at the flagship's dims — where
+    per-forward latency dominates and the forward savings become wall
+    time (at toy dims decode is op-count-bound and the extra MTP-head
+    pass eats the win: dim 256/L4 measured 0.78x)."""
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.infer import generate, generate_speculative
+    from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3, DeepSeekV3Config
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+    from solvingpapers_tpu.train.objectives import dsv3_init_fn, dsv3_loss_fn
+
+    cfg = DeepSeekV3Config(
+        vocab_size=64, block_size=512, dim=512, n_layers=6, n_heads=8,
+        latent_dim=64, rope_dim=32, pe_scale=0.02, n_experts=8,
+        top_experts=2, dropout=0.0, attn_dropout=0.0, mtp_heads=1,
+        dtype="bfloat16",
+    )
+    model = DeepSeekV3(cfg)
+    # word-structured synthetic text: predictable enough for real
+    # acceptance after a short burst, not a degenerate loop
+    from solvingpapers_tpu.data.synthetic import synthetic_text
+
+    text = synthetic_text(400_000, seed=5)
+    vocab = sorted(set(text))[: cfg.vocab_size]
+    lut = {c: i for i, c in enumerate(vocab)}
+    toks = np.asarray([lut.get(c, 0) for c in text], np.int32)
+    tcfg = TrainConfig(
+        steps=400, batch_size=32, log_every=10_000, eval_every=0,
+        optimizer=OptimizerConfig(max_lr=1e-3, warmup_steps=40,
+                                  total_steps=400),
+    )
+    trainer = Trainer(model, tcfg, loss_fn=dsv3_loss_fn, init_fn=dsv3_init_fn)
+    state = trainer.fit(lm_batch_iterator(toks, 32, 256, seed=0))
+    # keep params device-resident: a device_get here would re-ship the
+    # whole model host->device on every timed call
+    params = state.params
+    extra = {"moe_state": state.model_state["moe_state"]}
+
+    prompt = jnp.asarray(toks[:64][None, :], jnp.int32)
+    new = 128
+    rng = jax.random.key(0)
+
+    def plain():
+        return generate(model, params, prompt, rng, max_new_tokens=new,
+                        sampler=ops.sample_greedy, extra_variables=extra,
+                        max_len=prompt.shape[1] + new + 2)
+
+    def spec():
+        return generate_speculative(model, params, prompt,
+                                    max_new_tokens=new,
+                                    extra_variables=extra)
+
+    _fence(jnp.sum(plain()[:, -1]))
+    plain_s = min(
+        (lambda t0: (_fence(jnp.sum(plain()[:, -1])),
+                     time.perf_counter() - t0)[1])(time.perf_counter())
+        for _ in range(3)
+    )
+    out, stats = spec()
+    _fence(jnp.sum(out[:, -1]))
+    spec_s = min(
+        (lambda t0: (_fence(jnp.sum(spec()[0][:, -1])),
+                     time.perf_counter() - t0)[1])(time.perf_counter())
+        for _ in range(3)
+    )
+    f = int(jax.device_get(stats["forwards"]))
+    a = int(jax.device_get(stats["accepted"]))
+    return {
+        "new_tokens": new,
+        "forwards": f,
+        "accepted": a,
+        "tokens_per_forward": round((f + a) / max(f, 1), 3),
+        "plain_ms_per_token": round(plain_s / new * 1e3, 3),
+        "spec_ms_per_token": round(spec_s / new * 1e3, 3),
+        "wall_speedup": round(plain_s / spec_s, 3),
+    }
+
+
 def bench_dropout_identity():
     """In-kernel dropout backward verification (real TPU only): out is
     linear in v with a fixed seed, so <loss(v+u) - loss(v)> must equal
@@ -441,6 +524,7 @@ def main() -> None:
         ("flash_mla_16k_step", bench_flash_mla_16k),
         ("decode_llama3_350m", bench_decode),
         ("decode_dsv3_16k_prefill", bench_decode_16k_prefill),
+        ("mtp_speculative_decode", bench_speculative_decode),
         ("flash_dropout_linearity", bench_dropout_identity),
     ):
         try:

@@ -319,6 +319,13 @@ def cmd_sample(args) -> int:
                 file=sys.stderr,
             )
             return 1
+        if prompt.shape[1] < 2:
+            print(
+                "--speculative needs a prompt of at least 2 tokens "
+                "(pass --prompt)",
+                file=sys.stderr,
+            )
+            return 1
         # trace the MTP branch so the head params / routing state exist
         # even without a checkpoint
         init_kwargs["return_mtp"] = True

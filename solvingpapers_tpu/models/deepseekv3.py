@@ -507,6 +507,11 @@ class DeepSeekV3(nn.Module):
         norm_f hidden stream — the MTP draft head's input during
         speculative decoding (infer/speculative.py)."""
         cfg = self.cfg
+        if return_hidden and return_mtp and cfg.mtp_heads > 0:
+            # the two returns share an unpack shape ((logits, X), caches),
+            # so allowing both would silently hand mtp_logits to a caller
+            # expecting the hidden stream
+            raise ValueError("return_hidden and return_mtp are mutually exclusive")
         b, s = tokens.shape
         if positions is None:
             from solvingpapers_tpu.models.layers import default_positions
