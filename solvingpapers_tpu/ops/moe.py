@@ -183,6 +183,91 @@ def moe_expert_sliced_combine(
     return jax.lax.psum(partial, axis_name)
 
 
+def moe_all_to_all_combine(
+    x: jax.Array,
+    probs: jax.Array,
+    expert_fn,
+    capacity: int,
+    axis_name: str = "expert",
+) -> jax.Array:
+    """Token-dispatch expert parallelism: tokens physically move to their
+    experts over `axis_name` (SURVEY.md §2.3 EP row; the communication
+    pattern the reference's distributed MoE would use, rebuilt on XLA
+    collectives instead of NCCL).
+
+    Contract (differs from moe_expert_sliced_combine, which replicates
+    tokens): `x` (T_local, D) / `probs` (T_local, E) are this member's
+    TOKEN SHARD over `axis_name`; expert weights are sharded over the same
+    axis. Each member one-hot-dispatches its local tokens into per-expert
+    capacity slots (E, C, D), one tiled `all_to_all` ships each expert's
+    slot block to the member that owns it — landing as (E/ep, ep*C, D),
+    slot blocks ordered by source member — the local expert matmul runs via
+    ``expert_fn((E/ep, ep*C, D), start)`` (same `start` slicing convention
+    as the sliced op), a second `all_to_all` ships results back to the
+    slots' owners, and each member combines into its own (T_local, D).
+
+    Bytes on the wire per member (one direction, elements): the two
+    all_to_alls move 2*(ep-1)/ep * E*C*D ≈ 2*(ep-1)/ep * k*cf*T_local*D,
+    i.e. only the routed capacity — vs the replicate+psum path whose
+    combine all-reduce moves 2*(ep-1)/ep * T_full*D with T_full = ep *
+    T_local. See `ep_comm_elements` for the accounting used by dryrun/bench.
+
+    Capacity (and therefore dropping) is decided per member from its local
+    token count — the standard distributed-MoE semantics, identical to how
+    the sliced path decides drops per CP shard. In the drop-free regime the
+    result equals `moe_dispatch_combine` over the gathered tokens exactly.
+    """
+    t, e = probs.shape
+    ep = jax.lax.psum(1, axis_name)
+    if e % ep:
+        raise ValueError(f"{e} experts not divisible by '{axis_name}' axis {ep}")
+    e_local = e // ep
+    start = jax.lax.axis_index(axis_name) * e_local
+
+    sel, pos, keep = _dispatch_slots(probs, capacity)
+    dispatch = jax.nn.one_hot(
+        jnp.where(keep, pos, capacity), capacity, dtype=x.dtype
+    )  # (T, E, C)
+    xe = jnp.einsum("tec,td->ecd", dispatch, x)  # (E, C, D) — my tokens
+    # ship: split the expert dim across members, concat received blocks
+    # along the slot dim (source-member order) -> (E/ep, ep*C, D)
+    xe = jax.lax.all_to_all(
+        xe, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    ye = expert_fn(xe, start)  # (E/ep, ep*C, D) through MY experts
+    # ship back: split the slot dim by destination member, concat along the
+    # expert dim -> (E, C, D) with exactly my original slot layout
+    ye = jax.lax.all_to_all(
+        ye, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    combine = dispatch * probs[..., None].astype(x.dtype)
+    return jnp.einsum("tec,ecd->td", combine, ye)
+
+
+def ep_comm_elements(
+    t_local: int, d: int, capacity: int, n_experts: int, ep: int
+) -> dict[str, float]:
+    """Per-member elements on the wire for one MoE layer's combine, for the
+    two EP strategies (ring-collective model, one direction):
+
+    * ``all_to_all``: two tiled all_to_alls of the (E, C, D) slot tensor —
+      each ships (ep-1)/ep of it.
+    * ``replicate_psum``: `moe_expert_sliced_combine`'s psum of the full
+      (T_full, D) partial combine, T_full = ep * t_local (tokens are
+      replicated across the axis), costing 2*(ep-1)/ep*T_full*D as a ring
+      all-reduce (reduce-scatter + all-gather).
+
+    Used by the dryrun/bench notes; ratios < 1 mean all_to_all moves less.
+    """
+    a2a = 2 * (ep - 1) / ep * n_experts * capacity * d
+    psum = 2 * (ep - 1) / ep * (ep * t_local) * d
+    return {
+        "all_to_all": a2a,
+        "replicate_psum": psum,
+        "ratio": a2a / max(psum, 1.0),
+    }
+
+
 def moe_dense_combine(x: jax.Array, probs: jax.Array, expert_fn_all) -> jax.Array:
     """Drop-free reference path: run every expert on every token.
 
