@@ -256,9 +256,12 @@ def cmd_sample(args) -> int:
         )
         return 2
     if getattr(cfg.model, "context_parallel", False):
-        # CP params are replicated at rest, so a non-CP twin of the same
-        # architecture decodes them directly (tested:
-        # tests/test_infer_prefill.py::test_cp_trained_weights_export_to_plain_decode)
+        # Single-chip path: CP params are replicated at rest, so a non-CP
+        # twin of the same architecture decodes them directly (tested:
+        # tests/test_infer_prefill.py::test_cp_trained_weights_export_to_plain_decode).
+        # On a real multi-chip mesh, `infer.generate_cp` decodes UNDER CP
+        # instead — context-sharded caches, ring prefill, prompts beyond
+        # one chip's HBM (tests/test_deepseekv3.py::test_cp_decode_*).
         from solvingpapers_tpu.sharding import MeshConfig
 
         cfg = dataclasses.replace(

@@ -65,6 +65,131 @@ class LatentCache:
         return self.c.shape[1]
 
 
+@struct.dataclass
+class CPLatentCache:
+    """Context-sharded MLA cache for decode under context parallelism
+    (SURVEY.md §5 long-context row — the inference half of the CP story).
+
+    Layout per context shard: `c_prompt` (B, s0_local, L) holds this
+    shard's CONTIGUOUS prompt chunk — written in place by the ring prefill,
+    so no resharding collective is ever needed — and `c_tail`
+    (B, tail_len, L) holds the decoded tokens REPLICATED across the context
+    axis (decode tokens are few; replicating them keeps the per-step write
+    collective-free). Per-step attention computes shard-local logsumexp
+    partials over c_prompt (plus c_tail on the last shard only, so the
+    replicated tail is counted once) and combines them with one
+    pmax + two psums over the context axis — the cache never moves.
+    """
+
+    c_prompt: jax.Array
+    c_tail: jax.Array
+
+    @classmethod
+    def init(
+        cls, batch: int, prompt_local: int, tail_len: int, latent_dim: int,
+        dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "CPLatentCache":
+        return cls(
+            c_prompt=jnp.zeros((batch, prompt_local, latent_dim), dtype),
+            c_tail=jnp.zeros((batch, tail_len, latent_dim), dtype),
+        )
+
+
+@struct.dataclass
+class CPKVCache:
+    """Context-sharded k/v cache for GQA/MHA decode under CP — same layout
+    contract as CPLatentCache: prompt chunks stay sharded where the ring
+    prefill produced them, decoded tokens are replicated in the tail."""
+
+    k_prompt: jax.Array
+    v_prompt: jax.Array
+    k_tail: jax.Array
+    v_tail: jax.Array
+
+    @classmethod
+    def init(
+        cls, batch: int, prompt_local: int, tail_len: int, n_kv_heads: int,
+        head_dim: int, dtype: jnp.dtype = jnp.bfloat16,
+    ) -> "CPKVCache":
+        pshape = (batch, prompt_local, n_kv_heads, head_dim)
+        tshape = (batch, tail_len, n_kv_heads, head_dim)
+        return cls(
+            k_prompt=jnp.zeros(pshape, dtype), v_prompt=jnp.zeros(pshape, dtype),
+            k_tail=jnp.zeros(tshape, dtype), v_tail=jnp.zeros(tshape, dtype),
+        )
+
+
+def validate_cp_cache(cache, expected_cls, prompt_len: int, s: int) -> None:
+    """Shared trace-time guards for CP cached attention — one copy for MLA
+    (models/deepseekv3.py) and the generic Attention (models/layers.py)."""
+    if not isinstance(cache, expected_cls):
+        raise TypeError(
+            f"decode under context parallelism needs the context-sharded "
+            f"{expected_cls.__name__} (model.init_cp_caches / "
+            "infer.generate_cp); a plain per-shard cache would silently "
+            "attend only local slots"
+        )
+    if prompt_len < 2:
+        raise ValueError(
+            "CP caches need >= 2 prompt slots per shard: a 1-slot "
+            "chunk is indistinguishable from a decode step"
+        )
+    if s not in (1, prompt_len):
+        raise ValueError(
+            f"CP cached call must be the full local prompt chunk "
+            f"({prompt_len} tokens, ring prefill) or a single decode "
+            f"token; got {s}"
+        )
+
+
+def _cp_combine(
+    scores_p: jax.Array,
+    scores_t: jax.Array,
+    vals: jax.Array,
+    axis_name: str,
+    spec: str,
+) -> jax.Array:
+    """Shared core of the two distributed softmax-combines below: one pmax
+    + two psums over `axis_name`; `spec` is the value-contraction einsum."""
+    scores = jnp.concatenate([scores_p, scores_t], axis=-1)
+    m = jax.lax.pmax(jnp.max(scores, axis=-1, keepdims=True), axis_name)
+    w = jnp.exp(scores - m)
+    l = jax.lax.psum(jnp.sum(w, axis=-1, keepdims=True), axis_name)
+    o = jax.lax.psum(
+        jnp.einsum(spec, w, vals.astype(jnp.float32)), axis_name
+    )
+    return o / jnp.moveaxis(l, 1, 2)
+
+
+def cp_cache_partial_softmax(
+    scores_p: jax.Array,
+    scores_t: jax.Array,
+    vals: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Numerically-stable distributed softmax-combine for CP cached decode.
+
+    scores_p (B, N, S, Tp) local-prompt scores (f32, already masked),
+    scores_t (B, N, S, Tt) tail scores (masked to -inf on all but the
+    counting shard), vals (B, Tp+Tt, L) the matching value rows. Returns
+    (B, S, N, L) f32 — softmax over the GLOBAL slot set via one pmax and
+    two psums over `axis_name`; per-shard work is a (S, T_local) matmul so
+    the sharded cache never moves.
+    """
+    return _cp_combine(scores_p, scores_t, vals, axis_name, "bnst,btl->bsnl")
+
+
+def cp_cache_partial_softmax_kv(
+    scores_p: jax.Array,
+    scores_t: jax.Array,
+    vals: jax.Array,
+    axis_name: str,
+) -> jax.Array:
+    """Head-resolved variant of `cp_cache_partial_softmax` for CPKVCache:
+    vals (B, Tp+Tt, N, H) (kv heads already repeated to N) -> (B, S, N, H)."""
+    return _cp_combine(scores_p, scores_t, vals, axis_name, "bnst,btnh->bsnh")
+
+
 def update_latent_cache(
     cache: LatentCache, c_new: jax.Array, index: jax.Array
 ) -> LatentCache:

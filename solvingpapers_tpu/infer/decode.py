@@ -127,3 +127,122 @@ def generate(
     )
     generated = jnp.concatenate([first_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1)
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+@functools.lru_cache(maxsize=32)
+def _cp_generate_program(model, mesh, s0_loc, max_new_tokens, sampler, eos_id):
+    """Compiled prefill+decode program for `generate_cp`, cached so repeat
+    calls with the same (model, mesh, shapes) don't retrace/recompile."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(variables, prompt_local, rng):
+        b = prompt_local.shape[0]
+        cp_size = jax.lax.psum(1, "context")  # static under shard_map
+        s0 = s0_loc * cp_size
+        caches = model.init_cp_caches(b, s0_loc, max_new_tokens)
+        # ring prefill; positions default to global inside the shard_map
+        logits, caches = model.apply(
+            variables, prompt_local, caches=caches, deterministic=True,
+        )
+        idx = jax.lax.axis_index("context")
+        # the last GLOBAL token's logits live on the last shard — replicate
+        last = jax.lax.psum(
+            jnp.where(idx == cp_size - 1, logits[:, -1], 0.0), "context"
+        )
+        rng, sub = jax.random.split(rng)
+        first_tok = sampler(last, sub).astype(prompt_local.dtype)
+        done0 = (
+            first_tok == eos_id if eos_id is not None
+            else jnp.zeros((b,), jnp.bool_)
+        )
+
+        def step(carry, _):
+            tok, pos, caches, rng, done = carry
+            logits, caches = model.apply(
+                variables, tok[:, None],
+                positions=jnp.broadcast_to(pos[None, None], (b, 1)),
+                caches=caches, deterministic=True,
+            )
+            rng, sub = jax.random.split(rng)
+            new_tok = sampler(logits[:, -1], sub).astype(tok.dtype)
+            if eos_id is not None:
+                new_tok = jnp.where(
+                    done, jnp.asarray(eos_id, tok.dtype), new_tok
+                )
+                done = done | (new_tok == eos_id)
+            return (new_tok, pos + 1, caches, rng, done), new_tok
+
+        if max_new_tokens == 1:
+            return first_tok[:, None]
+        _, toks = jax.lax.scan(
+            step, (first_tok, jnp.asarray(s0), caches, rng, done0), None,
+            length=max_new_tokens - 1,
+        )
+        return jnp.concatenate(
+            [first_tok[:, None], jnp.moveaxis(toks, 0, 1)], axis=1
+        )
+
+    # check_vma off: the MoE stats path pmean/psums over axes the decode
+    # inputs are replicated across (a vma type error, numerically a no-op)
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, "context"), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def generate_cp(
+    model,
+    params,
+    prompt: jax.Array,
+    rng: jax.Array,
+    mesh,
+    *,
+    max_new_tokens: int = 64,
+    sampler: Callable = ops.sample_greedy,
+    extra_variables: dict | None = None,
+    eos_id: int | None = None,
+) -> jax.Array:
+    """Context-parallel generation: long-context decode beyond one chip
+    (SURVEY.md §5 long-context row — the inference half of the CP story).
+
+    The prompt is sharded over `mesh`'s 'context' axis; prefill is the ring
+    attention pass writing each shard's contiguous chunk into its
+    context-sharded cache slice (infer.cache.CPLatentCache — the ≥32k
+    prompt cache never leaves its shard), then each decode step is a
+    replicated single-token forward whose attention combines shard-local
+    logsumexp partials with one pmax + two psums per layer. The model must
+    be built with context_parallel=True and expose
+    `init_cp_caches(batch, prompt_local, tail_len)`; `mesh` must carry the
+    framework's standard axes (MeshConfig) with context = the shard count.
+
+    Returns (B, S0 + max_new_tokens), same contract as `generate`.
+    """
+    b, s0 = prompt.shape
+    cp = mesh.shape["context"]
+    if s0 % cp:
+        raise ValueError(f"prompt length {s0} not divisible by context={cp}")
+    s0_loc = s0 // cp
+    if s0_loc < 2:
+        # a 1-token local chunk is indistinguishable from a decode step in
+        # the model's cached dispatch — and a 1-token-per-shard prompt has
+        # no business being context-parallel anyway
+        raise ValueError(
+            f"prompt length {s0} gives a 1-token shard on context={cp}; "
+            "CP decode needs >= 2 prompt tokens per shard (use `generate`)"
+        )
+    limit = getattr(model, "max_positions", None)
+    if limit is not None and s0 + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt+new tokens {s0 + max_new_tokens} exceed the model's "
+            f"max positions {limit}"
+        )
+    program = _cp_generate_program(
+        model, mesh, s0_loc, max_new_tokens, sampler, eos_id
+    )
+    variables = {"params": params, **(extra_variables or {})}
+    generated = program(variables, prompt, rng)
+    return jnp.concatenate([prompt, generated.astype(prompt.dtype)], axis=1)

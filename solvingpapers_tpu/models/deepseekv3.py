@@ -35,7 +35,9 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from solvingpapers_tpu import ops
-from solvingpapers_tpu.infer.cache import LatentCache, update_latent_cache
+from solvingpapers_tpu.infer.cache import (
+    CPLatentCache, LatentCache, update_latent_cache,
+)
 from solvingpapers_tpu.models.layers import (
     GLUFFN, RMSNorm, LayerNorm, maybe_remat, swiglu_hidden_dim,
 )
@@ -98,8 +100,31 @@ class DeepSeekV3Config:
     # MoE load stats / bias updates are psum'd across the step's axes so
     # the routing state stays shard-invariant.
     context_parallel: bool = False
+    # how the 'expert' mesh axis is used inside the CP shard_map:
+    #   "sliced"     — tokens replicated over 'expert'; each member runs its
+    #                  E/ep expert columns and partial combines psum
+    #                  (ops.moe.moe_expert_sliced_combine).
+    #   "all_to_all" — token-dispatch EP: each member owns 1/ep of the
+    #                  tokens, all_to_all ships capacity slots to the
+    #                  experts' owners and back, an all_gather restores the
+    #                  replicated-token contract afterwards
+    #                  (ops.moe.moe_all_to_all_combine) — communication
+    #                  scales with routed capacity, not the full token count.
+    ep_impl: str = "sliced"
     norm_eps: float = 1e-6
     dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.ep_impl not in ("sliced", "all_to_all"):
+            raise ValueError(
+                f"ep_impl must be 'sliced' or 'all_to_all', got "
+                f"{self.ep_impl!r}"
+            )
+        if self.moe_impl not in ("dispatch", "dense"):
+            raise ValueError(
+                f"moe_impl must be 'dispatch' or 'dense', got "
+                f"{self.moe_impl!r}"
+            )
 
     @property
     def stats_axes(self) -> tuple | None:
@@ -142,11 +167,13 @@ class MLA(nn.Module):
             from solvingpapers_tpu.models.layers import default_positions
 
             positions = default_positions(b, s, cfg.context_parallel)
-        if cache is not None and cfg.context_parallel:
-            raise NotImplementedError(
-                "latent caches are unsupported under context parallelism: "
-                "a per-shard cache would silently attend only local slots. "
-                "Decode with a non-CP model config."
+        cp_cache = cache is not None and cfg.context_parallel
+        if cp_cache:
+            from solvingpapers_tpu.infer.cache import validate_cp_cache
+
+            validate_cp_cache(
+                cache, CPLatentCache,
+                getattr(cache, "c_prompt", jnp.zeros((1, 0, 1))).shape[1], s,
             )
 
         latent = nn.Dense(
@@ -181,7 +208,52 @@ class MLA(nn.Module):
             )
         scale = (hd + R) ** -0.5 if R else hd**-0.5
 
-        if cache is None and cfg.context_parallel:
+        if cp_cache and s > 1:
+            # CP PREFILL: this shard's contiguous prompt chunk exactly fills
+            # its c_prompt slice — written in place, no resharding — and
+            # attention falls through to the ring path below (cross-shard
+            # causality is the ring's job, cache slots play no part yet)
+            cache = cache.replace(
+                c_prompt=latent.astype(cache.c_prompt.dtype)
+            )
+        if cp_cache and s == 1:
+            # CP DECODE STEP: the token is replicated across the context
+            # axis; its latent lands in the replicated tail, shard-local
+            # logsumexp partials over the sharded prompt chunk (+ tail on
+            # the last shard only, counted once) combine with one pmax +
+            # two psums — the 32k+ prompt cache never moves off its shard.
+            from solvingpapers_tpu.infer.cache import cp_cache_partial_softmax
+            from solvingpapers_tpu.ops.attention import BIG_NEG
+
+            cp_size = jax.lax.psum(1, "context")
+            idx = jax.lax.axis_index("context")
+            s0_glob = cache.c_prompt.shape[1] * cp_size
+            tail_len = cache.c_tail.shape[1]
+            pos = positions[0, 0]
+            cache = cache.replace(
+                c_tail=jax.lax.dynamic_update_slice(
+                    cache.c_tail, latent.astype(cache.c_tail.dtype),
+                    (0, pos - s0_glob, 0),
+                )
+            )
+            q32 = q_lat.astype(jnp.float32) * scale
+            # every prompt slot precedes pos (pos >= s0_glob): no mask
+            scores_p = jnp.einsum(
+                "bsnl,btl->bnst", q32, cache.c_prompt.astype(jnp.float32)
+            )
+            scores_t = jnp.einsum(
+                "bsnl,btl->bnst", q32, cache.c_tail.astype(jnp.float32)
+            )
+            tail_pos = s0_glob + jnp.arange(tail_len)
+            mask_t = (tail_pos[None, None, None, :] <= pos) & (
+                idx == cp_size - 1
+            )
+            scores_t = jnp.where(mask_t, scores_t, BIG_NEG)
+            vals = jnp.concatenate([cache.c_prompt, cache.c_tail], axis=1)
+            ctx = cp_cache_partial_softmax(
+                scores_p, scores_t, vals, "context"
+            ).astype(dt)
+        elif cfg.context_parallel and (cache is None or s > 1):
             # ring over the latent stream (k = v = latents, one shared kv
             # head): long-context CP for the flagship family. The same
             # latent-space algebra as the dense path — decompression by
@@ -343,6 +415,11 @@ class MoELayer(nn.Module):
         w2 = self.param("w2", init, (e, d, h))
         w3 = self.param("w3", init, (e, h, d))
 
+        # (probs, axes) the drop metric must count over — the a2a path
+        # dispatches per-member token shards, so its drops are counted from
+        # the shard's probs and psum'd over the expert axis too
+        drop_probs = drop_axes = None
+
         if cfg.moe_impl == "dense":
             def expert_fn_all(xt):
                 a = jnp.einsum("td,edh->eth", xt, w1.astype(dt))
@@ -386,9 +463,37 @@ class MoELayer(nn.Module):
                     )
                     return expert_body(xe, sl(w1), sl(w2), sl(w3))
 
-                out = ops.moe.moe_expert_sliced_combine(
-                    xt, probs, expert_fn_sliced, cap, axis_name="expert"
-                )
+                if cfg.ep_impl == "all_to_all":
+                    # token-dispatch EP: the gate ran on the full replicated
+                    # tokens (cheap, and keeps probs identical across the
+                    # axis for the stats below); dispatch/expert/combine run
+                    # on this member's 1/ep token slice with tokens moved by
+                    # all_to_all, then an all_gather restores the
+                    # replicated-token contract for the residual stream.
+                    ep = jax.lax.psum(1, "expert")
+                    tl = (b * s) // ep
+                    if (b * s) % ep:
+                        raise ValueError(
+                            f"{b * s} local tokens not divisible by the "
+                            f"'expert' axis ({ep}) for ep_impl=all_to_all"
+                        )
+                    idx = jax.lax.axis_index("expert")
+                    x_sh = jax.lax.dynamic_slice_in_dim(xt, idx * tl, tl, 0)
+                    p_sh = jax.lax.dynamic_slice_in_dim(probs, idx * tl, tl, 0)
+                    cap = ops.moe.expert_capacity(
+                        tl, e, cfg.top_experts, cfg.capacity_factor
+                    )
+                    out = ops.moe.moe_all_to_all_combine(
+                        x_sh, p_sh, expert_fn_sliced, cap, axis_name="expert"
+                    )
+                    out = jax.lax.all_gather(out, "expert", axis=0, tiled=True)
+                    drop_probs, drop_axes = p_sh, (
+                        tuple(cfg.stats_axes) + ("expert",)
+                    )
+                else:
+                    out = ops.moe.moe_expert_sliced_combine(
+                        xt, probs, expert_fn_sliced, cap, axis_name="expert"
+                    )
             else:
                 out = ops.moe.moe_dispatch_combine(xt, probs, expert_fn, cap)
 
@@ -454,7 +559,11 @@ class MoELayer(nn.Module):
             stats["drop_fraction"] = (
                 jnp.zeros(()) if cfg.moe_impl == "dense"
                 else ops.moe.dispatch_drop_fraction(
-                    probs_g, cap, axis_names=cfg.stats_axes
+                    probs_g if drop_probs is None else drop_probs,
+                    cap,
+                    axis_names=(
+                        cfg.stats_axes if drop_probs is None else drop_axes
+                    ),
                 )
             )
             stats["bias_norm"] = jnp.linalg.norm(bias.value)
@@ -609,6 +718,22 @@ class DeepSeekV3(nn.Module):
             # the cache row is cat(latent, k_rope) when the decoupled-RoPE
             # branch is on (MLA concatenates before the cache update)
             LatentCache.init(batch, max_len, cfg.latent_dim + cfg.rope_dim, dtype)
+            for _ in range(cfg.n_layers)
+        ]
+
+    def init_cp_caches(
+        self, batch: int, prompt_local: int, tail_len: int, dtype=None
+    ) -> list[CPLatentCache]:
+        """Context-sharded decode caches (one per layer): `prompt_local` is
+        the per-shard prompt chunk length (global prompt / context axis),
+        `tail_len` the decode budget (replicated)."""
+        cfg = self.cfg
+        dtype = dtype or cfg.compute_dtype
+        return [
+            CPLatentCache.init(
+                batch, prompt_local, tail_len,
+                cfg.latent_dim + cfg.rope_dim, dtype,
+            )
             for _ in range(cfg.n_layers)
         ]
 

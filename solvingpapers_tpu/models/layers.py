@@ -152,13 +152,74 @@ class Attention(nn.Module):
             q = ops.apply_rope(q, cos, sin, positions=positions)
             k = ops.apply_rope(k, cos, sin, positions=positions)
 
-        if cache is not None and self.context_parallel:
-            raise NotImplementedError(
-                "KV caches are unsupported under context parallelism: a "
-                "per-shard cache would silently attend only local slots. "
-                "Decode with a non-CP model config."
+        cp_cache = cache is not None and self.context_parallel
+        if cp_cache:
+            from solvingpapers_tpu.infer.cache import (
+                CPKVCache, validate_cp_cache,
             )
-        if cache is not None:
+
+            validate_cp_cache(
+                cache, CPKVCache,
+                getattr(cache, "k_prompt", jnp.zeros((1, 0, 1, 1))).shape[1],
+                s,
+            )
+            if s > 1:
+                # CP PREFILL: this shard's contiguous chunk fills its
+                # prompt slice in place; attention falls through to the
+                # ring/ulysses branch below
+                cache = cache.replace(
+                    k_prompt=k.astype(cache.k_prompt.dtype),
+                    v_prompt=v.astype(cache.v_prompt.dtype),
+                )
+        if cp_cache and s == 1:
+            # CP DECODE STEP: replicated token, sharded prompt cache.
+            # Shard-local logsumexp partials over the local prompt chunk
+            # (+ the replicated tail on the last shard only, counted once)
+            # combine with one pmax + two psums; the cache never moves.
+            from solvingpapers_tpu.infer.cache import cp_cache_partial_softmax_kv
+            from solvingpapers_tpu.ops.attention import BIG_NEG, repeat_kv
+
+            axis = self.context_axis
+            cp_size = jax.lax.psum(1, axis)
+            idx = jax.lax.axis_index(axis)
+            s0_glob = cache.k_prompt.shape[1] * cp_size
+            tail_len = cache.k_tail.shape[1]
+            pos = positions[0, 0]
+            cache = cache.replace(
+                k_tail=jax.lax.dynamic_update_slice(
+                    cache.k_tail, k.astype(cache.k_tail.dtype),
+                    (0, pos - s0_glob, 0, 0),
+                ),
+                v_tail=jax.lax.dynamic_update_slice(
+                    cache.v_tail, v.astype(cache.v_tail.dtype),
+                    (0, pos - s0_glob, 0, 0),
+                ),
+            )
+            group = self.n_heads // n_kv
+            q32 = q.astype(jnp.float32) * head_dim**-0.5
+            # every prompt slot precedes pos (pos >= s0_glob): no mask
+            scores_p = jnp.einsum(
+                "bsnh,btnh->bnst", q32,
+                repeat_kv(cache.k_prompt, group).astype(jnp.float32),
+            )
+            scores_t = jnp.einsum(
+                "bsnh,btnh->bnst", q32,
+                repeat_kv(cache.k_tail, group).astype(jnp.float32),
+            )
+            mask_t = (s0_glob + jnp.arange(tail_len) <= pos) & (
+                idx == cp_size - 1
+            )
+            scores_t = jnp.where(
+                mask_t[None, None, None, :], scores_t, BIG_NEG
+            )
+            vals = repeat_kv(
+                jnp.concatenate([cache.v_prompt, cache.v_tail], axis=1),
+                group,
+            )
+            out = cp_cache_partial_softmax_kv(
+                scores_p, scores_t, vals, axis
+            ).astype(self.dtype)
+        elif cache is not None and not cp_cache:
             # single contiguous segment per step: write at the first position
             cache = update_kv_cache(cache, k, v, positions[0, 0])
             if attend_len is not None:

@@ -161,3 +161,19 @@ class Llama(nn.Module):
             KVCache.init(batch, max_len, cfg.n_kv_heads, head_dim, dtype)
             for _ in range(cfg.n_layers)
         ]
+
+    def init_cp_caches(
+        self, batch: int, prompt_local: int, tail_len: int, dtype=None
+    ) -> list:
+        """Context-sharded decode caches for infer.generate_cp."""
+        from solvingpapers_tpu.infer.cache import CPKVCache
+
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        dtype = dtype or cfg.compute_dtype
+        return [
+            CPKVCache.init(
+                batch, prompt_local, tail_len, cfg.n_kv_heads, head_dim, dtype
+            )
+            for _ in range(cfg.n_layers)
+        ]
