@@ -80,6 +80,14 @@ class DSV3PipeConfig:
     remat: bool = False  # jax.checkpoint each block inside the stage_fn
     n_stages: int = 2
     n_microbatches: int = 2
+    # interleaved (virtual-stage) schedule: each pipe device holds
+    # `virtual_stages` thin stages (n_stages = pipe_size * virtual_stages);
+    # the MoE routing state rides the schedule's per-virtual-slice aux
+    # stack (sharding/pipeline.py with_aux) and is scattered back into the
+    # storage rows [d*v, d*v + v). 1 = GPipe. Does not compose with
+    # context_parallel (the virtual-slice branch cannot contain the CP
+    # ring's collectives).
+    virtual_stages: int = 1
     # True: GPipe schedule inside shard_map over 'pipe'; False: sequential
     # scan over stages (the dense oracle the schedule is tested against)
     pipeline_parallel: bool = False
@@ -100,6 +108,24 @@ class DSV3PipeConfig:
                 f"n_layers {self.n_layers} not divisible by n_stages "
                 f"{self.n_stages}"
             )
+        from solvingpapers_tpu.models.staged import validate_interleaved_config
+
+        validate_interleaved_config(
+            self.n_stages, self.virtual_stages, self.n_microbatches,
+            self.context_parallel,
+        )
+
+    @property
+    def pipe_size(self) -> int:
+        """Devices on the pipe axis (= n_stages / virtual_stages)."""
+        return self.n_stages // self.virtual_stages
+
+    def storage_index(self, global_stage: int) -> int:
+        from solvingpapers_tpu.models.staged import interleaved_storage_index
+
+        return interleaved_storage_index(
+            global_stage, self.virtual_stages, self.pipe_size
+        )
 
     @property
     def layers_per_stage(self) -> int:
@@ -156,8 +182,11 @@ class DSV3Pipe:
             # ring's varying carries under the vma checker
             dummy = jax.lax.pcast(dummy, ("context",), to="varying")
 
+        from solvingpapers_tpu.models.staged import interleaved_storage_order
+
         stacked = init_stage_stack(
-            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage
+            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage,
+            order=interleaved_storage_order(cfg.n_stages, cfg.virtual_stages),
         )
         params = {
             "tok_emb": {
@@ -197,8 +226,10 @@ class DSV3Pipe:
     def _make_stage_fn(self, bias_stack, positions, stage_index_fn):
         """stage_fn(stage_params, x) -> (y, aux): applies this stage's
         layers with the routing bias READ-ONLY, collecting per-layer raw
-        loads + load stats. `stage_index_fn()` -> traced stage id (axis
-        index under PP, python int under the dense oracle)."""
+        loads + load stats. `stage_index_fn(virtual_idx)` -> the STORAGE
+        row of this unit's stage in the stacked variables (axis index
+        under GPipe, d*v + virtual_idx under the interleaved schedule,
+        python int under the dense oracle)."""
         cfg = self.cfg
 
         def one(block_params, bias_j, x, key):
@@ -216,8 +247,8 @@ class DSV3Pipe:
             # same key on the remat replay -> identical masks in backward
             one = jax.checkpoint(one)
 
-        def stage_fn(sp, x, rng=None):
-            sid = stage_index_fn()
+        def stage_fn(sp, x, rng=None, virtual_idx=0):
+            sid = stage_index_fn(virtual_idx)
             aux_layers = []
             for j in range(cfg.layers_per_stage):
                 bias_j = stage_slice(bias_stack[f"block_{j}"], sid)
@@ -285,32 +316,59 @@ class DSV3Pipe:
                 )
             k_out, sched_rng = jax.random.split(rngs["dropout"])
 
-        if cfg.pipeline_parallel:
+        if cfg.pipeline_parallel and cfg.virtual_stages > 1:
+            # interleaved schedule: the routing state rides the schedule's
+            # per-virtual-slice aux stack; storage row of slice j on
+            # device d is d*v + j
+            from solvingpapers_tpu.sharding.pipeline import (
+                pipeline_local_apply_interleaved,
+            )
+
+            mb = x.shape[0] // cfg.n_microbatches
+            v = cfg.virtual_stages
+            stage_fn = self._make_stage_fn(
+                bias_stack, positions[:mb],
+                lambda j: jax.lax.axis_index("pipe") * v + j,
+            )
+            x, aux = pipeline_local_apply_interleaved(
+                p["stages"], x, stage_fn,
+                n_microbatches=cfg.n_microbatches,
+                n_virtual=v, with_aux=True, rng=sched_rng,
+            )
+            # aux rows sum over each slice's n_microbatches valid ticks
+            n_ticks = cfg.n_microbatches
+        elif cfg.pipeline_parallel:
             mb = x.shape[0] // cfg.n_microbatches
             mb_positions = positions[:mb]
             stage_fn = self._make_stage_fn(
-                bias_stack, mb_positions, lambda: jax.lax.axis_index("pipe")
+                bias_stack, mb_positions,
+                lambda j: jax.lax.axis_index("pipe"),
             )
             x, aux = pipeline_local_apply(
                 p["stages"], x, stage_fn,
                 n_microbatches=cfg.n_microbatches, with_aux=True,
                 rng=sched_rng,
             )
+            # stack aux like the interleaved path's (v=1, ...) rows so
+            # _mutate handles one layout
+            aux = jax.tree.map(lambda a: a[None], aux)
             # aux sums over this device's n_microbatches valid ticks
             n_ticks = cfg.n_microbatches
         else:
-            # dense oracle: same layers, same aux plumbing, no pipe axis
+            # dense oracle: same layers, same aux plumbing, no pipe axis;
+            # iterate GLOBAL stage order, slicing the storage row
             aux_stages = []
-            for st in range(cfg.n_stages):
+            for g in range(cfg.n_stages):
+                row = cfg.storage_index(g)
                 stage_fn = self._make_stage_fn(
-                    bias_stack, positions, lambda st=st: st
+                    bias_stack, positions, lambda j, row=row: row
                 )
                 x, aux_s = stage_fn(
-                    jax.tree.map(lambda a: a[st], p["stages"]), x,
+                    jax.tree.map(lambda a: a[row], p["stages"]), x,
                     None if sched_rng is None
-                    else jax.random.fold_in(sched_rng, st),
+                    else jax.random.fold_in(sched_rng, g),
                 )
-                aux_stages.append(aux_s)
+                aux_stages.append((row, aux_s))
             n_ticks = 1
 
         if train_drop and cfg.dropout > 0.0:
@@ -379,25 +437,29 @@ class DSV3Pipe:
     def _mutate(self, bias_stack, aux, n_ticks, wants, deterministic,
                 ms_all=None, mtp_aux=()):
         """Recombine per-device aux into the shard-invariant moe_state
-        update + scalar metrics. Under PP, `aux` holds THIS device's stage
-        sums; the update is scattered into a zero stack and psum'd over
-        'pipe'. Under the dense oracle, `aux` is a per-stage list.
-        `mtp_aux`: [(state key, stats)] for the replicated MTP layers —
-        their biases update in place (no pipe scatter: every device
-        computed the identical global stats)."""
+        update + scalar metrics. Under PP, `aux` holds THIS device's
+        per-virtual-slice stage sums, stacked (v, ...) (v=1 under GPipe);
+        the update is scattered into the device's storage rows
+        [sid*v, sid*v + v) of a zero stack and psum'd over 'pipe'. Under
+        the dense oracle, `aux` is a [(storage row, stats)] list in global
+        stage order. `mtp_aux`: [(state key, stats)] for the replicated
+        MTP layers — their biases update in place (no pipe scatter: every
+        device computed the identical global stats)."""
         cfg = self.cfg
         pp = cfg.pipeline_parallel
+        v = cfg.virtual_stages
         mutated: dict = {}
 
         if pp:
             sid = jax.lax.axis_index("pipe")
-            ci = aux["ci"]  # (layers_per_stage, E), summed over valid ticks
+            ci = aux["ci"]  # (v, layers_per_stage, E), summed over valid ticks
             # make loads global across the data axes (inside the block,
             # stats_axes covered data/fsdp/context only under CP)
             if not cfg.context_parallel:
                 ci = jax.lax.psum(ci, ("data", "fsdp"))
         else:
-            ci = jnp.stack([a["ci"] for a in aux])  # (n_stages, lps, E)
+            # (n_stages, lps, E), index-aligned with aux's global order
+            ci = jnp.stack([a["ci"] for _, a in aux])
 
         def global_ci(raw):
             # mtp layers run replicated per device over the local batch
@@ -413,11 +475,12 @@ class DSV3Pipe:
             new_state: dict = {}
             rate = cfg.aux_free_bias_update_rate
             if cfg.use_aux_free and not deterministic:
-                def upd(bias_j, delta_j):
-                    # bias_j: (n_stages, E); delta_j: (E,) for own stage
+                def upd(bias_j, delta_block):
+                    # bias_j: (n_stages, E) storage stack; delta_block:
+                    # (v, E) for this device's storage rows [sid*v, ..+v)
                     full = jnp.zeros_like(bias_j)
-                    full = jax.lax.dynamic_update_index_in_dim(
-                        full, delta_j.astype(bias_j.dtype), sid, 0
+                    full = jax.lax.dynamic_update_slice(
+                        full, delta_block.astype(bias_j.dtype), (sid * v, 0)
                     )
                     return bias_j + jax.lax.psum(full, "pipe")
 
@@ -425,16 +488,20 @@ class DSV3Pipe:
                 for j in range(cfg.layers_per_stage):
                     key = f"block_{j}"
                     if pp:
-                        err = jnp.mean(ci[j]) - ci[j]
+                        # per virtual slice: err (v, E)
+                        err = (
+                            jnp.mean(ci[:, j], axis=-1, keepdims=True)
+                            - ci[:, j]
+                        )
                         delta = rate * jnp.sign(err)
                         new_stack[key] = jax.tree.map(
                             lambda b: upd(b, delta), bias_stack[key]
                         )
                     else:
-                        deltas = []
-                        for st in range(cfg.n_stages):
-                            err = jnp.mean(ci[st, j]) - ci[st, j]
-                            deltas.append(rate * jnp.sign(err))
+                        deltas = [None] * cfg.n_stages
+                        for idx, (row, _) in enumerate(aux):
+                            err = jnp.mean(ci[idx, j]) - ci[idx, j]
+                            deltas[row] = rate * jnp.sign(err)
                         new_stack[key] = jax.tree.map(
                             lambda b: b + jnp.stack(deltas).astype(b.dtype),
                             bias_stack[key],
@@ -502,7 +569,7 @@ class DSV3Pipe:
                     stats[k] = (jax.lax.psum(v, "pipe") + extra) / n_total
             else:
                 stats = {
-                    k: (jnp.sum(jnp.stack([a[k] for a in aux]))
+                    k: (jnp.sum(jnp.stack([a[k] for _, a in aux]))
                         + sum(a[k] for _, a in mtp_aux)) / n_total
                     for k in _STAT_KEYS
                 }
@@ -529,12 +596,14 @@ class DSV3Pipe:
             # and tok_emb/norm_f copy straight across
             **{k: v for k, v in params.items() if k != "stages"},
             **restack_to_dense(params["stages"], cfg.n_stages,
-                               cfg.layers_per_stage, name),
+                               cfg.layers_per_stage, name,
+                               storage_index=cfg.storage_index),
         }
         dense_state = {
             **{k: v for k, v in moe_state.items() if k != "stages"},
             **restack_to_dense(
-                moe_state["stages"], cfg.n_stages, cfg.layers_per_stage, name
+                moe_state["stages"], cfg.n_stages, cfg.layers_per_stage,
+                name, storage_index=cfg.storage_index,
             ),
         }
         dense_cfg = dataclasses.replace(

@@ -76,23 +76,12 @@ class GPTPipeConfig:
                 f"n_layers {self.n_layers} not divisible by n_stages "
                 f"{self.n_stages}"
             )
-        if self.n_stages % self.virtual_stages:
-            raise ValueError(
-                f"n_stages {self.n_stages} not divisible by virtual_stages "
-                f"{self.virtual_stages}"
-            )
-        if self.virtual_stages > 1:
-            if self.context_parallel:
-                raise NotImplementedError(
-                    "interleaved schedule x context_parallel: the virtual-"
-                    "slice branch cannot contain the CP ring's collectives"
-                )
-            if self.n_microbatches % self.pipe_size:
-                raise ValueError(
-                    f"interleaved schedule needs n_microbatches "
-                    f"({self.n_microbatches}) divisible by the pipe size "
-                    f"({self.pipe_size}): microbatches enter in groups of P"
-                )
+        from solvingpapers_tpu.models.staged import validate_interleaved_config
+
+        validate_interleaved_config(
+            self.n_stages, self.virtual_stages, self.n_microbatches,
+            self.context_parallel,
+        )
 
     @property
     def pipe_size(self) -> int:
@@ -100,15 +89,13 @@ class GPTPipeConfig:
         return self.n_stages // self.virtual_stages
 
     def storage_index(self, global_stage: int) -> int:
-        """Row of the stacked params holding `global_stage`. GPipe (v=1):
-        identity. Interleaved: device d stores its v slices contiguously
-        (blocked sharding over 'pipe'), so global stage g = j*P + d lives
-        at row d*v + j."""
-        v, p = self.virtual_stages, self.pipe_size
-        if v == 1:
-            return global_stage
-        d, j = global_stage % p, global_stage // p
-        return d * v + j
+        """Row of the stacked params holding `global_stage` (the shared
+        interleaved layout — models/staged.py)."""
+        from solvingpapers_tpu.models.staged import interleaved_storage_index
+
+        return interleaved_storage_index(
+            global_stage, self.virtual_stages, self.pipe_size
+        )
 
     @property
     def layers_per_stage(self) -> int:
@@ -162,11 +149,11 @@ class GPTPipe:
             stage_init(jax.random.fold_in(k_blocks, s))
             for s in range(cfg.n_stages)
         ]
-        # storage row r holds global stage global_of(r) (identity for
-        # GPipe; the interleaved permutation for virtual_stages > 1 —
-        # cfg.storage_index documents the layout)
-        v, p = cfg.virtual_stages, cfg.pipe_size
-        order = [(r % v) * p + r // v for r in range(cfg.n_stages)]
+        # storage row r holds global stage order[r] (identity for GPipe;
+        # the shared interleaved permutation for virtual_stages > 1)
+        from solvingpapers_tpu.models.staged import interleaved_storage_order
+
+        order = interleaved_storage_order(cfg.n_stages, cfg.virtual_stages)
         stages = jax.tree.map(
             lambda *xs: jnp.stack(xs), *[stage_list[g] for g in order]
         )
@@ -192,7 +179,9 @@ class GPTPipe:
 
     # ----------------------------------------------------------------- apply
 
-    def _stage_fn(self, stage_params, x, rng=None):
+    def _stage_fn(self, stage_params, x, rng=None, virtual_idx=0):
+        # virtual_idx: interleaved-schedule slice index (unused here — the
+        # unit_rng already encodes the global stage)
         def one(p, x, key):
             if key is None:
                 y, _ = self._block.apply({"params": p}, x, None, None, True)

@@ -46,6 +46,12 @@ class LlamaPipeConfig:
     remat: bool = False  # jax.checkpoint each block inside the stage_fn
     n_stages: int = 2
     n_microbatches: int = 2
+    # interleaved (virtual-stage) schedule: each pipe device holds
+    # `virtual_stages` thin stages (n_stages = pipe_size * virtual_stages),
+    # shrinking the bubble to (P-1)/(m*v + P - 1). 1 = GPipe. Does not
+    # compose with context_parallel (the virtual-slice branch cannot
+    # contain the CP ring's collectives).
+    virtual_stages: int = 1
     pipeline_parallel: bool = False
     context_parallel: bool = False
     context_impl: str = "ring"
@@ -56,6 +62,24 @@ class LlamaPipeConfig:
                 f"n_layers {self.n_layers} not divisible by n_stages "
                 f"{self.n_stages}"
             )
+        from solvingpapers_tpu.models.staged import validate_interleaved_config
+
+        validate_interleaved_config(
+            self.n_stages, self.virtual_stages, self.n_microbatches,
+            self.context_parallel,
+        )
+
+    @property
+    def pipe_size(self) -> int:
+        """Devices on the pipe axis (= n_stages / virtual_stages)."""
+        return self.n_stages // self.virtual_stages
+
+    def storage_index(self, global_stage: int) -> int:
+        from solvingpapers_tpu.models.staged import interleaved_storage_index
+
+        return interleaved_storage_index(
+            global_stage, self.virtual_stages, self.pipe_size
+        )
 
     @property
     def layers_per_stage(self) -> int:
@@ -94,8 +118,11 @@ class LlamaPipe:
         )
         if cfg.context_parallel:
             dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+        from solvingpapers_tpu.models.staged import interleaved_storage_order
+
         stacked = init_stage_stack(
-            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage
+            self._block, k_blocks, dummy, cfg.n_stages, cfg.layers_per_stage,
+            order=interleaved_storage_order(cfg.n_stages, cfg.virtual_stages),
         )
         params = {
             "tok_emb": {
@@ -129,7 +156,7 @@ class LlamaPipe:
             # same key on the remat replay -> identical masks in backward
             one = jax.checkpoint(one)
 
-        def stage_fn(sp, x, rng=None):
+        def stage_fn(sp, x, rng=None, virtual_idx=0):
             for j in range(self.cfg.layers_per_stage):
                 x = one(
                     sp[f"block_{j}"], x,
@@ -173,7 +200,20 @@ class LlamaPipe:
                 )
             sched_rng = rngs["dropout"]
 
-        if cfg.pipeline_parallel:
+        if cfg.pipeline_parallel and cfg.virtual_stages > 1:
+            from solvingpapers_tpu.sharding.pipeline import (
+                pipeline_local_apply_interleaved,
+            )
+
+            mb = x.shape[0] // cfg.n_microbatches
+            stage_fn = self._stage_fn(positions[:mb])
+            x = pipeline_local_apply_interleaved(
+                p["stages"], x, stage_fn,
+                n_microbatches=cfg.n_microbatches,
+                n_virtual=cfg.virtual_stages,
+                rng=sched_rng,
+            )
+        elif cfg.pipeline_parallel:
             mb = x.shape[0] // cfg.n_microbatches
             stage_fn = self._stage_fn(positions[:mb])
             x = pipeline_local_apply(
@@ -183,11 +223,14 @@ class LlamaPipe:
             )
         else:
             stage_fn = self._stage_fn(positions)
-            for st in range(cfg.n_stages):
+            for g in range(cfg.n_stages):  # GLOBAL stage order
                 x = stage_fn(
-                    jax.tree.map(lambda a: a[st], p["stages"]), x,
+                    jax.tree.map(
+                        lambda a: a[cfg.storage_index(g)], p["stages"]
+                    ),
+                    x,
                     None if sched_rng is None
-                    else jax.random.fold_in(sched_rng, st),
+                    else jax.random.fold_in(sched_rng, g),
                 )
 
         x = RMSNorm(eps=cfg.norm_eps).apply({"params": p["norm_f"]}, x)
@@ -210,7 +253,7 @@ class LlamaPipe:
         dense = {k: v for k, v in params.items() if k != "stages"}
         dense.update(restack_to_dense(
             params["stages"], cfg.n_stages, cfg.layers_per_stage,
-            lambda i: f"block_{i}",
+            lambda i: f"block_{i}", storage_index=cfg.storage_index,
         ))
         dense_cfg = dataclasses.replace(cfg.block_cfg(), context_parallel=False)
         return Llama(dense_cfg), dense

@@ -26,11 +26,14 @@ def init_stage_stack(
     n_stages: int,
     layers_per_stage: int,
     block_args: tuple = (),
+    order: list | None = None,
 ):
     """Initialize n_stages x layers_per_stage copies of `block` and stack
     them into {collection: {block_j: stacked-vars}} with a leading stage
     dim (shard over 'pipe'). `block_args` are extra positional args for
-    block.init after the dummy activation (e.g. positions)."""
+    block.init after the dummy activation (e.g. positions). `order`:
+    order[row] = global stage stored at `row` (interleaved_storage_order;
+    default identity)."""
 
     def stage_init(stage_key):
         per_col: dict = {}
@@ -43,7 +46,56 @@ def init_stage_stack(
         return per_col
 
     stages = [stage_init(jax.random.fold_in(key, s)) for s in range(n_stages)]
+    if order is not None:
+        stages = [stages[g] for g in order]
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def validate_interleaved_config(n_stages: int, virtual_stages: int,
+                                n_microbatches: int,
+                                context_parallel: bool) -> None:
+    """Shared __post_init__ validation for the staged-LM configs'
+    interleaved-schedule knobs (one copy for gpt/llama3/dsv3 pipe)."""
+    if n_stages % virtual_stages:
+        raise ValueError(
+            f"n_stages {n_stages} not divisible by virtual_stages "
+            f"{virtual_stages}"
+        )
+    if virtual_stages > 1:
+        if context_parallel:
+            raise NotImplementedError(
+                "interleaved schedule x context_parallel: the virtual-"
+                "slice branch cannot contain the CP ring's collectives"
+            )
+        pipe_size = n_stages // virtual_stages
+        if n_microbatches % pipe_size:
+            raise ValueError(
+                f"interleaved schedule needs n_microbatches "
+                f"({n_microbatches}) divisible by the pipe size "
+                f"({pipe_size}): microbatches enter in groups of P"
+            )
+
+
+def interleaved_storage_index(global_stage: int, virtual_stages: int,
+                              pipe_size: int) -> int:
+    """Stack row holding `global_stage` under the interleaved layout:
+    device d stores its v virtual slices contiguously (blocked sharding
+    over 'pipe'), so global stage g = j*P + d lives at row d*v + j.
+    v == 1 is the identity (GPipe)."""
+    if virtual_stages == 1:
+        return global_stage
+    d, j = global_stage % pipe_size, global_stage // pipe_size
+    return d * virtual_stages + j
+
+
+def interleaved_storage_order(n_stages: int, virtual_stages: int) -> list:
+    """order[row] = global stage stored at `row` (inverse of
+    interleaved_storage_index): row r = d*v + j holds stage j*P + d."""
+    p = n_stages // virtual_stages
+    return [
+        (r % virtual_stages) * p + r // virtual_stages
+        for r in range(n_stages)
+    ]
 
 
 def stage_slice(tree, stage_index, keepdims: bool = False):
@@ -57,15 +109,19 @@ def stage_slice(tree, stage_index, keepdims: bool = False):
 
 
 def restack_to_dense(stages, n_stages: int, layers_per_stage: int,
-                     layer_name):
+                     layer_name, storage_index=None):
     """Stage-stacked {block_j: stacked-vars} -> {layer_name(i): vars} in the
     dense model's layout. Block j of stage s is dense layer
     s * layers_per_stage + j; module names inside each block are shared
-    with the dense family, so the forward is bit-identical."""
+    with the dense family, so the forward is bit-identical.
+    `storage_index(global_stage) -> row` maps global stage to its stack
+    row (identity by default; the interleaved layout stores device d's
+    virtual slices contiguously)."""
     dense = {}
     for s in range(n_stages):
+        row = s if storage_index is None else storage_index(s)
         for j in range(layers_per_stage):
             dense[layer_name(s * layers_per_stage + j)] = jax.tree.map(
-                lambda a: a[s], stages[f"block_{j}"]
+                lambda a: a[row], stages[f"block_{j}"]
             )
     return dense

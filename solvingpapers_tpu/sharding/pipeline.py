@@ -135,7 +135,8 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
 
 
 def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
-                                axis_name, n_virtual, rng=None):
+                                axis_name, n_virtual, rng=None,
+                                with_aux: bool = False):
     """Interleaved (virtual-stage) schedule: device d holds `n_virtual`
     THIN stages (global stage j*P + d stored at local row j), microbatches
     enter in groups of P and loop the ring v times consecutively — the
@@ -152,7 +153,15 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
     ticks (t % (v*P) < P) never collide with wrapped units, and group
     g+1's ingest lands exactly as group g's last loop leaves.
 
-    stage_fn(stage_params_slice_j, x) -> y; requires n_micro % P == 0.
+    stage_fn(stage_params_slice_j, x[, rng][, virtual_idx]) -> y (or
+    (y, aux) with `with_aux`); requires n_micro % P == 0.
+
+    with_aux: aux is accumulated into a leading (n_virtual,) stack — row j
+    sums virtual slice j's n_micro VALID ticks (device d's row j covers
+    global stage j*P + d; bubble ticks are masked out). Each (global
+    stage, microbatch) unit runs exactly once across all valid ticks, so
+    the stacked sums have the same per-stage coverage as the GPipe
+    schedule's aux (callers scatter rows j -> storage row d*v + j).
     """
     n_stages = jax.lax.psum(1, axis_name)  # P devices
     d_id = jax.lax.axis_index(axis_name)
@@ -169,8 +178,21 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
     buf = jnp.zeros_like(microbatches[0])
     out = jnp.zeros_like(microbatches)
 
+    def run_virtual(j, incoming, unit_rng):
+        res = _apply_virtual(params_v, j, incoming, stage_fn, n_virtual,
+                             unit_rng, rng_used=rng is not None)
+        return res if with_aux else (res, None)
+
+    aux_shapes = (
+        jax.eval_shape(
+            lambda p, x: run_virtual(jnp.zeros((), jnp.int32), x, rng)[1],
+            params_v, buf,
+        )
+        if with_aux else None
+    )
+
     def tick(carry, t):
-        buf, out = carry
+        buf, out, aux_acc = carry
         rel = t - d_id  # hops since this device's current unit entered
         g = jnp.maximum(rel, 0) // vP
         i = jnp.maximum(rel, 0) % n_stages
@@ -188,8 +210,18 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
             unit_rng = jax.random.fold_in(
                 jax.random.fold_in(rng, j * n_stages + d_id), mb_idx
             )
-        y = _apply_virtual(params_v, j, incoming, stage_fn, n_virtual,
-                           unit_rng)
+        y, aux = run_virtual(j, incoming, unit_rng)
+        if with_aux:
+            # this device's unit is real for the first m*v ticks after its
+            # ramp (rel in [0, m*v)) — every (slice, microbatch) pair once
+            valid = (rel >= 0) & (rel < n_micro * n_virtual)
+
+            def acc_row(acc, a):
+                row = jax.lax.dynamic_index_in_dim(acc, j, 0, keepdims=False)
+                row = row + jnp.where(valid, a, 0.0).astype(acc.dtype)
+                return jax.lax.dynamic_update_index_in_dim(acc, row, j, 0)
+
+            aux_acc = jax.tree.map(acc_row, aux_acc, aux)
         # unit completes at device P-1 on its last slice
         done = (
             (d_id == n_stages - 1)
@@ -202,29 +234,46 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
         )
         out = jnp.where(done, updated, out)
         buf = jax.lax.ppermute(y, axis_name, perm)
-        return (buf, out), None
+        return (buf, out, aux_acc), None
 
-    (_, out), _ = jax.lax.scan(tick, (buf, out), jnp.arange(ticks))
+    def zero_stack_shape(s):
+        # (n_virtual, *aux shape) accumulator matching run_virtual's vma
+        z = jnp.zeros((n_virtual, *s.shape), jnp.float32)
+        vma = tuple(getattr(s, "vma", ()) or ())
+        return jax.lax.pcast(z, vma, to="varying") if vma else z
+
+    aux0 = jax.tree.map(zero_stack_shape, aux_shapes) if with_aux else None
+    (_, out, aux_sum), _ = jax.lax.scan(
+        tick, (buf, out, aux0), jnp.arange(ticks)
+    )
     out = jnp.where(d_id == n_stages - 1, out, jnp.zeros_like(out))
-    return jax.lax.psum(out, axis_name)
+    out = jax.lax.psum(out, axis_name)
+    return (out, aux_sum) if with_aux else out
 
 
-def _apply_virtual(params_v, j, x, stage_fn, n_virtual, unit_rng=None):
+def _apply_virtual(params_v, j, x, stage_fn, n_virtual, unit_rng=None,
+                   rng_used=None):
     """Run stage_fn with this device's virtual-slice-j params. j is traced,
     so slice with lax.switch over the (python-static) v rows — a dynamic
     gather of a whole param subtree would copy it; switch lets XLA keep
-    each branch's weights in place."""
-    if unit_rng is None:
+    each branch's weights in place. Each branch passes its python-static
+    slice index as `virtual_idx` so stage_fns that need the GLOBAL stage id
+    (j*P + d — e.g. the flagship's routing-bias slicing) can derive it.
+    `rng_used` distinguishes 'no rng this call' (None key) from 'schedule
+    has no rng arg at all' (2-arg stage_fn); default: keyed iff unit_rng."""
+    if rng_used is None:
+        rng_used = unit_rng is not None
+    if not rng_used:
         branches = [
             lambda x, jj=jj: stage_fn(
-                jax.tree.map(lambda a: a[jj], params_v), x
+                jax.tree.map(lambda a: a[jj], params_v), x, virtual_idx=jj
             )
             for jj in range(n_virtual)
         ]
         return jax.lax.switch(j, branches, x)
     branches = [
         lambda x, r, jj=jj: stage_fn(
-            jax.tree.map(lambda a: a[jj], params_v), x, r
+            jax.tree.map(lambda a: a[jj], params_v), x, r, virtual_idx=jj
         )
         for jj in range(n_virtual)
     ]
@@ -270,21 +319,221 @@ def pipeline_local_apply_interleaved(
     n_virtual: int,
     axis_name: str = "pipe",
     rng=None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Per-device interleaved-schedule entry (see
     _pipeline_local_interleaved). stage_params: this device's (v, ...)
     virtual-slice rows. Does not compose with collectives inside stage_fn
     (slice selection is a data-dependent branch), so CP x interleaved is
     rejected at the model layer. With `rng`, stage_fn is called as
-    (params, x, unit_rng) keyed by (global stage, microbatch)."""
+    (params, x, unit_rng, virtual_idx=j) keyed by (global stage,
+    microbatch). With `with_aux`, stage_fn returns (y, aux) and this
+    returns (out, aux stacked per virtual slice)."""
     b = x.shape[0]
     if b % n_microbatches:
         raise ValueError(f"batch {b} not divisible by {n_microbatches} microbatches")
     micro = x.reshape(n_microbatches, b // n_microbatches, *x.shape[1:])
-    out = _pipeline_local_interleaved(
-        stage_params, micro, stage_fn, axis_name, n_virtual, rng=rng
+    res = _pipeline_local_interleaved(
+        stage_params, micro, stage_fn, axis_name, n_virtual, rng=rng,
+        with_aux=with_aux,
     )
-    return out.reshape(b, *x.shape[1:])
+    if with_aux:
+        out, aux = res
+        return out.reshape(b, *x.shape[1:]), aux
+    return res.reshape(b, *x.shape[1:])
+
+
+def pipeline_1f1b_value_and_grad(
+    stage_params,
+    head_params,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    stage_fn,
+    loss_fn,
+    axis_name: str = "pipe",
+):
+    """One-forward-one-backward schedule (SURVEY.md §2.3 PP row): loss AND
+    gradients in a single pass whose live activation memory is bounded by
+    the PIPE DEPTH, not the microbatch count.
+
+    GPipe (jax.grad over `_pipeline_local`'s scan) must stash every tick's
+    residuals — activation memory grows with n_micro, which is exactly what
+    `pp_grad_groups` works around by paying one fill+drain bubble per
+    group. 1F1B instead schedules each microbatch's backward as soon as its
+    loss exists: stage s runs forward i at tick s + 2i and backward i at
+    tick 2P - 1 - s + 2i (the classic schedule in tick-synchronous SPMD
+    form — F and B strictly alternate per device, so each device holds at
+    most P stashed INPUTS and nothing else; the backward recomputes its
+    stage forward from the stashed input, the same recompute GPipe-remat
+    pays). Ticks total 2(m + P) - 3; the steady state is bubble-free.
+
+    Per tick, uniformly on every device: one `lax.cond` (forward unit OR
+    backward unit — dynamic branch, collective-free inside) then two
+    ppermutes (activations downstream, cotangents upstream). The backward
+    unit takes one vjp of
+
+        where(is_last_stage, loss_fn(head, y, target), vdot(y, cot_in))
+
+    so the LAST stage seeds the chain from its per-microbatch loss while
+    the others pull the incoming cotangent through — and grads w.r.t.
+    `head_params` are exactly zero on non-last stages (where-masked), so
+    the pipe-psum recovers the true head gradient.
+
+    Args: stage_params — this device's stage slice, leading dim 1 (same
+    contract as `_pipeline_local`); head_params — the replicated loss head
+    (e.g. final norm + lm head), threaded to `loss_fn`; microbatches
+    (m, mb, ...) replicated inputs; targets (m, mb, ...) replicated;
+    stage_fn(params, x) -> y shape-preserving; loss_fn(head_params, y,
+    target) -> scalar MEAN loss of one microbatch (note: evaluated on
+    every stage's backward unit and where-masked, so keep the head small
+    relative to a stage — true for norm+vocab heads vs transformer
+    stages at scale, and the price of a uniform SPMD program).
+
+    Returns (loss, dstage_params, dhead_params, dmicrobatches): loss is
+    the mean over microbatches; dstage_params has the input's leading-1
+    stage dim (this device's stage); dhead_params is psum'd over the pipe
+    (replicated, ready for the optimizer); dmicrobatches (m, mb, ...) is
+    the cotangent w.r.t. `microbatches` (backprop it into the embedding
+    outside), psum-broadcast from stage 0.
+
+    Equality vs jax.grad over the sequential stage loop is pinned by
+    tests/test_pipeline.py::test_1f1b_matches_sequential_grads.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_id = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stage_params)
+    n_micro, mb = microbatches.shape[0], microbatches.shape[1:]
+    # last backward is stage 0's B(0, m-1) at tick 2(m + P) - 3 inclusive
+    ticks = 2 * (n_micro + n_stages) - 2
+    down = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    up = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    is_last = stage_id == n_stages - 1
+
+    probe = jax.tree.leaves(stage_params)[0]
+    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
+
+    def mark(x):
+        if tracking and axis_name not in jax.typeof(x).vma:
+            return jax.lax.pcast(x, (axis_name,), to="varying")
+        return x
+
+    microbatches = mark(microbatches)
+    targets = mark(targets)
+    head_params = jax.tree.map(mark, head_params)
+
+    f32 = jnp.float32
+    # the whole carry is inherently per-device data — mark it varying up
+    # front so the two cond branches (and the scan) type-match under vma
+    fwd_buf = mark(jnp.zeros(mb, f32))       # activation arriving from s-1
+    bwd_buf = mark(jnp.zeros(mb, f32))       # cotangent arriving from s+1
+    stash = mark(jnp.zeros((n_stages, *mb), f32))  # in-flight unit inputs
+    dparams = jax.tree.map(lambda a: mark(jnp.zeros(a.shape, f32)), params)
+    dhead = jax.tree.map(
+        lambda a: mark(jnp.zeros(a.shape, f32)), head_params
+    )
+    dmicro = mark(jnp.zeros((n_micro, *mb), f32))
+    loss_acc = mark(jnp.zeros((), f32))
+
+    def unit_scalar(p, hp, x, cot, target):
+        y = stage_fn(p, x.astype(probe.dtype)).astype(f32)
+        per_mb = loss_fn(hp, y, target)
+        pulled = jnp.vdot(y, cot)
+        return jnp.where(is_last, per_mb, pulled), (y, per_mb)
+
+    def tick(carry, t):
+        (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc) = carry
+        rel_f = t - stage_id
+        i_f = rel_f // 2
+        do_f = (rel_f >= 0) & (rel_f % 2 == 0) & (i_f < n_micro)
+        rel_b = t - (2 * n_stages - 1 - stage_id)
+        i_b = rel_b // 2
+        do_b = (rel_b >= 0) & (rel_b % 2 == 0) & (i_b < n_micro)
+
+        i_f_c = jnp.clip(i_f, 0, n_micro - 1)
+        i_b_c = jnp.clip(i_b, 0, n_micro - 1)
+
+        def fwd_unit(op):
+            fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc = op
+            x_in = jnp.where(
+                stage_id == 0, microbatches[i_f_c].astype(f32), fwd_buf
+            )
+            # idle (ramp) ticks also land here with a clipped index — they
+            # must NOT clobber a live slot another microbatch's backward
+            # still needs
+            stash = jnp.where(
+                do_f,
+                jax.lax.dynamic_update_index_in_dim(
+                    stash, x_in, i_f_c % n_stages, 0
+                ),
+                stash,
+            )
+            y = stage_fn(params, x_in.astype(probe.dtype)).astype(f32)
+            return jax.tree.map(mark, (
+                y, jnp.zeros(mb, f32), stash, dparams, dhead, dmicro,
+                loss_acc,
+            ))
+
+        def bwd_unit(op):
+            fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc = op
+            x_in = jax.lax.dynamic_index_in_dim(
+                stash, i_b_c % n_stages, 0, keepdims=False
+            )
+            target = targets[i_b_c]
+            _, vjp, (_, per_mb) = jax.vjp(
+                unit_scalar, params, head_params, x_in, bwd_buf, target,
+                has_aux=True,
+            )
+            dp, dh, dx, _, _ = vjp(mark(jnp.ones((), f32)))
+            dparams = jax.tree.map(lambda a, b: a + b.astype(f32),
+                                   dparams, dp)
+            dhead = jax.tree.map(lambda a, b: a + b.astype(f32), dhead, dh)
+            # stage 0's dx is the microbatch-input cotangent
+            dmicro = jnp.where(
+                stage_id == 0,
+                jax.lax.dynamic_update_index_in_dim(dmicro, dx, i_b_c, 0),
+                dmicro,
+            )
+            loss_acc = loss_acc + jnp.where(is_last, per_mb, 0.0)
+            return jax.tree.map(mark, (
+                jnp.zeros(mb, f32), dx, stash, dparams, dhead, dmicro,
+                loss_acc,
+            ))
+
+        # F and B ticks strictly alternate per device, so exactly one (or
+        # neither, in the ramp) runs; idle ticks take the fwd branch with a
+        # clipped index and the result is never consumed
+        res = jax.lax.cond(do_b, bwd_unit, fwd_unit,
+                           (fwd_buf, bwd_buf, stash, dparams, dhead,
+                            dmicro, loss_acc))
+        y_send, cot_send, stash, dparams, dhead, dmicro, loss_acc = res
+        y_send = jnp.where(do_f, y_send, jnp.zeros(mb, f32))
+        cot_send = jnp.where(do_b, cot_send, jnp.zeros(mb, f32))
+        fwd_buf = jax.lax.ppermute(y_send, axis_name, down)
+        bwd_buf_new = jax.lax.ppermute(cot_send, axis_name, up)
+        # a device KEEPS its pending cotangent until its B tick consumes
+        # it: the sender's B tick is exactly 1 before ours, so overwrite
+        # only when fresh data arrived (sender did B at tick t)
+        sender_did_b = ((t - (2 * n_stages - 2 - stage_id)) >= 0) & (
+            ((t - (2 * n_stages - 2 - stage_id)) % 2 == 0)
+        )
+        bwd_buf = jnp.where(sender_did_b, bwd_buf_new, bwd_buf)
+        return (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro,
+                loss_acc), None
+
+    carry0 = (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc)
+    (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc), _ = (
+        jax.lax.scan(tick, carry0, jnp.arange(ticks))
+    )
+    loss = jax.lax.psum(
+        jnp.where(is_last, loss_acc, 0.0), axis_name
+    ) / n_micro
+    dhead = jax.lax.psum(jax.tree.map(lambda a: a / n_micro, dhead),
+                         axis_name)
+    dmicro = jax.lax.psum(
+        jnp.where(stage_id == 0, dmicro, jnp.zeros_like(dmicro)), axis_name
+    ) / n_micro
+    dstage = jax.tree.map(lambda a: (a / n_micro)[None], dparams)
+    return loss, dstage, dhead, dmicro
 
 
 def pipeline_apply(

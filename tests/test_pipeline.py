@@ -74,3 +74,63 @@ def test_pipeline_rejects_bad_microbatching(devices):
     x = jnp.zeros((10, 8))
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(stack_stage_params(stages), x, mlp_stage, mesh, n_microbatches=4)
+
+
+def test_1f1b_matches_sequential_grads(devices):
+    """1F1B (per-microbatch backward interleaved with forwards, live
+    activations bounded by pipe depth) must produce the same loss and the
+    same stage/head/input gradients as jax.grad over the sequential stage
+    loop (the same oracle GPipe is tested against) — VERDICT r4 ask 4."""
+    from jax.sharding import PartitionSpec as P
+
+    from solvingpapers_tpu.sharding.pipeline import (
+        pipeline_1f1b_value_and_grad,
+    )
+
+    n_stages, d, m, mb = 4, 8, 8, 2
+    mesh = create_mesh(MeshConfig(data=1, pipe=n_stages), devices[:4])
+    stages = make_stages(jax.random.key(2), n_stages, d=d, h=16)
+    stacked = stack_stage_params(stages)
+    head = {"w": jax.random.normal(jax.random.key(5), (d, d)) * 0.3}
+    micro = jax.random.normal(jax.random.key(3), (m, mb, d))
+    targets = jax.random.normal(jax.random.key(4), (m, mb, d))
+
+    def loss_fn(hp, y, t):
+        return jnp.mean((y @ hp["w"] - t) ** 2)
+
+    def seq_loss(stages, head, micro):
+        losses = []
+        for i in range(m):
+            x = micro[i]
+            for p in stages:
+                x = mlp_stage(p, x)
+            losses.append(loss_fn(head, x, targets[i]))
+        return jnp.mean(jnp.stack(losses))
+
+    l_ref, (dstage_ref, dhead_ref, dmicro_ref) = jax.value_and_grad(
+        seq_loss, argnums=(0, 1, 2)
+    )(stages, head, micro)
+
+    def f1b(stage_local, head, micro, targets):
+        return pipeline_1f1b_value_and_grad(
+            stage_local, head, micro, targets, mlp_stage, loss_fn
+        )
+
+    l_new, dstage_new, dhead_new, dmicro_new = jax.shard_map(
+        f1b, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P("pipe"), stacked), P(), P(), P()),
+        out_specs=(P(), jax.tree.map(lambda _: P("pipe"), stacked), P(),
+                   P()),
+    )(stacked, head, micro, targets)
+
+    np.testing.assert_allclose(float(l_new), float(l_ref), rtol=1e-6)
+    dstage_ref_stacked = stack_stage_params(dstage_ref)
+    for a, b in zip(jax.tree.leaves(dstage_new),
+                    jax.tree.leaves(dstage_ref_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(dhead_new), jax.tree.leaves(dhead_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dmicro_new), np.asarray(dmicro_ref),
+                               rtol=1e-5, atol=1e-6)

@@ -88,6 +88,7 @@ def _body(n_stages: int, batch: int) -> None:
                           "(per-tick overhead dominates at this scale)"}))
 
     _memory_body(n_stages)
+    _memory_body_1f1b(n_stages)
 
 
 def _memory_body(n_stages: int) -> None:
@@ -139,6 +140,74 @@ def _memory_body(n_stages: int) -> None:
                 "argument_mb": round(stats.argument_size_in_bytes / 2**20, 1),
             }
         }), flush=True)
+
+
+def _memory_body_1f1b(n_stages: int) -> None:
+    """1F1B memory row (VERDICT r4 ask 4): same GPT stages, same 16
+    microbatches, loss+grads in ONE pass via
+    sharding.pipeline.pipeline_1f1b_value_and_grad — peak temp memory must
+    beat both the single-flush GPipe backward (residuals ∝ total
+    microbatches) and pp_grad_groups (residuals ∝ one group, but one
+    fill+drain bubble per group) at equal microbatch count."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from solvingpapers_tpu import ops
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipe, GPTPipeConfig
+    from solvingpapers_tpu.models.layers import LayerNorm
+    from solvingpapers_tpu.sharding import MeshConfig, create_mesh
+    from solvingpapers_tpu.sharding.pipeline import (
+        pipeline_1f1b_value_and_grad,
+    )
+
+    batch, seq, dim, m = 64, 512, 256, 16
+    mesh = create_mesh(MeshConfig(data=1, pipe=n_stages),
+                       jax.devices()[:n_stages])
+    cfg = GPTPipeConfig(
+        vocab_size=256, block_size=seq, dim=dim, n_layers=n_stages * 2,
+        n_heads=4, n_stages=n_stages, n_microbatches=m,
+        pipeline_parallel=True, remat=True,
+    )
+    model = GPTPipe(cfg)
+    x = np.random.default_rng(0).integers(0, 256, (batch, seq)).astype(np.int32)
+    y = np.roll(x, -1, 1).astype(np.int32)
+    variables = model.init({"params": jax.random.key(0)}, jnp.asarray(x))
+    p = variables["params"]
+    head = {"ln_f": p["ln_f"], "lm_head": p["lm_head"]}
+
+    def loss_fn(hp, h, target):
+        z = LayerNorm().apply({"params": hp["ln_f"]}, h)
+        return ops.cross_entropy(z @ hp["lm_head"]["kernel"], target)
+
+    def step(stages_local, head, emb, pos, xx, yy):
+        xe = jnp.take(emb["embedding"], xx, axis=0) + pos[None, :seq]
+        micro = xe.reshape(m, batch // m, seq, dim)
+        targets = yy.reshape(m, batch // m, seq)
+        return pipeline_1f1b_value_and_grad(
+            stages_local, head, micro, targets, model._stage_fn, loss_fn
+        )
+
+    pipe_spec = jax.tree.map(lambda _: P("pipe"), p["stages"])
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(pipe_spec, P(), P(), P(), P(), P()),
+        out_specs=(P(), pipe_spec, P(), P()),
+    ))
+    stats = fn.lower(
+        p["stages"], head, p["tok_emb"], p["pos_emb"], jnp.asarray(x),
+        jnp.asarray(y),
+    ).compile().memory_analysis()
+    print(json.dumps({
+        "memory_study": {
+            "schedule": "1f1b",
+            "n_microbatches_per_flush": m,
+            "temp_bytes_per_device": int(stats.temp_size_in_bytes),
+            "temp_mb_per_device": round(stats.temp_size_in_bytes / 2**20, 1),
+            "argument_mb": round(stats.argument_size_in_bytes / 2**20, 1),
+        }
+    }), flush=True)
 
 
 def main() -> int:
