@@ -25,6 +25,14 @@ Mechanics worth noting:
     scope. Batch 1 only: rows would otherwise advance at different rates
     and the contiguous cache write (one position per step) no longer
     holds.
+  * Equality caveat (measured, not hypothetical): verification computes
+    logits over a 2-3-token chunk while plain generate uses 1-token steps;
+    XLA may re-associate the reductions differently, so bf16 argmax TIES
+    can resolve differently between the two programs. On trained
+    checkpoints (peaked logits) outputs match exactly — the bench pins
+    this — and in f32 the equality tests are exact; an untrained bf16
+    model decoding near-uniform logits for hundreds of steps can diverge
+    at tie positions. Both outputs are valid greedy decodes of the model.
 """
 
 from __future__ import annotations
@@ -39,7 +47,7 @@ from solvingpapers_tpu.infer.cache import LatentCache
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "prefill_chunk"),
+    static_argnames=("model", "max_new_tokens", "prefill_chunk", "n_drafts"),
 )
 def generate_speculative(
     model,
@@ -49,34 +57,54 @@ def generate_speculative(
     max_new_tokens: int = 64,
     extra_variables: dict | None = None,
     prefill_chunk: int | None = None,
+    n_drafts: int = 1,
 ):
     """Greedy decode with MTP-draft speculation.
 
     Returns (tokens (1, S0 + max_new_tokens), stats) where stats carries
     `forwards` (main model calls in the decode loop) and `accepted`
     (drafts that verified) — tokens/forward = 1 + accepted/forwards.
-    Requires model.cfg.mtp_heads >= 1 and prompt batch 1.
+    Requires model.cfg.mtp_heads >= n_drafts and prompt batch 1.
+
+    n_drafts=2 chains BOTH trained MTP heads: head 1's layer output feeds
+    head 2 (exactly the training-time chaining, cell 33), so each
+    iteration verifies a 3-token chunk [t, d1, d2] with accept-prefix
+    semantics and commits up to 3 tokens per forward. Greedy output stays
+    IDENTICAL to plain `generate` — committed tokens only ever come from
+    the main model's argmax; drafts change speed, not content. One honest
+    caveat, documented: head 2's cache column for the newest position is
+    built from head 1's (unverified) draft embedding — a rejected draft
+    leaves that one surviving slot draft-contaminated, which can only
+    lower later acceptance, never change output.
     """
+    if n_drafts not in (1, 2):
+        raise ValueError(f"n_drafts must be 1 or 2, got {n_drafts}")
     cfg = model.cfg
-    if getattr(cfg, "mtp_heads", 0) < 1:
-        raise ValueError("speculative decode needs a model with mtp_heads >= 1")
+    if getattr(cfg, "mtp_heads", 0) < n_drafts:
+        raise ValueError(
+            f"speculative decode with n_drafts={n_drafts} needs a model "
+            f"with mtp_heads >= {n_drafts}"
+        )
     b, s0 = prompt.shape
     if b != 1:
         raise ValueError(
             "speculative decode supports batch 1: rows accept drafts at "
             "different rates, which breaks the contiguous cache write"
         )
-    if s0 < 2:
-        raise ValueError("prompt must have at least 2 tokens")
-    total = s0 + max_new_tokens + 2  # cache slack: the last chunk touches p+1
+    if s0 < n_drafts + 1:
+        raise ValueError(f"prompt must have at least {n_drafts + 1} tokens")
+    # cache slack: the last chunk touches p + n_drafts
+    total = s0 + max_new_tokens + n_drafts + 1
     limit = getattr(model, "max_positions", None)
-    # positions never exceed s0 + max_new - 1 (p = s0 + count - 1 and the
-    # loop stops at count == max_new), so full-context decodes that plain
-    # generate accepts pass here too; only the CACHE carries +2 slack
-    if limit is not None and s0 + max_new_tokens > limit:
+    # chunk positions reach s0 + max_new + n_drafts - 2 (p tops out at
+    # s0 + max_new - 2 entering the last iteration), so both the position
+    # tables and the post-min cache must cover one slot PAST that — a bare
+    # s0+max_new check would let the cache clamp shift the final chunk's
+    # write one slot left and corrupt a committed token's latent
+    if limit is not None and s0 + max_new_tokens + n_drafts - 1 > limit:
         raise ValueError(
-            f"prompt+new = {s0 + max_new_tokens} exceeds the model's "
-            f"max positions {limit}"
+            f"prompt+new+drafts = {s0 + max_new_tokens + n_drafts - 1} "
+            f"exceeds the model's max positions {limit}"
         )
     total = min(total, limit) if limit is not None else total
     if prefill_chunk is None and s0 > 4096:
@@ -109,27 +137,64 @@ def generate_speculative(
     # ---- prefill the MTP head's cache over positions [0, s0-1) (the
     # next-token embeddings are the prompt itself there) — chunked like the
     # main prefill so long prompts neither hit the flash kernel's q-block
-    # limit nor materialize an (s0, s0) dense score tensor
+    # limit nor materialize an (s0, s0) dense score tensor. With
+    # n_drafts=2, collect head 1's layer output y1 — it is head 2's input
+    # stream (the training-time chaining, cell 33).
+    y1s = []
     for start in range(0, s0 - 1, chunk_size):
         end = min(start + chunk_size, s0 - 1)
-        _, _, mtp_cache, _ = mtp_head_apply(
+        _, y1, mtp_cache, _ = mtp_head_apply(
             cfg, params, moe_state, h_all[:, start:end],
             prompt[:, start + 1 : end + 1],
             jnp.broadcast_to(jnp.arange(start, end), (1, end - start)),
             cache=mtp_cache, attend_len=end,
         )
+        y1s.append(y1)
 
     t1 = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)  # (1,)
     # bootstrap draft at position s0-1 (h of the prompt's last token +
     # the embedding of the just-decoded t1) -> predicts position s0+1
-    g, _, mtp_cache, _ = mtp_head_apply(
+    g, y1_last, mtp_cache, _ = mtp_head_apply(
         cfg, params, moe_state, h_all[:, -1:], t1[:, None],
         jnp.full((1, 1), s0 - 1), cache=mtp_cache,
     )
     d0 = jnp.argmax(g[:, -1], axis=-1).astype(prompt.dtype)
 
-    out = jnp.zeros((max_new_tokens + 2,), prompt.dtype)
+    mtp2_cache = d2_0 = None
+    if n_drafts == 2:
+        # head 2's cache over positions [0, s0-2): merged(y1_i,
+        # emb(token_{i+2})) — both verified there
+        mtp2_cache = LatentCache.init(
+            1, total, cfg.latent_dim + cfg.rope_dim, cfg.compute_dtype
+        )
+        y1_all = jnp.concatenate([*y1s, y1_last], axis=1)  # (1, s0, D)
+        for start in range(0, s0 - 2, chunk_size):
+            end = min(start + chunk_size, s0 - 2)
+            _, _, mtp2_cache, _ = mtp_head_apply(
+                cfg, params, moe_state, y1_all[:, start:end],
+                prompt[:, start + 2 : end + 2],
+                jnp.broadcast_to(jnp.arange(start, end), (1, end - start)),
+                cache=mtp2_cache, attend_len=end, head=2,
+            )
+        # bootstrap head 2 over columns [s0-2, s0-1]: next tokens are the
+        # decoded t1 (@s0, verified) and head 1's draft d0 (@s0+1) —
+        # column s0-1's cache slot carries the documented draft taint
+        g2, _, mtp2_cache, _ = mtp_head_apply(
+            cfg, params, moe_state, y1_all[:, s0 - 2 : s0],
+            jnp.stack([t1[0], d0[0]])[None, :],
+            jnp.broadcast_to(jnp.arange(s0 - 2, s0), (1, 2)),
+            cache=mtp2_cache, head=2,
+        )
+        d2_0 = jnp.argmax(g2[:, -1], axis=-1).astype(prompt.dtype)
+
+    out = jnp.zeros((max_new_tokens + n_drafts + 1,), prompt.dtype)
     out = out.at[0].set(t1[0])
+
+    if n_drafts == 2:
+        return _speculative_loop_2(
+            model, variables, cfg, params, moe_state, prompt, t1, d0, d2_0,
+            caches, mtp_cache, mtp2_cache, out, s0, max_new_tokens,
+        )
 
     def cond(carry):
         return carry[3] < max_new_tokens
@@ -175,5 +240,74 @@ def generate_speculative(
     _, _, _, _, _, _, out, forwards, accepts = jax.lax.while_loop(
         cond, body, carry0
     )
+    tokens = jnp.concatenate([prompt, out[None, :max_new_tokens]], axis=1)
+    return tokens, {"forwards": forwards, "accepted": accepts}
+
+
+def _speculative_loop_2(model, variables, cfg, params, moe_state, prompt,
+                        t1, d1_0, d2_0, caches, mtp1_cache, mtp2_cache, out,
+                        s0, max_new_tokens):
+    """Decode loop for n_drafts=2: verify 3-token chunks [t, d1, d2] with
+    accept-prefix semantics (a = 0, 1 or 2 accepted drafts), committing
+    1 + a tokens per main forward (cap 3). Draft refresh chains the heads:
+    head 1 redrafts from the chunk's hiddens at column a, head 2 from
+    head 1's layer output with head 1's fresh draft as its next-token
+    embedding at column a."""
+    from solvingpapers_tpu.models.deepseekv3 import mtp_head_apply
+
+    def cond(carry):
+        return carry[4] < max_new_tokens
+
+    def body(carry):
+        t, d1, d2, p, count, caches, c1, c2, out, forwards, accepts = carry
+        chunk = jnp.stack([t[0], d1[0], d2[0]])[None, :]  # (1, 3)
+        positions = (p + jnp.arange(3))[None, :]
+        (l, h3), caches = model.apply(
+            variables, chunk, positions=positions, caches=caches,
+            deterministic=True, return_hidden=True,
+        )
+        true1 = jnp.argmax(l[:, 0], axis=-1).astype(t.dtype)  # tok @ p+1
+        true2 = jnp.argmax(l[:, 1], axis=-1).astype(t.dtype)  # @ p+2 if ok1
+        t3 = jnp.argmax(l[:, 2], axis=-1).astype(t.dtype)     # @ p+3 if ok2
+        ok1 = true1[0] == d1[0]
+        ok2 = ok1 & (true2[0] == d2[0])
+        a = ok1.astype(jnp.int32) + ok2.astype(jnp.int32)
+
+        out1 = jax.lax.dynamic_update_index_in_dim(out, true1[0], count, 0)
+        out2 = jax.lax.dynamic_update_index_in_dim(out1, true2[0], count + 1, 0)
+        out2 = jnp.where(ok1, out2, out1)
+        out3 = jax.lax.dynamic_update_index_in_dim(out2, t3[0], count + 2, 0)
+        out = jnp.where(ok2, out3, out2)
+
+        # head 1 over the 3 columns; its next-token stream is the main
+        # model's verified argmaxes (garbage columns are either never
+        # selected or overwritten by the next chunk)
+        next1 = jnp.stack([true1[0], true2[0], t3[0]])[None, :]
+        g1, y1, c1, _ = mtp_head_apply(
+            cfg, params, moe_state, h3, next1, positions, cache=c1,
+        )
+        d1n = jnp.argmax(jnp.take(g1[0], a, axis=0), axis=-1).astype(t.dtype)
+
+        # head 2 over the same columns on head 1's layer output; column a
+        # (the newest surviving slot) embeds head 1's FRESH draft — the
+        # only token at that offset that exists yet (documented taint)
+        next2 = jnp.stack([true2[0], t3[0], t3[0]])
+        next2 = next2.at[a].set(d1n)
+        g2, _, c2, _ = mtp_head_apply(
+            cfg, params, moe_state, y1, next2[None, :], positions,
+            cache=c2, head=2,
+        )
+        d2n = jnp.argmax(jnp.take(g2[0], a, axis=0), axis=-1).astype(t.dtype)
+
+        t_next = jnp.take(jnp.stack([true1[0], true2[0], t3[0]]), a)[None]
+        p_next = p + 1 + a.astype(p.dtype)
+        count_next = count + 1 + a.astype(count.dtype)
+        return (t_next, d1n[None], d2n[None], p_next, count_next, caches,
+                c1, c2, out, forwards + 1, accepts + a.astype(forwards.dtype))
+
+    carry0 = (t1, d1_0, d2_0, jnp.asarray(s0), jnp.asarray(1), caches,
+              mtp1_cache, mtp2_cache, out, jnp.asarray(0), jnp.asarray(0))
+    res = jax.lax.while_loop(cond, body, carry0)
+    out, forwards, accepts = res[8], res[9], res[10]
     tokens = jnp.concatenate([prompt, out[None, :max_new_tokens]], axis=1)
     return tokens, {"forwards": forwards, "accepted": accepts}

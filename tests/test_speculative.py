@@ -88,6 +88,96 @@ def test_speculative_accepts_on_predictable_stream():
     assert int(stats["forwards"]) < new  # strictly fewer forwards
 
 
+TINY2 = dc.replace(TINY, mtp_heads=2)
+
+
+@pytest.mark.parametrize("new", [5, 16])
+def test_speculative_2draft_equals_plain_greedy(new):
+    """Chained 2-head drafts: greedy output identical to plain generate,
+    even when untrained drafts mostly reject."""
+    model = DeepSeekV3(TINY2)
+    prompt = jax.random.randint(jax.random.key(0), (1, 9), 0, TINY2.vocab_size)
+    variables = model.init({"params": jax.random.key(1)}, prompt,
+                           return_mtp=True)
+    extra = {"moe_state": variables["moe_state"]}
+    params = variables["params"]
+    plain = generate(model, params, prompt, jax.random.key(9),
+                     max_new_tokens=new, sampler=ops.sample_greedy,
+                     extra_variables=extra, max_len=prompt.shape[1] + new + 3)
+    spec, stats = generate_speculative(
+        model, params, prompt, max_new_tokens=new, extra_variables=extra,
+        n_drafts=2,
+    )
+    np.testing.assert_array_equal(np.asarray(spec[:, : prompt.shape[1] + new]),
+                                  np.asarray(plain))
+    f, a = int(stats["forwards"]), int(stats["accepted"])
+    # each forward commits 1 + (accepted this iter); overshoot <= 2
+    assert new <= f + a + 1 <= new + 2, (f, a)
+    assert 0 <= a <= 2 * f
+
+
+def test_speculative_2draft_beats_single_on_predictable_stream():
+    """On a memorized periodic stream the chained drafts must push
+    tokens/forward ABOVE the single-draft cap of 2."""
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+    from solvingpapers_tpu.train.objectives import dsv3_init_fn, dsv3_loss_fn
+
+    model = DeepSeekV3(TINY2)
+    toks = np.tile(np.arange(8), 4000)
+    tcfg = TrainConfig(
+        steps=150, batch_size=8, log_every=1000, eval_every=0,
+        optimizer=OptimizerConfig(max_lr=3e-3, warmup_steps=10,
+                                  total_steps=150),
+    )
+    trainer = Trainer(model, tcfg, loss_fn=dsv3_loss_fn, init_fn=dsv3_init_fn)
+    state = trainer.fit(lm_batch_iterator(toks, 8, 32, seed=0))
+    params = jax.device_get(state.params)
+    extra = {"moe_state": jax.device_get(state.model_state)["moe_state"]}
+
+    prompt = jnp.asarray(np.tile(np.arange(8), 2)[None, :], jnp.int32)
+    new = 24
+    plain = generate(model, params, prompt, jax.random.key(0),
+                     max_new_tokens=new, sampler=ops.sample_greedy,
+                     extra_variables=extra, max_len=prompt.shape[1] + new + 3)
+    spec, stats = generate_speculative(
+        model, params, prompt, max_new_tokens=new, extra_variables=extra,
+        n_drafts=2,
+    )
+    np.testing.assert_array_equal(np.asarray(spec[:, : prompt.shape[1] + new]),
+                                  np.asarray(plain))
+    f, a = int(stats["forwards"]), int(stats["accepted"])
+    tpf = 1 + a / f
+    assert tpf > 2.0, dict(stats)  # beyond the single-draft cap
+
+
+def test_speculative_2draft_full_context_edge():
+    """Full-context decode (s0 + new + n_drafts - 1 == block_size): the
+    cache must NOT clamp the final 3-token chunk's write (a clamped
+    dynamic_update_slice would shift the write one slot left and corrupt a
+    committed token's latent — code-review r5 finding)."""
+    cfg = dc.replace(TINY2, block_size=48)
+    model = DeepSeekV3(cfg)
+    s0 = 16
+    new = cfg.block_size - s0 - 1  # 31: exactly at the position limit
+    prompt = jax.random.randint(jax.random.key(2), (1, s0), 0, cfg.vocab_size)
+    variables = model.init({"params": jax.random.key(1)}, prompt,
+                           return_mtp=True)
+    extra = {"moe_state": variables["moe_state"]}
+    params = variables["params"]
+    plain = generate(model, params, prompt, jax.random.key(9),
+                     max_new_tokens=new, sampler=ops.sample_greedy,
+                     extra_variables=extra)
+    spec, _ = generate_speculative(model, params, prompt, max_new_tokens=new,
+                                   extra_variables=extra, n_drafts=2)
+    np.testing.assert_array_equal(np.asarray(spec[:, : s0 + new]),
+                                  np.asarray(plain[:, : s0 + new]))
+    # one past the limit must raise, not silently clamp
+    with pytest.raises(ValueError, match="max positions"):
+        generate_speculative(model, params, prompt, max_new_tokens=new + 1,
+                             extra_variables=extra, n_drafts=2)
+
+
 def test_speculative_rejects_bad_inputs():
     model, params, prompt, extra = _setup()
     with pytest.raises(ValueError, match="batch 1"):
