@@ -16,6 +16,7 @@ failure records {"error": ...} instead of killing the bench.
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -254,12 +255,29 @@ def bench_decode_16k_prefill():
     Prefill and decode are each timed DIRECTLY as separate jitted programs
     over the same cache state — round 3 subtracted two independently
     measured end-to-end runs and the noise-dominated difference produced a
-    nonsense decode number (VERDICT r3 'what's weak' #1)."""
+    nonsense decode number (VERDICT r3 'what's weak' #1).
+
+    Decode timing (round 5): the tunnelled platform carries a measured
+    ~110 ms FIXED latency per program execution (a jitted x+1 round-trips
+    in 110 ms; a 1000-step trivial scan in 108 ms), so round 4's
+    "3.9 ms/token" over a 32-token scan was ~3.4 ms/token of tunnel
+    overhead, not decode. The steady-state number a real serving loop
+    sees is the MARGINAL cost — (T(128 tokens) - T(32 tokens)) / 96 —
+    reported as decode_ms_per_token with both raw walls kept for audit.
+    The same profiling killed the planned blockwise cached-decode kernel
+    with data: per-token time is FLAT in cache length (1.61 ms @ 4k vs
+    1.75 ms @ 16k cache) and nearly flat in depth (1.50 ms @ 1 layer vs
+    1.75 @ 6), i.e. bs-1 decode is per-op-overhead-bound, not
+    attention-bound — so the lever is batch, and the bs=8 row below
+    amortizes exactly that."""
     from solvingpapers_tpu import ops
     from solvingpapers_tpu.models.deepseekv3 import DeepSeekV3, DeepSeekV3Config
 
     prompt_len, new, chunk = 16_384, 32, 2048
-    total = prompt_len + new
+    new_long = 128
+    # the cache/position budget must cover the LONG timing arm — 32 slots
+    # would silently clamp the 128-token program's tail writes
+    total = prompt_len + new_long
     cfg = DeepSeekV3Config(
         vocab_size=32_000, block_size=total, dtype="bfloat16",
         use_flash=True, pe_scale=0.02, rope_dim=64, dropout=0.0,
@@ -287,13 +305,15 @@ def bench_decode_16k_prefill():
             )
         return logits, caches
 
-    @jax.jit
-    def decode(variables, first_tok, caches, rng):
+    @functools.partial(jax.jit, static_argnames=("length",))
+    def decode(variables, first_tok, caches, rng, length=new):
+        b = first_tok.shape[0]
+
         def body(carry, _):
             tok, pos, caches, rng = carry
             logits, caches = model.apply(
                 variables, tok[:, None],
-                positions=jnp.broadcast_to(pos[None, None], (1, 1)),
+                positions=jnp.broadcast_to(pos[None, None], (b, 1)),
                 caches=caches, deterministic=True,
             )
             rng, sub = jax.random.split(rng)
@@ -302,7 +322,7 @@ def bench_decode_16k_prefill():
 
         _, toks = jax.lax.scan(
             body, (first_tok, jnp.asarray(prompt_len), caches, rng), None,
-            length=new,
+            length=length,
         )
         return toks
 
@@ -317,20 +337,43 @@ def bench_decode_16k_prefill():
         for _ in range(3)
     )
     first_tok = ops.sample_greedy(logits[:, -1], rng).astype(prompt.dtype)
-    _fence(jnp.sum(decode(variables, first_tok, caches, rng)))  # compile
-    decode_s = min(
-        (lambda t0: (
-            _fence(jnp.sum(decode(variables, first_tok, caches, rng))),
-            time.perf_counter() - t0,
-        )[1])(time.perf_counter())
-        for _ in range(3)
-    )
+
+    def time_decode(tok, caches, length):
+        _fence(jnp.sum(decode(variables, tok, caches, rng, length=length)))
+        return min(
+            (lambda t0: (
+                _fence(jnp.sum(
+                    decode(variables, tok, caches, rng, length=length)
+                )),
+                time.perf_counter() - t0,
+            )[1])(time.perf_counter())
+            for _ in range(3)
+        )
+
+    t_short = time_decode(first_tok, caches, new)
+    t_long = time_decode(first_tok, caches, new_long)
+    marginal_s = max(t_long - t_short, 1e-9) / (new_long - new)
+
+    # bs=8 decode over the same 16k-deep cache (per-op overhead amortizes
+    # across the batch; prompt processing replicated via tiled caches)
+    bs = 8
+    caches8 = jax.tree.map(lambda a: jnp.tile(a, (bs,) + (1,) * (a.ndim - 1)),
+                           caches)
+    tok8 = jnp.tile(first_tok, (bs,))
+    t8_short = time_decode(tok8, caches8, new)
+    t8_long = time_decode(tok8, caches8, new_long)
+    marginal8_s = max(t8_long - t8_short, 1e-9) / (new_long - new)
+
     return {
         "prompt": prompt_len, "new": new,
         "prefill_s": round(prefill_s, 3),
         "prefill_tokens_per_sec": round(prompt_len / prefill_s),
-        "decode_tokens_per_sec": round(new / decode_s),
-        "decode_ms_per_token": round(decode_s / new * 1e3, 3),
+        "decode_tokens_per_sec": round(1.0 / marginal_s),
+        "decode_ms_per_token": round(marginal_s * 1e3, 3),
+        "decode_wall_s_32": round(t_short, 3),
+        "decode_wall_s_128": round(t_long, 3),
+        "decode_bs8_tokens_per_sec": round(bs / marginal8_s),
+        "decode_bs8_ms_per_token": round(marginal8_s * 1e3 / bs, 3),
     }
 
 
@@ -353,7 +396,7 @@ def bench_speculative_decode():
     cfg = DeepSeekV3Config(
         vocab_size=64, block_size=512, dim=512, n_layers=6, n_heads=8,
         latent_dim=64, rope_dim=32, pe_scale=0.02, n_experts=8,
-        top_experts=2, dropout=0.0, attn_dropout=0.0, mtp_heads=1,
+        top_experts=2, dropout=0.0, attn_dropout=0.0, mtp_heads=2,
         dtype="bfloat16",
     )
     model = DeepSeekV3(cfg)
@@ -386,10 +429,11 @@ def bench_speculative_decode():
                         sampler=ops.sample_greedy, extra_variables=extra,
                         max_len=prompt.shape[1] + new + 2)
 
-    def spec():
+    def spec(n_drafts=1):
         return generate_speculative(model, params, prompt,
                                     max_new_tokens=new,
-                                    extra_variables=extra)
+                                    extra_variables=extra,
+                                    n_drafts=n_drafts)
 
     _fence(jnp.sum(plain()[:, -1]))
     plain_s = min(
@@ -397,15 +441,23 @@ def bench_speculative_decode():
                      time.perf_counter() - t0)[1])(time.perf_counter())
         for _ in range(3)
     )
-    out, stats = spec()
-    _fence(jnp.sum(out[:, -1]))
-    spec_s = min(
-        (lambda t0: (_fence(jnp.sum(spec()[0][:, -1])),
-                     time.perf_counter() - t0)[1])(time.perf_counter())
-        for _ in range(3)
-    )
-    f = int(jax.device_get(stats["forwards"]))
-    a = int(jax.device_get(stats["accepted"]))
+
+    def time_spec(n_drafts):
+        out, stats = spec(n_drafts)
+        _fence(jnp.sum(out[:, -1]))
+        s = min(
+            (lambda t0: (_fence(jnp.sum(spec(n_drafts)[0][:, -1])),
+                         time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(3)
+        )
+        f = int(jax.device_get(stats["forwards"]))
+        a = int(jax.device_get(stats["accepted"]))
+        return s, f, a
+
+    spec_s, f, a = time_spec(1)
+    # chained 2-head drafts (round 5): both trained MTP heads draft, cap 3
+    # tokens/forward — must push tokens/forward past the 1-draft cap of 2
+    spec2_s, f2, a2 = time_spec(2)
     return {
         "new_tokens": new,
         "forwards": f,
@@ -414,6 +466,11 @@ def bench_speculative_decode():
         "plain_ms_per_token": round(plain_s / new * 1e3, 3),
         "spec_ms_per_token": round(spec_s / new * 1e3, 3),
         "wall_speedup": round(plain_s / spec_s, 3),
+        "draft2_forwards": f2,
+        "draft2_accepted": a2,
+        "draft2_tokens_per_forward": round((f2 + a2) / max(f2, 1), 3),
+        "draft2_ms_per_token": round(spec2_s / new * 1e3, 3),
+        "draft2_wall_speedup": round(plain_s / spec2_s, 3),
     }
 
 
