@@ -294,7 +294,19 @@ def _gemma_markov() -> RunConfig:
         model=GemmaConfig(vocab_size=64, max_seq_len=256, dim=256, n_layers=4,
                           n_heads=4, n_kv_heads=2, dropout=0.0, dtype="bfloat16"),
         train=_markov_train(3000, 64, 256),
-        data=dict(_MARKOV_DATA),
+        # capacity-matched corpus (VERDICT r4 ask 6 — the 0.139-nat outlier
+        # diagnosed): the round-5 ablation (tools/gemma_markov_ablation.py,
+        # 3000 steps each on the v5e) cleared the verdict's suspect list —
+        # full-MHA 0.144 and SwiGLU-activation 0.132 sit AT the 0.139
+        # baseline, so neither grouped-MQA nor GeGLU is the cause — while
+        # 16M chars drops the gap to 0.044, best of the dense zoo. Gemma's
+        # FFN carries ~2.25x llama3_markov's FFN params (4*dim GeGLU hidden
+        # vs (2/3)*4*dim SwiGLU, 4 layers vs 3), so on the shared 4M-char
+        # corpus it memorizes like dsv3 did in r4; the honest fix is the
+        # same capacity-matched 16M-char source, not a schedule or
+        # architecture change (supporting evidence: lr 5e-4 and 3-layer
+        # variants land at 0.093/0.097 by REDUCING fit, not generalizing).
+        data={**_MARKOV_DATA, "n_chars": 16_000_000},
         notes="entropy-calibrated quality row; target val_loss -> H ~= 2.362",
     )
 
@@ -740,8 +752,13 @@ def _vit_bayes() -> RunConfig:
             # matched filter and unregularized nets overfit the per-pixel
             # noise (measured: wd 0.1 closes the val gap 0.085 -> 0.022 on
             # the MLP); 32k train samples bound the estimation error
+            # eval_batches 64 (8192 samples): binomial eval noise at
+            # p~0.84 is sigma~0.004, so the parity gate's 0.02 tolerance
+            # sits 5 sigma out instead of 2.5 (VERDICT r4 ask 9 — the
+            # steps stay pinned at 2000 so the row remains gate-comparable
+            # across rounds; only the eval got less noisy)
             steps=2000, batch_size=128, log_every=100, eval_every=500,
-            eval_batches=16,
+            eval_batches=64,
             optimizer=OptimizerConfig(
                 name="adamw", max_lr=1e-3, warmup_steps=0, total_steps=2000,
                 min_lr_ratio=0.1, weight_decay=0.1, grad_clip=1.0,
@@ -766,9 +783,10 @@ def _kd_bayes() -> RunConfig:
         model=student_config(),
         train=TrainConfig(
             # see vit_bayes: wd + cosine + 32k samples keep the student at
-            # the matched filter instead of the training noise
+            # the matched filter instead of the training noise;
+            # eval_batches widened like vit_bayes (gate-noise margin)
             steps=4000, batch_size=64, log_every=200, eval_every=1000,
-            eval_batches=16,
+            eval_batches=64,
             optimizer=OptimizerConfig(name="adamw", max_lr=1e-3, warmup_steps=0,
                                       total_steps=4000, weight_decay=0.1,
                                       grad_clip=1.0, min_lr_ratio=0.1),

@@ -216,6 +216,11 @@ def main() -> int:
     args = p.parse_args()
 
     div = 8 if args.fast else 1
+    # STEP COUNTS ARE PINNED (VERDICT r4 ask 9): the regression gate only
+    # compares rows whose `steps` match a prior round's, so changing a
+    # row's schedule silently disengages its gate. Tune eval noise (e.g.
+    # eval_batches) or data instead; if a schedule truly must change,
+    # record one transition round where BOTH step counts run.
     plan = [
         ("gpt_shakespeare", _run_lm, 1000 // div, args.data_path),
         ("dsv3_tinystories", _run_lm, 2000 // div, args.data_path),
