@@ -212,12 +212,18 @@ def bench_flash_mla_16k():
 
 def bench_decode():
     """Cached scan decode (llama3 d1024 L24) — the reference re-runs the
-    full forward per token (SURVEY.md §3.4)."""
+    full forward per token (SURVEY.md §3.4).
+
+    Round 5: marginal timing — (T(256 new) - T(64 new)) / 192 — cancels
+    the tunnel's ~110 ms fixed per-program latency, which was ~20% of the
+    256-token wall and the round-to-round noise in this row (r3 3664,
+    r4 3929, r5 quiet re-run 3626 'tok/s' under the old end-to-end
+    method, all the same device). Raw walls stay in the row for audit."""
     from solvingpapers_tpu import ops
     from solvingpapers_tpu.infer import generate
     from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
 
-    bs, prompt_len, new = 8, 128, 256
+    bs, prompt_len, new, new_short = 8, 128, 256, 64
     cfg = LlamaConfig(
         vocab_size=32_000, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
         max_seq_len=prompt_len + new, dropout=0.0, dtype="bfloat16",
@@ -230,22 +236,40 @@ def bench_decode():
     params = model.init({"params": jax.random.key(0)}, prompt)["params"]
     rng = jax.random.key(1)
 
-    def run():
-        return generate(model, params, prompt, rng, max_new_tokens=new,
-                        sampler=ops.sample_greedy)
+    def timed(n_new):
+        def run():
+            return generate(model, params, prompt, rng, max_new_tokens=n_new,
+                            sampler=ops.sample_greedy,
+                            max_len=prompt_len + new)
 
-    _fence(jnp.sum(run()[:, -1]))  # compile
-    best = min(
-        (lambda t0: (_fence(jnp.sum(run()[:, -1])), time.perf_counter() - t0)[1])(
-            time.perf_counter()
+        _fence(jnp.sum(run()[:, -1]))  # compile
+        return min(
+            (lambda t0: (_fence(jnp.sum(run()[:, -1])),
+                         time.perf_counter() - t0)[1])(time.perf_counter())
+            for _ in range(3)
         )
-        for _ in range(3)
-    )
-    return {
+
+    t_long = timed(new)
+    t_short = timed(new_short)
+    # transition round: `tokens_per_sec` keeps the END-TO-END method so the
+    # vs_prior gate compares like with like; the marginal figure rides
+    # alongside and becomes the gated key next round
+    row = {
         "bs": bs, "prompt": prompt_len, "new": new,
-        "tokens_per_sec": round(bs * new / best),
-        "ms_per_token": round(best / new * 1e3, 3),
+        "tokens_per_sec": round(bs * new / t_long),
+        "ms_per_token": round(t_long / new * 1e3, 3),
+        "wall_s_64": round(t_short, 3),
+        "wall_s_256": round(t_long, 3),
     }
+    if t_long > t_short:
+        marginal = (t_long - t_short) / (new - new_short)
+        row["tokens_per_sec_marginal"] = round(bs / marginal)
+        row["ms_per_token_marginal"] = round(marginal * 1e3, 3)
+    else:
+        # separate min-of-3 runs crossed on the noisy tunnel — record the
+        # failure instead of clamping into an absurd-looking number
+        row["marginal_error"] = "t_long <= t_short; marginal unmeasurable"
+    return row
 
 
 def bench_decode_16k_prefill():
@@ -263,7 +287,9 @@ def bench_decode_16k_prefill():
     "3.9 ms/token" over a 32-token scan was ~3.4 ms/token of tunnel
     overhead, not decode. The steady-state number a real serving loop
     sees is the MARGINAL cost — (T(128 tokens) - T(32 tokens)) / 96 —
-    reported as decode_ms_per_token with both raw walls kept for audit.
+    reported in the *_marginal keys with both raw walls kept for audit
+    (the unsuffixed keys keep the r4-comparable end-to-end method for one
+    transition round so the vs_prior gate compares like with like).
     The same profiling killed the planned blockwise cached-decode kernel
     with data: per-token time is FLAT in cache length (1.61 ms @ 4k vs
     1.75 ms @ 16k cache) and nearly flat in depth (1.50 ms @ 1 layer vs
@@ -352,7 +378,6 @@ def bench_decode_16k_prefill():
 
     t_short = time_decode(first_tok, caches, new)
     t_long = time_decode(first_tok, caches, new_long)
-    marginal_s = max(t_long - t_short, 1e-9) / (new_long - new)
 
     # bs=8 decode over the same 16k-deep cache (per-op overhead amortizes
     # across the batch; prompt processing replicated via tiled caches)
@@ -362,19 +387,37 @@ def bench_decode_16k_prefill():
     tok8 = jnp.tile(first_tok, (bs,))
     t8_short = time_decode(tok8, caches8, new)
     t8_long = time_decode(tok8, caches8, new_long)
-    marginal8_s = max(t8_long - t8_short, 1e-9) / (new_long - new)
 
-    return {
+    # transition round: `decode_tokens_per_sec` keeps the END-TO-END
+    # method (r4-comparable; dominated by the ~110 ms tunnel latency at 32
+    # tokens — see docstring); the marginal keys carry the honest
+    # steady-state figure and become the gated keys next round
+    row = {
         "prompt": prompt_len, "new": new,
         "prefill_s": round(prefill_s, 3),
         "prefill_tokens_per_sec": round(prompt_len / prefill_s),
-        "decode_tokens_per_sec": round(1.0 / marginal_s),
-        "decode_ms_per_token": round(marginal_s * 1e3, 3),
+        "decode_tokens_per_sec": round(new / t_short),
+        "decode_ms_per_token": round(t_short / new * 1e3, 3),
         "decode_wall_s_32": round(t_short, 3),
         "decode_wall_s_128": round(t_long, 3),
-        "decode_bs8_tokens_per_sec": round(bs / marginal8_s),
-        "decode_bs8_ms_per_token": round(marginal8_s * 1e3 / bs, 3),
     }
+    if t_long > t_short:
+        marginal_s = (t_long - t_short) / (new_long - new)
+        row["decode_tokens_per_sec_marginal"] = round(1.0 / marginal_s)
+        row["decode_ms_per_token_marginal"] = round(marginal_s * 1e3, 3)
+    else:
+        row["decode_marginal_error"] = (
+            "t_long <= t_short; marginal unmeasurable"
+        )
+    if t8_long > t8_short:
+        marginal8_s = (t8_long - t8_short) / (new_long - new)
+        row["decode_bs8_tokens_per_sec"] = round(bs / marginal8_s)
+        row["decode_bs8_ms_per_token"] = round(marginal8_s * 1e3 / bs, 3)
+    else:
+        row["decode_bs8_marginal_error"] = (
+            "t_long <= t_short; marginal unmeasurable"
+        )
+    return row
 
 
 def bench_speculative_decode():

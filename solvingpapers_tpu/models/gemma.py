@@ -47,6 +47,9 @@ class GemmaConfig:
     n_heads: int = 4
     n_kv_heads: int = 2
     hidden_dim: int | None = None  # None => 4*dim (GeGLU, cell 9)
+    # FFN gate activation: "gelu_tanh" (GeGLU, cell 9 — notebook parity)
+    # or "silu" (SwiGLU) — an ablation knob (tools/gemma_markov_ablation)
+    activation: str = "gelu_tanh"
     rope_theta: float = 10000.0
     norm_eps: float = 1e-6
     dropout: float = 0.1
@@ -102,7 +105,7 @@ class GemmaBlock(nn.Module):
         h = GLUFFN(
             dim=cfg.dim,
             hidden_dim=cfg.ffn_hidden,
-            activation=ops.gelu_tanh,
+            activation=getattr(ops, cfg.activation),
             dtype=cfg.compute_dtype,
             name="ffn",
         )(RMSNorm(eps=cfg.norm_eps, name="ffn_norm")(x))

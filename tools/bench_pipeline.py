@@ -89,9 +89,14 @@ def _body(n_stages: int, batch: int) -> None:
 
     _memory_body(n_stages)
     _memory_body_1f1b(n_stages)
+    # production-ish scale (~100M stage stack, dim 1024, seq 1024):
+    # memory_analysis is compile-only, so the CPU mesh measures it fine
+    _memory_body(n_stages, batch=16, seq=1024, dim=1024)
+    _memory_body_1f1b(n_stages, batch=16, seq=1024, dim=1024)
 
 
-def _memory_body(n_stages: int) -> None:
+def _memory_body(n_stages: int, batch: int = 64, seq: int = 512,
+                 dim: int = 256) -> None:
     """Live-memory study (BENCHMARKS.md PP memory table): XLA's compiled
     memory_analysis for the PP train step — temp_size is the peak live
     temp-buffer footprint per device, which is where the backward's saved
@@ -99,7 +104,9 @@ def _memory_body(n_stages: int) -> None:
     pp_grad_groups sequential flushes (loss+backward per group, grads
     accumulated): with n_microbatches = pipe size per flush, residual
     memory covers one group's ticks instead of the whole batch's —
-    live activations scale with n_stages, not total microbatches."""
+    live activations scale with n_stages, not total microbatches.
+    Compile-only (no execution), so production-scale dims are measurable
+    on the CPU mesh."""
     import jax
     import numpy as np
 
@@ -107,7 +114,6 @@ def _memory_body(n_stages: int) -> None:
     from solvingpapers_tpu.sharding import MeshConfig, PP_RULES, create_mesh
     from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
 
-    batch, seq, dim = 64, 512, 256
     n_micro_total = 16
     mesh_cfg = MeshConfig(data=1, pipe=n_stages)
     mesh = create_mesh(mesh_cfg, jax.devices()[:n_stages])
@@ -132,6 +138,7 @@ def _memory_body(n_stages: int) -> None:
         stats = trainer._train_step.lower(state, b0).compile().memory_analysis()
         print(json.dumps({
             "memory_study": {
+                "dim": dim, "seq": seq,
                 "pp_grad_groups": groups,
                 "n_microbatches_per_flush": n_micro_total // groups,
                 "temp_bytes_per_device": int(stats.temp_size_in_bytes),
@@ -142,7 +149,8 @@ def _memory_body(n_stages: int) -> None:
         }), flush=True)
 
 
-def _memory_body_1f1b(n_stages: int) -> None:
+def _memory_body_1f1b(n_stages: int, batch: int = 64, seq: int = 512,
+                      dim: int = 256) -> None:
     """1F1B memory row (VERDICT r4 ask 4): same GPT stages, same 16
     microbatches, loss+grads in ONE pass via
     sharding.pipeline.pipeline_1f1b_value_and_grad — peak temp memory must
@@ -162,7 +170,7 @@ def _memory_body_1f1b(n_stages: int) -> None:
         pipeline_1f1b_value_and_grad,
     )
 
-    batch, seq, dim, m = 64, 512, 256, 16
+    m = 16
     mesh = create_mesh(MeshConfig(data=1, pipe=n_stages),
                        jax.devices()[:n_stages])
     cfg = GPTPipeConfig(
@@ -201,6 +209,7 @@ def _memory_body_1f1b(n_stages: int) -> None:
     ).compile().memory_analysis()
     print(json.dumps({
         "memory_study": {
+            "dim": dim, "seq": seq,
             "schedule": "1f1b",
             "n_microbatches_per_flush": m,
             "temp_bytes_per_device": int(stats.temp_size_in_bytes),

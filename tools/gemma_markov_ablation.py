@@ -24,13 +24,11 @@ import time
 def run_variant(name: str, steps: int) -> dict:
     import jax  # noqa: F401
 
-    from solvingpapers_tpu import ops
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import (
         build_char_lm_run, init_fn_for, loss_fn_for, rules_for,
     )
     from solvingpapers_tpu.data.synthetic import markov_entropy_nats
-    from solvingpapers_tpu.models import gemma as gemma_mod
     from solvingpapers_tpu.sharding import batch_sharding, create_mesh
     from solvingpapers_tpu.train import Trainer
 
@@ -38,14 +36,12 @@ def run_variant(name: str, steps: int) -> dict:
     model_over: dict = {}
     data_over: dict = {}
     train_over: dict = {}
-    restore_act = None
 
     if name == "base":
         pass
     elif name == "silu":
-        # GeGLU -> SwiGLU activation at equal width
-        restore_act = ops.gelu_tanh
-        gemma_mod.ops.gelu_tanh = ops.silu  # GemmaBlock reads it at call time
+        # GeGLU -> SwiGLU activation at equal width (GemmaConfig knob)
+        model_over["activation"] = "silu"
     elif name == "swiglu_width":
         # llama's (2/3)*4*dim hidden at gemma's gelu gating
         from solvingpapers_tpu.models.layers import swiglu_hidden_dim
@@ -64,40 +60,36 @@ def run_variant(name: str, steps: int) -> dict:
     else:
         raise SystemExit(f"unknown variant {name}")
 
-    try:
-        if model_over:
-            cfg = dataclasses.replace(
-                cfg, model=dataclasses.replace(cfg.model, **model_over)
-            )
-        if data_over:
-            cfg = dataclasses.replace(cfg, data={**cfg.data, **data_over})
-        if train_over:
-            cfg = dataclasses.replace(
-                cfg, train=dataclasses.replace(cfg.train, **train_over)
-            )
-        mesh = create_mesh(cfg.train.mesh)
-        cfg, model, _, train_iter, eval_iter_fn = build_char_lm_run(
-            cfg, sharding=batch_sharding(mesh)
+    if model_over:
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, **model_over)
         )
-        trainer = Trainer(model, cfg.train, loss_fn=loss_fn_for(cfg),
-                          init_fn=init_fn_for(cfg), mesh=mesh,
-                          rules=rules_for(cfg))
-        t0 = time.perf_counter()
-        state = trainer.fit(train_iter)
-        val = trainer.evaluate(state, eval_iter_fn())
-        wall = time.perf_counter() - t0
-        h = markov_entropy_nats(cfg.data)
-        return {
-            "variant": name,
-            "steps": steps,
-            "val_loss": round(float(val["val_loss"]), 5),
-            "entropy_nats": round(h, 5),
-            "gap": round(float(val["val_loss"]) - h, 5),
-            "wall_s": round(wall, 1),
-        }
-    finally:
-        if restore_act is not None:
-            gemma_mod.ops.gelu_tanh = restore_act
+    if data_over:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, **data_over})
+    if train_over:
+        cfg = dataclasses.replace(
+            cfg, train=dataclasses.replace(cfg.train, **train_over)
+        )
+    mesh = create_mesh(cfg.train.mesh)
+    cfg, model, _, train_iter, eval_iter_fn = build_char_lm_run(
+        cfg, sharding=batch_sharding(mesh)
+    )
+    trainer = Trainer(model, cfg.train, loss_fn=loss_fn_for(cfg),
+                      init_fn=init_fn_for(cfg), mesh=mesh,
+                      rules=rules_for(cfg))
+    t0 = time.perf_counter()
+    state = trainer.fit(train_iter)
+    val = trainer.evaluate(state, eval_iter_fn())
+    wall = time.perf_counter() - t0
+    h = markov_entropy_nats(cfg.data)
+    return {
+        "variant": name,
+        "steps": steps,
+        "val_loss": round(float(val["val_loss"]), 5),
+        "entropy_nats": round(h, 5),
+        "gap": round(float(val["val_loss"]) - h, 5),
+        "wall_s": round(wall, 1),
+    }
 
 
 def main() -> None:
