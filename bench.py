@@ -33,6 +33,23 @@ def _fence(x) -> float:
     return float(jax.device_get(x))
 
 
+def _marginal_row(t_long, t_short, n_delta, prefix, batch=1):
+    """Marginal-cost keys for a decode row: (T_long - T_short) / n_delta
+    steps cancels the tunnel's ~110 ms fixed per-program latency;
+    tokens/sec counts DELIVERED tokens (batch rows per step). Records an
+    error key instead of clamping when the two separately-timed runs
+    cross (a clamped near-zero marginal would masquerade as an absurd
+    tokens/sec, the r3 31e9 artifact class)."""
+    if t_long > t_short:
+        step_s = (t_long - t_short) / n_delta
+        return {
+            f"{prefix}tokens_per_sec_marginal": round(batch / step_s),
+            f"{prefix}ms_per_token_marginal": round(step_s * 1e3 / batch, 3),
+        }
+    return {f"{prefix}marginal_error":
+            "t_long <= t_short; marginal unmeasurable"}
+
+
 def _timed_windows(step, n_steps=40, n_windows=3, warmup=20):
     """Best-of-N windows of `n_steps` steps; step() must return a scalar-
     fence-able value. The tunnelled device has bursty transport noise, so
@@ -254,22 +271,14 @@ def bench_decode():
     # transition round: `tokens_per_sec` keeps the END-TO-END method so the
     # vs_prior gate compares like with like; the marginal figure rides
     # alongside and becomes the gated key next round
-    row = {
+    return {
         "bs": bs, "prompt": prompt_len, "new": new,
         "tokens_per_sec": round(bs * new / t_long),
         "ms_per_token": round(t_long / new * 1e3, 3),
         "wall_s_64": round(t_short, 3),
         "wall_s_256": round(t_long, 3),
+        **_marginal_row(t_long, t_short, new - new_short, "", batch=bs),
     }
-    if t_long > t_short:
-        marginal = (t_long - t_short) / (new - new_short)
-        row["tokens_per_sec_marginal"] = round(bs / marginal)
-        row["ms_per_token_marginal"] = round(marginal * 1e3, 3)
-    else:
-        # separate min-of-3 runs crossed on the noisy tunnel — record the
-        # failure instead of clamping into an absurd-looking number
-        row["marginal_error"] = "t_long <= t_short; marginal unmeasurable"
-    return row
 
 
 def bench_decode_16k_prefill():
@@ -392,7 +401,7 @@ def bench_decode_16k_prefill():
     # method (r4-comparable; dominated by the ~110 ms tunnel latency at 32
     # tokens — see docstring); the marginal keys carry the honest
     # steady-state figure and become the gated keys next round
-    row = {
+    return {
         "prompt": prompt_len, "new": new,
         "prefill_s": round(prefill_s, 3),
         "prefill_tokens_per_sec": round(prompt_len / prefill_s),
@@ -400,24 +409,10 @@ def bench_decode_16k_prefill():
         "decode_ms_per_token": round(t_short / new * 1e3, 3),
         "decode_wall_s_32": round(t_short, 3),
         "decode_wall_s_128": round(t_long, 3),
+        **_marginal_row(t_long, t_short, new_long - new, "decode_"),
+        **_marginal_row(t8_long, t8_short, new_long - new, "decode_bs8_",
+                        batch=bs),
     }
-    if t_long > t_short:
-        marginal_s = (t_long - t_short) / (new_long - new)
-        row["decode_tokens_per_sec_marginal"] = round(1.0 / marginal_s)
-        row["decode_ms_per_token_marginal"] = round(marginal_s * 1e3, 3)
-    else:
-        row["decode_marginal_error"] = (
-            "t_long <= t_short; marginal unmeasurable"
-        )
-    if t8_long > t8_short:
-        marginal8_s = (t8_long - t8_short) / (new_long - new)
-        row["decode_bs8_tokens_per_sec"] = round(bs / marginal8_s)
-        row["decode_bs8_ms_per_token"] = round(marginal8_s * 1e3 / bs, 3)
-    else:
-        row["decode_bs8_marginal_error"] = (
-            "t_long <= t_short; marginal unmeasurable"
-        )
-    return row
 
 
 def bench_speculative_decode():

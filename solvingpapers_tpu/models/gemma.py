@@ -61,6 +61,13 @@ class GemmaConfig:
     context_parallel: bool = False
     context_impl: str = "ring"  # ring | ulysses
 
+    def __post_init__(self):
+        if self.activation not in ("gelu_tanh", "silu"):
+            raise ValueError(
+                f"activation must be 'gelu_tanh' or 'silu', got "
+                f"{self.activation!r}"
+            )
+
     @property
     def compute_dtype(self) -> jnp.dtype:
         return jnp.dtype(self.dtype)

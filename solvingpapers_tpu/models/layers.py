@@ -109,7 +109,8 @@ class Attention(nn.Module):
     # context_impl "ring" rotates K/V chunks via ppermute (memory-optimal,
     # any head count); "ulysses" all_to_alls to head sharding around a dense
     # core (needs n_heads and n_kv_heads divisible by the axis size). Decode
-    # caches are unsupported under context parallelism (training/prefill).
+    # under CP uses the context-sharded CPKVCache (infer.generate_cp /
+    # model.init_cp_caches); a plain per-shard KVCache is rejected.
     context_parallel: bool = False
     context_axis: str = "context"
     context_impl: str = "ring"  # ring | ulysses

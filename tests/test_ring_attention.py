@@ -170,7 +170,11 @@ def test_cp_llama_forward_matches_dense(devices, impl):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
 
 
-def test_cp_model_rejects_decode_cache(devices):
+def test_cp_model_rejects_plain_decode_cache(devices):
+    """CP decode is supported as of round 5 — but only through the
+    context-sharded CPKVCache; a PLAIN per-shard KVCache would silently
+    attend only local slots and must be rejected with a pointer to the
+    right API."""
     import dataclasses
 
     from jax.sharding import PartitionSpec as P
@@ -185,13 +189,13 @@ def test_cp_model_rejects_decode_cache(devices):
     mesh = create_mesh(MeshConfig(data=1, context=4), devices[:4])
 
     def run(p, x):
-        caches = model.init_caches(1, 32)
+        caches = model.init_caches(1, 32)  # plain KVCache: wrong under CP
         out, _ = model.apply({"params": p}, x, caches=caches)
         return out
 
     base = Llama(dataclasses.replace(cfg, context_parallel=False))
     params = base.init({"params": jax.random.key(0)}, toks)["params"]
-    with pytest.raises(NotImplementedError, match="unsupported under context"):
+    with pytest.raises(TypeError, match="CPKVCache"):
         jax.shard_map(run, mesh=mesh,
                       in_specs=(P(), P(("data",), "context")),
                       out_specs=P(("data",), "context", None))(params, toks)
