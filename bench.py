@@ -35,16 +35,18 @@ def _fence(x) -> float:
 
 def _marginal_row(t_long, t_short, n_delta, prefix, batch=1):
     """Marginal-cost keys for a decode row: (T_long - T_short) / n_delta
-    steps cancels the tunnel's ~110 ms fixed per-program latency;
-    tokens/sec counts DELIVERED tokens (batch rows per step). Records an
-    error key instead of clamping when the two separately-timed runs
-    cross (a clamped near-zero marginal would masquerade as an absurd
-    tokens/sec, the r3 31e9 artifact class)."""
+    steps cancels the tunnel's ~110 ms fixed per-program latency. Units
+    mirror the rows' unsuffixed keys exactly — tokens/sec counts
+    DELIVERED tokens (batch rows per step), ms_per_token is per SCAN STEP
+    — so suffixed and unsuffixed values differ only by the cancelled
+    fixed latency. Records an error key instead of clamping when the two
+    separately-timed runs cross (a clamped near-zero marginal would
+    masquerade as an absurd tokens/sec, the r3 31e9 artifact class)."""
     if t_long > t_short:
         step_s = (t_long - t_short) / n_delta
         return {
             f"{prefix}tokens_per_sec_marginal": round(batch / step_s),
-            f"{prefix}ms_per_token_marginal": round(step_s * 1e3 / batch, 3),
+            f"{prefix}ms_per_token_marginal": round(step_s * 1e3, 3),
         }
     return {f"{prefix}marginal_error":
             "t_long <= t_short; marginal unmeasurable"}

@@ -78,6 +78,17 @@ def is_tpu_backend() -> bool:
     return dev.platform == "tpu" or "TPU" in str(getattr(dev, "device_kind", ""))
 
 
+def auto_block(seq: int, requested: int | None) -> int:
+    """Resolve a caller's block request: None = seq-adaptive auto
+    (LONG_SEQ_BLOCK past LONG_SEQ, DEFAULT_BLOCK below — the measured
+    crossover, see the constants above); an explicit int is honored.
+    Shared by flash_attention and the ring-flash per-chunk core so long
+    CP shards get the long-sequence tile too."""
+    if requested is not None:
+        return requested
+    return LONG_SEQ_BLOCK if seq >= LONG_SEQ else DEFAULT_BLOCK
+
+
 def _pick_block(seq: int, requested: int) -> int:
     block = min(requested, seq)
     while seq % block:
@@ -577,14 +588,8 @@ def flash_attention(
         )
     if scale is None:
         scale = d**-0.5
-    # None = auto: seq-adaptive default (long sequences want the bigger
-    # tile — see LONG_SEQ_BLOCK above); an explicit int is always honored
-    if block_q is None:
-        block_q = LONG_SEQ_BLOCK if seq_q >= LONG_SEQ else DEFAULT_BLOCK
-    if block_k is None:
-        block_k = LONG_SEQ_BLOCK if seq_k >= LONG_SEQ else DEFAULT_BLOCK
-    block_q = _pick_block_q(seq_q, block_q)
-    block_k = _pick_block(seq_k, block_k)
+    block_q = _pick_block_q(seq_q, auto_block(seq_q, block_q))
+    block_k = _pick_block(seq_k, auto_block(seq_k, block_k))
 
     q3 = q.transpose(0, 2, 1, 3).reshape(b * n_heads, seq_q, d)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, seq_k, d)

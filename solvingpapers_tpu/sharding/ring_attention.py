@@ -345,9 +345,9 @@ def ring_flash_attention_local(
     device, and each chunk's inner loop is the MXU-tiled kernel instead of
     a jnp einsum."""
     from solvingpapers_tpu.kernels.flash_attention import (
-        DEFAULT_BLOCK,
         _pick_block,
         _pick_block_q,
+        auto_block,
     )
 
     b, s_loc, n, h = q.shape
@@ -371,8 +371,11 @@ def ring_flash_attention_local(
             "in-kernel dropout requires the hardware PRNG: interpret-mode "
             "pltpu.prng_random_bits is a zero stub (kernels/flash_attention)"
         )
-    bq = _pick_block_q(s_loc, block_q or DEFAULT_BLOCK)
-    bk = _pick_block(s_loc, block_k or DEFAULT_BLOCK)
+    # seq-adaptive auto like flash_attention: an 8k+ CP shard gets the
+    # long-sequence tile (the 16k sweep's 1.5-2x backward win applies to
+    # each ring chunk too)
+    bq = _pick_block_q(s_loc, auto_block(s_loc, block_q))
+    bk = _pick_block(s_loc, auto_block(s_loc, block_k))
 
     q3 = q.transpose(0, 2, 1, 3).reshape(b * n, s_loc, h)
     k3 = k.transpose(0, 2, 1, 3).reshape(b * n_kv, s_loc, h)
