@@ -85,6 +85,8 @@ def auto_block(seq: int, requested: int | None) -> int:
     Shared by flash_attention and the ring-flash per-chunk core so long
     CP shards get the long-sequence tile too."""
     if requested is not None:
+        if requested <= 0:
+            raise ValueError(f"block size must be positive, got {requested}")
         return requested
     return LONG_SEQ_BLOCK if seq >= LONG_SEQ else DEFAULT_BLOCK
 
