@@ -289,6 +289,62 @@ class GPTPipe:
     def max_positions(self) -> int:
         return self.cfg.block_size
 
+    # ------------------------------------------------------------------ 1f1b
+
+    def f1b_value_and_grad(self, params, batch):
+        """Loss AND grads in one 1F1B pass (sharding.pipeline
+        .pipeline_1f1b_value_and_grad) — call INSIDE a shard_map whose
+        'pipe' axis shards the stage stack. Returns (loss, grads) with
+        `grads` matching the params tree (stage grads keep this device's
+        leading-1 stage dim; head/embedding grads are pipe-invariant).
+        Deterministic only (the 1F1B schedule has no per-unit rng
+        channel yet); the Trainer opts in via TrainConfig.pp_schedule."""
+        from solvingpapers_tpu import ops
+        from solvingpapers_tpu.sharding.pipeline import (
+            pipeline_1f1b_value_and_grad,
+        )
+
+        cfg = self.cfg
+        tokens, targets = batch["x"], batch["y"]
+        b, s = tokens.shape
+        m = cfg.n_microbatches
+        if b % m:
+            raise ValueError(f"batch {b} not divisible by {m} microbatches")
+        positions = default_positions(b, s, False,
+                                      max_positions=cfg.block_size)
+        head = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+
+        def embed_fn(emb, pos):
+            x = jnp.take(emb["embedding"], tokens, axis=0)
+            x = x + jnp.take(pos, positions, axis=0)
+            return x.astype(cfg.compute_dtype).reshape(
+                m, b // m, s, cfg.dim
+            )
+
+        micro, embed_vjp = jax.vjp(
+            embed_fn, params["tok_emb"], params["pos_emb"]
+        )
+        targets_m = targets.reshape(m, b // m, s)
+
+        def head_loss(hp, h, t):
+            z = LayerNorm().apply({"params": hp["ln_f"]}, h)
+            logits = (
+                z.astype(cfg.compute_dtype)
+                @ hp["lm_head"]["kernel"].astype(cfg.compute_dtype)
+            )
+            return ops.cross_entropy(logits, t)
+
+        loss, dstage, dhead, dmicro = pipeline_1f1b_value_and_grad(
+            params["stages"], head, micro, targets_m, self._stage_fn,
+            head_loss,
+        )
+        demb, dpos = embed_vjp(dmicro.astype(micro.dtype))
+        grads = {
+            "tok_emb": demb, "pos_emb": dpos, "stages": dstage,
+            "ln_f": dhead["ln_f"], "lm_head": dhead["lm_head"],
+        }
+        return loss, grads
+
     # ---------------------------------------------------------------- export
 
     def to_dense(self, params: dict):

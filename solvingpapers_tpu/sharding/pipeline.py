@@ -411,11 +411,21 @@ def pipeline_1f1b_value_and_grad(
 
     probe = jax.tree.leaves(stage_params)[0]
     tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
+    # the schedule's carries must be varying over the pipe axis AND over
+    # whatever batch axes the inputs already vary over (under the Trainer
+    # the microbatches enter data-sharded), or the cond branches/scan
+    # carry would type-mismatch under the vma checker
+    _target_vma = {axis_name}
+    for _x in (microbatches, targets, *jax.tree.leaves(head_params)):
+        _target_vma |= set(getattr(jax.typeof(_x), "vma", ()) or ())
 
     def mark(x):
-        if tracking and axis_name not in jax.typeof(x).vma:
-            return jax.lax.pcast(x, (axis_name,), to="varying")
-        return x
+        if not tracking:
+            return x
+        missing = tuple(
+            _target_vma - set(getattr(jax.typeof(x), "vma", ()) or ())
+        )
+        return jax.lax.pcast(x, missing, to="varying") if missing else x
 
     microbatches = mark(microbatches)
     targets = mark(targets)
@@ -479,11 +489,16 @@ def pipeline_1f1b_value_and_grad(
                 stash, i_b_c % n_stages, 0, keepdims=False
             )
             target = targets[i_b_c]
-            _, vjp, (_, per_mb) = jax.vjp(
+            primal, vjp, (_, per_mb) = jax.vjp(
                 unit_scalar, params, head_params, x_in, bwd_buf, target,
                 has_aux=True,
             )
-            dp, dh, dx, _, _ = vjp(mark(jnp.ones((), f32)))
+            # the cotangent's varying-axes type must match the primal's
+            ct = jnp.ones((), f32)
+            vma = tuple(getattr(jax.typeof(primal), "vma", ()) or ())
+            if vma:
+                ct = jax.lax.pcast(ct, vma, to="varying")
+            dp, dh, dx, _, _ = vjp(ct)
             dparams = jax.tree.map(lambda a, b: a + b.astype(f32),
                                    dparams, dp)
             dhead = jax.tree.map(lambda a, b: a + b.astype(f32), dhead, dh)

@@ -112,6 +112,15 @@ class TrainConfig:
     # up to fp reassociation (tests/test_pipeline_model.py pins this);
     # model_state (MoE routing bias) threads through groups sequentially.
     pp_grad_groups: int = 1
+    # PP backward schedule: "gpipe" = jax.grad through the forward
+    # schedule (activation memory grows with total microbatches; pair with
+    # pp_grad_groups to bound it at the cost of per-group bubbles).
+    # "1f1b" = one-forward-one-backward (sharding.pipeline
+    # .pipeline_1f1b_value_and_grad): each microbatch's backward runs as
+    # soon as its loss exists, bounding live activations by PIPE DEPTH
+    # with no extra bubble. Requires a model exposing f1b_value_and_grad
+    # (GPTPipe); deterministic-only and data x pipe meshes in v1.
+    pp_schedule: str = "gpipe"
 
 
 def lm_loss_fn(model, params, batch, rng, model_state, train):
@@ -411,6 +420,96 @@ class Trainer:
         for every shard_map this Trainer builds (CP loss, PP loss, CP init)."""
         return not getattr(getattr(self.model, "cfg", None), "use_flash", False)
 
+    def _pp_1f1b_vg_call(self):
+        """Loss AND grads via the 1F1B schedule (TrainConfig.pp_schedule
+        = "1f1b"): the model's f1b_value_and_grad runs inside shard_map —
+        per-microbatch backwards interleaved with forwards, live
+        activations bounded by pipe depth (BENCHMARKS.md PP memory table)
+        — so the engine consumes grads directly instead of wrapping the
+        forward in jax.value_and_grad."""
+        self._reject_axes(
+            "pp_schedule='1f1b'", ("model", "expert", "context", "fsdp"),
+            "v1 supports data x pipe meshes only",
+        )
+        mcfg = getattr(self.model, "cfg", None)
+        if not getattr(mcfg, "pipeline_parallel", False):
+            raise ValueError(
+                "pp_schedule='1f1b' requires a model built with "
+                "pipeline_parallel=True"
+            )
+        self._check_pp_stages(mcfg)
+        if not hasattr(self.model, "f1b_value_and_grad"):
+            raise NotImplementedError(
+                f"{type(self.model).__name__} does not implement "
+                "f1b_value_and_grad (GPTPipe does); use pp_schedule='gpipe'"
+            )
+        if getattr(mcfg, "virtual_stages", 1) != 1:
+            raise NotImplementedError(
+                "pp_schedule='1f1b' x virtual_stages is not composed; "
+                "use pp_schedule='gpipe' for the interleaved schedule"
+            )
+        if getattr(mcfg, "dropout", 0.0) > 0.0:
+            raise NotImplementedError(
+                "pp_schedule='1f1b' is deterministic-only (the schedule "
+                "has no per-unit rng channel yet): set dropout=0.0 or use "
+                "pp_schedule='gpipe'"
+            )
+        if self.config.pp_grad_groups > 1:
+            raise NotImplementedError(
+                "pp_schedule='1f1b' already bounds activation memory by "
+                "pipe depth; pp_grad_groups adds only bubbles — use one "
+                "or the other"
+            )
+        if self.loss_fn is not lm_loss_fn:
+            raise NotImplementedError(
+                "pp_schedule='1f1b' computes its objective inside the "
+                "schedule (the model's f1b_value_and_grad), so a custom "
+                "Trainer loss_fn would be silently ignored — use "
+                "pp_schedule='gpipe' for custom objectives"
+            )
+        batch_specs = self._batch_specs()
+        param_in_specs = self._pp_param_specs()
+
+        def call(params, model_state, batch, rng):
+            p_specs = jax.tree_util.tree_map_with_path(
+                param_in_specs, params
+            )
+
+            sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            n_shards = sizes.get("data", 1) * sizes.get("fsdp", 1)
+
+            def mean_over_data(a):
+                # every cross-shard reduction is explicit on this path:
+                # psum the per-shard local value and divide by the shard
+                # count (the mean the replicated-param grads need)
+                return jax.lax.psum(a, ("data", "fsdp")) / n_shards
+
+            def local(params, batch):
+                loss, grads = self.model.f1b_value_and_grad(params, batch)
+                loss = mean_over_data(loss)
+                grads = jax.tree.map(mean_over_data, grads)
+                aux = {"perplexity": jnp.exp(loss)}
+                return loss, aux, grads
+
+            # check_vma OFF deliberately (not just for flash models): under
+            # the vma checker, vjp cotangents w.r.t. data-replicated params
+            # carry a pending cross-shard sum whose materialization point
+            # differs per leaf (measured: stage-param grads came back
+            # doubled after pmean while head grads did not) — with the
+            # checker off the body has plain SPMD semantics, every device
+            # holds its shard-local grads (verified against per-shard
+            # oracles), and the ONE explicit psum/n above is the whole
+            # cross-shard story.
+            loss, aux, grads = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=(p_specs, batch_specs),
+                out_specs=(P(), P(), p_specs),
+                check_vma=False,
+            )(params, batch)
+            return loss, aux, model_state, grads
+
+        return call
+
     def _shard_map_loss_call(self, axes, param_in_specs, rng_axes,
                              gather_fsdp: bool = False):
         """Common shard_map loss wrapper for CP/PP. `param_in_specs` is a
@@ -581,10 +680,33 @@ class Trainer:
                 aux = dict(aux, perplexity=jnp.exp(aux["perplexity"]))
             return loss, aux, new_ms, grads
 
+        if self.config.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.config.pp_schedule!r}"
+            )
+        if (self.config.pp_schedule == "1f1b"
+                and not self.config.pipeline_parallel):
+            raise ValueError(
+                "pp_schedule='1f1b' requires pipeline_parallel=True — "
+                "without it the config would silently train on the plain "
+                "data-parallel path"
+            )
+        pp_1f1b_vg = (
+            self._pp_1f1b_vg_call()
+            if self.config.pipeline_parallel
+            and self.config.pp_schedule == "1f1b"
+            else None
+        )
+
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
-            if pp_groups > 1:
+            if pp_1f1b_vg is not None:
+                loss, aux, new_ms, grads = pp_1f1b_vg(
+                    state.params, state.model_state, batch, step_rng
+                )
+            elif pp_groups > 1:
                 loss, aux, new_ms, grads = grouped_value_and_grad(
                     state, batch, step_rng
                 )
