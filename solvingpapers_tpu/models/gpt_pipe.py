@@ -300,31 +300,23 @@ class GPTPipe:
         Deterministic only (the 1F1B schedule has no per-unit rng
         channel yet); the Trainer opts in via TrainConfig.pp_schedule."""
         from solvingpapers_tpu import ops
-        from solvingpapers_tpu.sharding.pipeline import (
-            pipeline_1f1b_value_and_grad,
-        )
+        from solvingpapers_tpu.models.staged import f1b_lm_value_and_grad
 
         cfg = self.cfg
         tokens, targets = batch["x"], batch["y"]
         b, s = tokens.shape
         m = cfg.n_microbatches
-        if b % m:
-            raise ValueError(f"batch {b} not divisible by {m} microbatches")
         positions = default_positions(b, s, False,
                                       max_positions=cfg.block_size)
         head = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+        embed = {"tok_emb": params["tok_emb"], "pos_emb": params["pos_emb"]}
 
-        def embed_fn(emb, pos):
-            x = jnp.take(emb["embedding"], tokens, axis=0)
-            x = x + jnp.take(pos, positions, axis=0)
+        def embed_fn(ep):
+            x = jnp.take(ep["tok_emb"]["embedding"], tokens, axis=0)
+            x = x + jnp.take(ep["pos_emb"], positions, axis=0)
             return x.astype(cfg.compute_dtype).reshape(
                 m, b // m, s, cfg.dim
             )
-
-        micro, embed_vjp = jax.vjp(
-            embed_fn, params["tok_emb"], params["pos_emb"]
-        )
-        targets_m = targets.reshape(m, b // m, s)
 
         def head_loss(hp, h, t):
             z = LayerNorm().apply({"params": hp["ln_f"]}, h)
@@ -334,13 +326,13 @@ class GPTPipe:
             )
             return ops.cross_entropy(logits, t)
 
-        loss, dstage, dhead, dmicro = pipeline_1f1b_value_and_grad(
-            params["stages"], head, micro, targets_m, self._stage_fn,
-            head_loss,
+        loss, dstage, dhead, dembed = f1b_lm_value_and_grad(
+            params["stages"], embed, head, targets, m, embed_fn,
+            self._stage_fn, head_loss,
         )
-        demb, dpos = embed_vjp(dmicro.astype(micro.dtype))
         grads = {
-            "tok_emb": demb, "pos_emb": dpos, "stages": dstage,
+            "tok_emb": dembed["tok_emb"], "pos_emb": dembed["pos_emb"],
+            "stages": dstage,
             "ln_f": dhead["ln_f"], "lm_head": dhead["lm_head"],
         }
         return loss, grads

@@ -244,6 +244,48 @@ class LlamaPipe:
     def max_positions(self) -> int:
         return self.cfg.max_seq_len
 
+    def f1b_value_and_grad(self, params, batch):
+        """Loss AND grads in one 1F1B pass — same contract as
+        GPTPipe.f1b_value_and_grad (call inside the Trainer's 'pipe'
+        shard_map via TrainConfig.pp_schedule='1f1b'; deterministic
+        only). RoPE positions are baked into the stage_fn closure, the
+        RMSNorm+lm_head ride as the schedule's loss head."""
+        from solvingpapers_tpu import ops
+        from solvingpapers_tpu.models.staged import f1b_lm_value_and_grad
+
+        cfg = self.cfg
+        tokens, targets = batch["x"], batch["y"]
+        b, s = tokens.shape
+        m = cfg.n_microbatches
+        positions = default_positions(b, s, False,
+                                      max_positions=cfg.max_seq_len)
+        head = {"norm_f": params["norm_f"], "lm_head": params["lm_head"]}
+        stage_fn = self._stage_fn(positions[: b // m])
+
+        def embed_fn(emb):
+            x = jnp.take(emb["embedding"], tokens, axis=0)
+            return x.astype(cfg.compute_dtype).reshape(
+                m, b // m, s, cfg.dim
+            )
+
+        def head_loss(hp, h, t):
+            z = RMSNorm(eps=cfg.norm_eps).apply({"params": hp["norm_f"]}, h)
+            logits = (
+                z.astype(cfg.compute_dtype)
+                @ hp["lm_head"]["kernel"].astype(cfg.compute_dtype)
+            )
+            return ops.cross_entropy(logits, t)
+
+        loss, dstage, dhead, dembed = f1b_lm_value_and_grad(
+            params["stages"], params["tok_emb"], head, targets, m,
+            embed_fn, stage_fn, head_loss,
+        )
+        grads = {
+            "tok_emb": dembed, "stages": dstage,
+            "norm_f": dhead["norm_f"], "lm_head": dhead["lm_head"],
+        }
+        return loss, grads
+
     def to_dense(self, params: dict):
         """Restack into the dense Llama layout (block_{i} keys) — the
         decode path for pipeline-trained weights."""

@@ -51,6 +51,34 @@ def init_stage_stack(
     return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
 
 
+def f1b_lm_value_and_grad(stage_params, embed_params, head_params, targets,
+                          n_microbatches: int, embed_fn, stage_fn,
+                          head_loss):
+    """Shared 1F1B scaffold for the staged LM families (the per-family
+    f1b_value_and_grad methods differ only in their embed and loss-head):
+    embed -> pipeline_1f1b_value_and_grad -> backprop the schedule's input
+    cotangent through the embedding. `embed_fn(embed_params)` returns the
+    (m, mb, s, d) microbatches (closing over the tokens); `head_loss
+    (head_params, h, targets_mb)` is one microbatch's mean loss. Returns
+    (loss, dstage, dhead, dembed)."""
+    from solvingpapers_tpu.sharding.pipeline import (
+        pipeline_1f1b_value_and_grad,
+    )
+
+    b, s = targets.shape
+    if b % n_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {n_microbatches} microbatches"
+        )
+    micro, embed_vjp = jax.vjp(embed_fn, embed_params)
+    targets_m = targets.reshape(n_microbatches, b // n_microbatches, s)
+    loss, dstage, dhead, dmicro = pipeline_1f1b_value_and_grad(
+        stage_params, head_params, micro, targets_m, stage_fn, head_loss,
+    )
+    (dembed,) = embed_vjp(dmicro.astype(micro.dtype))
+    return loss, dstage, dhead, dembed
+
+
 def validate_interleaved_config(n_stages: int, virtual_stages: int,
                                 n_microbatches: int,
                                 context_parallel: bool) -> None:

@@ -340,6 +340,43 @@ def test_pp_1f1b_trainer_matches_gpipe(devices):
                                    rtol=3e-4, atol=3e-4)
 
 
+def test_pp_1f1b_llama_trainer_matches_gpipe(devices):
+    """The second staged family through TrainConfig.pp_schedule='1f1b':
+    LlamaPipe (RoPE positions in the stage closure, RMSNorm head)."""
+    from solvingpapers_tpu.models.llama3_pipe import LlamaPipe, LlamaPipeConfig
+
+    batch = _batch(jax.random.key(9))
+    mesh_cfg = MeshConfig(data=2, pipe=4)
+
+    def run(schedule):
+        model = LlamaPipeConfig(
+            vocab_size=64, max_seq_len=32, dim=32, n_layers=4, n_heads=4,
+            n_kv_heads=2, n_stages=4, n_microbatches=4,
+            pipeline_parallel=True,
+        )
+        train = TrainConfig(
+            steps=1, batch_size=8, log_every=1, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True, pp_schedule=schedule,
+            optimizer=OptimizerConfig(name="sgd", max_lr=1e-1,
+                                      warmup_steps=0, total_steps=4,
+                                      grad_clip=1.0),
+        )
+        t = Trainer(LlamaPipe(model), train, rules=PP_RULES,
+                    mesh=create_mesh(mesh_cfg, devices))
+        state = t.init_state(batch)
+        t._build_steps()
+        state, metrics = t._train_step(state, batch)
+        return (float(jax.device_get(metrics["train_loss"])),
+                jax.device_get(state.params))
+
+    l_ref, p_ref = run("gpipe")
+    l_new, p_new = run("1f1b")
+    np.testing.assert_allclose(l_new, l_ref, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_new), jax.tree.leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
 def test_pp_1f1b_rejects_unsupported_compositions(devices):
     model, train = _cfgs(True, MeshConfig(data=1, pipe=4))
     mesh = create_mesh(MeshConfig(data=1, pipe=4), devices[:4])
