@@ -116,6 +116,14 @@ class GPTPipeConfig:
         )
 
 
+def _emb_dropout(x, key, rate):
+    """The embedding-dropout site shared by apply() and the 1F1B path:
+    replicated key (every pipe device must agree on stage 0's input)."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
 class GPTPipe:
     """init/apply surface compatible with Trainer + lm_loss_fn."""
 
@@ -239,11 +247,8 @@ class GPTPipe:
                 )
             k_emb, sched_rng = jax.random.split(rngs["dropout"])
             # embedding dropout (models/gpt.py's nn.Dropout site) applied
-            # manually — it runs replicated on every pipe device with the
-            # same key, so all devices agree on the schedule's stage-0 input
-            keep = 1.0 - cfg.dropout
-            mask = jax.random.bernoulli(k_emb, keep, x.shape)
-            x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+            # manually (shared helper with the 1F1B path)
+            x = _emb_dropout(x, k_emb, cfg.dropout)
 
         if cfg.pipeline_parallel and cfg.virtual_stages > 1:
             # interleaved schedule: local slice holds this device's
@@ -291,14 +296,17 @@ class GPTPipe:
 
     # ------------------------------------------------------------------ 1f1b
 
-    def f1b_value_and_grad(self, params, batch):
+    def f1b_value_and_grad(self, params, batch, rng=None):
         """Loss AND grads in one 1F1B pass (sharding.pipeline
         .pipeline_1f1b_value_and_grad) — call INSIDE a shard_map whose
         'pipe' axis shards the stage stack. Returns (loss, grads) with
         `grads` matching the params tree (stage grads keep this device's
         leading-1 stage dim; head/embedding grads are pipe-invariant).
-        Deterministic only (the 1F1B schedule has no per-unit rng
-        channel yet); the Trainer opts in via TrainConfig.pp_schedule."""
+        With `rng` and dropout > 0, masks come from the schedule's
+        per-(stage, microbatch) regenerable keys (identical in the
+        backward recompute) plus a replicated embedding-dropout key —
+        the same recipe as the GPipe path. The Trainer opts in via
+        TrainConfig.pp_schedule."""
         from solvingpapers_tpu import ops
         from solvingpapers_tpu.models.staged import f1b_lm_value_and_grad
 
@@ -311,12 +319,18 @@ class GPTPipe:
         head = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
         embed = {"tok_emb": params["tok_emb"], "pos_emb": params["pos_emb"]}
 
+        train_drop = rng is not None and cfg.dropout > 0.0
+        sched_rng = k_emb = None
+        if train_drop:
+            k_emb, sched_rng = jax.random.split(rng)
+
         def embed_fn(ep):
             x = jnp.take(ep["tok_emb"]["embedding"], tokens, axis=0)
             x = x + jnp.take(ep["pos_emb"], positions, axis=0)
-            return x.astype(cfg.compute_dtype).reshape(
-                m, b // m, s, cfg.dim
-            )
+            x = x.astype(cfg.compute_dtype)
+            if train_drop:
+                x = _emb_dropout(x, k_emb, cfg.dropout)
+            return x.reshape(m, b // m, s, cfg.dim)
 
         def head_loss(hp, h, t):
             z = LayerNorm().apply({"params": hp["ln_f"]}, h)
@@ -328,7 +342,7 @@ class GPTPipe:
 
         loss, dstage, dhead, dembed = f1b_lm_value_and_grad(
             params["stages"], embed, head, targets, m, embed_fn,
-            self._stage_fn, head_loss,
+            self._stage_fn, head_loss, rng=sched_rng,
         )
         grads = {
             "tok_emb": dembed["tok_emb"], "pos_emb": dembed["pos_emb"],

@@ -244,12 +244,13 @@ class LlamaPipe:
     def max_positions(self) -> int:
         return self.cfg.max_seq_len
 
-    def f1b_value_and_grad(self, params, batch):
+    def f1b_value_and_grad(self, params, batch, rng=None):
         """Loss AND grads in one 1F1B pass — same contract as
         GPTPipe.f1b_value_and_grad (call inside the Trainer's 'pipe'
-        shard_map via TrainConfig.pp_schedule='1f1b'; deterministic
-        only). RoPE positions are baked into the stage_fn closure, the
-        RMSNorm+lm_head ride as the schedule's loss head."""
+        shard_map via TrainConfig.pp_schedule='1f1b'; with `rng`,
+        block dropout uses the schedule's per-(stage, microbatch)
+        regenerable keys). RoPE positions are baked into the stage_fn
+        closure, the RMSNorm+lm_head ride as the schedule's loss head."""
         from solvingpapers_tpu import ops
         from solvingpapers_tpu.models.staged import f1b_lm_value_and_grad
 
@@ -279,6 +280,7 @@ class LlamaPipe:
         loss, dstage, dhead, dembed = f1b_lm_value_and_grad(
             params["stages"], params["tok_emb"], head, targets, m,
             embed_fn, stage_fn, head_loss,
+            rng=rng if cfg.dropout > 0.0 else None,
         )
         grads = {
             "tok_emb": dembed, "stages": dstage,

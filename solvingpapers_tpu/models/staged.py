@@ -53,7 +53,7 @@ def init_stage_stack(
 
 def f1b_lm_value_and_grad(stage_params, embed_params, head_params, targets,
                           n_microbatches: int, embed_fn, stage_fn,
-                          head_loss):
+                          head_loss, rng=None):
     """Shared 1F1B scaffold for the staged LM families (the per-family
     f1b_value_and_grad methods differ only in their embed and loss-head):
     embed -> pipeline_1f1b_value_and_grad -> backprop the schedule's input
@@ -74,6 +74,7 @@ def f1b_lm_value_and_grad(stage_params, embed_params, head_params, targets,
     targets_m = targets.reshape(n_microbatches, b // n_microbatches, s)
     loss, dstage, dhead, dmicro = pipeline_1f1b_value_and_grad(
         stage_params, head_params, micro, targets_m, stage_fn, head_loss,
+        rng=rng,
     )
     (dembed,) = embed_vjp(dmicro.astype(micro.dtype))
     return loss, dstage, dhead, dembed

@@ -351,10 +351,18 @@ def pipeline_1f1b_value_and_grad(
     stage_fn,
     loss_fn,
     axis_name: str = "pipe",
+    rng=None,
 ):
     """One-forward-one-backward schedule (SURVEY.md §2.3 PP row): loss AND
     gradients in a single pass whose live activation memory is bounded by
     the PIPE DEPTH, not the microbatch count.
+
+    With `rng`, stage_fn is called as (params, x, unit_rng) with
+    unit_rng = fold_in(fold_in(rng, stage_id), microbatch) — the same
+    regenerable-key recipe as the GPipe schedule, and because the
+    backward unit derives the IDENTICAL key before its recompute-vjp,
+    dropout masks regenerate exactly and the grads are the true grads of
+    the masked forward.
 
     GPipe (jax.grad over `_pipeline_local`'s scan) must stash every tick's
     residuals — activation memory grows with n_micro, which is exactly what
@@ -444,11 +452,12 @@ def pipeline_1f1b_value_and_grad(
     dmicro = mark(jnp.zeros((n_micro, *mb), f32))
     loss_acc = mark(jnp.zeros((), f32))
 
-    def unit_scalar(p, hp, x, cot, target):
-        y = stage_fn(p, x.astype(probe.dtype)).astype(f32)
-        per_mb = loss_fn(hp, y, target)
-        pulled = jnp.vdot(y, cot)
-        return jnp.where(is_last, per_mb, pulled), (y, per_mb)
+    stage_rng = None if rng is None else jax.random.fold_in(rng, stage_id)
+
+    def call_stage(p, x, mb_idx):
+        if rng is None:
+            return stage_fn(p, x)
+        return stage_fn(p, x, jax.random.fold_in(stage_rng, mb_idx))
 
     def tick(carry, t):
         (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc) = carry
@@ -477,7 +486,9 @@ def pipeline_1f1b_value_and_grad(
                 ),
                 stash,
             )
-            y = stage_fn(params, x_in.astype(probe.dtype)).astype(f32)
+            y = call_stage(params, x_in.astype(probe.dtype), i_f_c).astype(
+                f32
+            )
             return jax.tree.map(mark, (
                 y, jnp.zeros(mb, f32), stash, dparams, dhead, dmicro,
                 loss_acc,
@@ -489,6 +500,15 @@ def pipeline_1f1b_value_and_grad(
                 stash, i_b_c % n_stages, 0, keepdims=False
             )
             target = targets[i_b_c]
+
+            def unit_scalar(p, hp, x, cot, target):
+                # same key as the forward unit -> identical dropout masks
+                # in the recompute, so the vjp is exact
+                y = call_stage(p, x.astype(probe.dtype), i_b_c).astype(f32)
+                per_mb = loss_fn(hp, y, target)
+                pulled = jnp.vdot(y, cot)
+                return jnp.where(is_last, per_mb, pulled), (y, per_mb)
+
             primal, vjp, (_, per_mb) = jax.vjp(
                 unit_scalar, params, head_params, x_in, bwd_buf, target,
                 has_aux=True,

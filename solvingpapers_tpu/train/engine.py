@@ -119,7 +119,8 @@ class TrainConfig:
     # .pipeline_1f1b_value_and_grad): each microbatch's backward runs as
     # soon as its loss exists, bounding live activations by PIPE DEPTH
     # with no extra bubble. Requires a model exposing f1b_value_and_grad
-    # (GPTPipe); deterministic-only and data x pipe meshes in v1.
+    # (GPTPipe, LlamaPipe); dropout trains via per-(stage, microbatch)
+    # regenerable keys; data x pipe meshes and the LM objective in v1.
     pp_schedule: str = "gpipe"
 
 
@@ -441,18 +442,13 @@ class Trainer:
         if not hasattr(self.model, "f1b_value_and_grad"):
             raise NotImplementedError(
                 f"{type(self.model).__name__} does not implement "
-                "f1b_value_and_grad (GPTPipe does); use pp_schedule='gpipe'"
+                "f1b_value_and_grad (GPTPipe and LlamaPipe do); use "
+                "pp_schedule='gpipe'"
             )
         if getattr(mcfg, "virtual_stages", 1) != 1:
             raise NotImplementedError(
                 "pp_schedule='1f1b' x virtual_stages is not composed; "
                 "use pp_schedule='gpipe' for the interleaved schedule"
-            )
-        if getattr(mcfg, "dropout", 0.0) > 0.0:
-            raise NotImplementedError(
-                "pp_schedule='1f1b' is deterministic-only (the schedule "
-                "has no per-unit rng channel yet): set dropout=0.0 or use "
-                "pp_schedule='gpipe'"
             )
         if self.config.pp_grad_groups > 1:
             raise NotImplementedError(
@@ -484,8 +480,16 @@ class Trainer:
                 # count (the mean the replicated-param grads need)
                 return jax.lax.psum(a, ("data", "fsdp")) / n_shards
 
-            def local(params, batch):
-                loss, grads = self.model.f1b_value_and_grad(params, batch)
+            def local(params, batch, rng):
+                # decorrelate dropout masks across data shards (pipe
+                # devices share the key: they must agree on the masks the
+                # schedule's units regenerate)
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(("data", "fsdp"))
+                )
+                loss, grads = self.model.f1b_value_and_grad(
+                    params, batch, rng=rng
+                )
                 loss = mean_over_data(loss)
                 grads = jax.tree.map(mean_over_data, grads)
                 aux = {"perplexity": jnp.exp(loss)}
@@ -502,10 +506,10 @@ class Trainer:
             # cross-shard story.
             loss, aux, grads = jax.shard_map(
                 local, mesh=self.mesh,
-                in_specs=(p_specs, batch_specs),
+                in_specs=(p_specs, batch_specs, P()),
                 out_specs=(P(), P(), p_specs),
                 check_vma=False,
-            )(params, batch)
+            )(params, batch, rng)
             return loss, aux, model_state, grads
 
         return call

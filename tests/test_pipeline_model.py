@@ -382,15 +382,6 @@ def test_pp_1f1b_rejects_unsupported_compositions(devices):
     mesh = create_mesh(MeshConfig(data=1, pipe=4), devices[:4])
     batch = _batch(jax.random.key(1))
 
-    # dropout needs the rng channel the schedule doesn't have yet
-    model_d = dataclasses.replace(model, dropout=0.1)
-    t = Trainer(GPTPipe(model_d),
-                dataclasses.replace(train, pp_schedule="1f1b"),
-                rules=PP_RULES, mesh=mesh)
-    t.init_state(batch)
-    with pytest.raises(NotImplementedError, match="deterministic-only"):
-        t._build_steps()
-
     # grad groups are redundant under 1F1B
     t = Trainer(GPTPipe(model),
                 dataclasses.replace(train, pp_schedule="1f1b",
@@ -399,6 +390,66 @@ def test_pp_1f1b_rejects_unsupported_compositions(devices):
     t.init_state(batch)
     with pytest.raises(NotImplementedError, match="pp_grad_groups"):
         t._build_steps()
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_pp_1f1b_dropout_deterministic_and_active(devices, family):
+    """Dropout under the 1F1B schedule (per-(stage, microbatch)
+    regenerable keys, identical in the backward recompute), for BOTH
+    f1b families: identical TrainStates step bit-identically, losses are
+    finite, and the step-1 train loss (computed on the identical init
+    params) DIFFERS from the dropout=0 run's — masks demonstrably fire
+    in the forward that produced the loss, so a silently-dead rng
+    channel cannot pass."""
+    batch = _batch(jax.random.key(0))
+    mesh_cfg = MeshConfig(data=2, pipe=2)
+
+    def build(dropout):
+        if family == "gpt":
+            model = GPTPipeConfig(
+                vocab_size=64, block_size=32, dim=32, n_layers=4,
+                n_heads=2, n_stages=2, n_microbatches=4,
+                pipeline_parallel=True, dropout=dropout,
+            )
+            pipe_model = GPTPipe(model)
+        else:
+            from solvingpapers_tpu.models.llama3_pipe import (
+                LlamaPipe, LlamaPipeConfig,
+            )
+
+            model = LlamaPipeConfig(
+                vocab_size=64, max_seq_len=32, dim=32, n_layers=4,
+                n_heads=4, n_kv_heads=2, n_stages=2, n_microbatches=4,
+                pipeline_parallel=True, dropout=dropout,
+            )
+            pipe_model = LlamaPipe(model)
+        train = TrainConfig(
+            steps=1, batch_size=8, log_every=1, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True, pp_schedule="1f1b",
+            optimizer=OptimizerConfig(name="sgd", max_lr=1e-1,
+                                      warmup_steps=0, total_steps=4,
+                                      grad_clip=1.0),
+        )
+        return pipe_model, train
+
+    def run(dropout):
+        pipe_model, train = build(dropout)
+        t = Trainer(pipe_model, train, rules=PP_RULES,
+                    mesh=create_mesh(mesh_cfg, devices[:4]))
+        state = t.init_state(batch)
+        t._build_steps()
+        state, metrics = t._train_step(state, batch)
+        return (float(jax.device_get(metrics["train_loss"])),
+                float(jax.device_get(metrics["grad_norm"])))
+
+    l1, g1 = run(0.1)
+    l2, g2 = run(0.1)
+    assert l1 == l2 and g1 == g2  # regenerable keys -> bit-deterministic
+    assert np.isfinite(l1) and np.isfinite(g1)
+    # same init params (init is dropout-independent): a dropout-on step-1
+    # loss equal to the dropout-off loss means the masks never fired
+    l_off, _ = run(0.0)
+    assert abs(l1 - l_off) > 1e-4, (l1, l_off)
 
 
 def test_pp_trainer_rejects_stage_mesh_mismatch(devices):
