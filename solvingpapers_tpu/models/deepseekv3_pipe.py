@@ -580,6 +580,93 @@ class DSV3Pipe:
     def max_positions(self) -> int:
         return self.cfg.block_size
 
+    # ------------------------------------------------------------------ 1f1b
+
+    def f1b_value_and_grad(self, params, batch, rng=None, model_state=None):
+        """The FLAGSHIP through the 1F1B schedule (TrainConfig.pp_schedule
+        = '1f1b'): the MoE routing loads ride the schedule's aux channel
+        (summed over each stage's forward units, the backward recompute's
+        aux discarded), the aux-free bias update is recombined exactly
+        like the GPipe path's `_mutate` (data-psum'd loads -> per-stage
+        sign deltas scattered into a zero stack, pipe-psum'd), and the
+        tied lm head rides as the loss head so the embedding's gradient
+        sums its embed-side and head-side contributions. v1 scope:
+        deterministic (the post-stack dropout of cell 31 has no
+        per-microbatch key channel in the loss head), no MTP heads, no
+        balance loss — the GPipe schedule serves those."""
+        from solvingpapers_tpu.models.staged import f1b_lm_value_and_grad
+
+        cfg = self.cfg
+        if cfg.mtp_heads > 0:
+            raise NotImplementedError(
+                "MTP under pp_schedule='1f1b' is not composed (the heads "
+                "need the full hidden stream); use pp_schedule='gpipe'"
+            )
+        if getattr(cfg, "balance_loss_weight", 0.0) > 0.0:
+            raise NotImplementedError(
+                "balance_loss_weight under pp_schedule='1f1b' is not "
+                "composed; use pp_schedule='gpipe'"
+            )
+        if cfg.dropout > 0.0 or cfg.attn_dropout > 0.0:
+            raise NotImplementedError(
+                "the flagship's 1F1B path is deterministic-only (the "
+                "post-stack dropout needs a per-microbatch key in the "
+                "loss head); set dropout=0 or use pp_schedule='gpipe'"
+            )
+        ms_all = model_state["moe_state"]
+        bias_stack = ms_all["stages"]
+        tokens, targets = batch["x"], batch["y"]
+        b, s = tokens.shape
+        m = cfg.n_microbatches
+        dt = cfg.compute_dtype
+        positions = default_positions(b, s, False,
+                                      max_positions=cfg.block_size)
+        stage_fn = self._make_stage_fn(
+            bias_stack, positions[: b // m],
+            lambda j: jax.lax.axis_index("pipe"),
+        )
+        head = {"norm_f": params["norm_f"], "tok_emb": params["tok_emb"]}
+        pe = ops.sinusoidal_position_encoding(cfg.block_size, cfg.dim)
+
+        def embed_fn(ep):
+            x = jnp.take(ep["embedding"], tokens, axis=0).astype(dt)
+            x = x + cfg.pe_scale * jnp.take(pe, positions, axis=0).astype(dt)
+            return x.reshape(m, b // m, s, cfg.dim)
+
+        def head_loss(hp, h, t):
+            # depth scaling -> final RMSNorm -> weight-tied head (cell 31)
+            x = 2.0 * cfg.n_layers**-0.5 * h
+            x = RMSNorm().apply({"params": hp["norm_f"]}, x)
+            emb = hp["tok_emb"]["embedding"]
+            logits = x.astype(dt) @ emb.T.astype(dt)
+            return ops.cross_entropy(logits, t)
+
+        loss, dstage, dhead, dembed, aux = f1b_lm_value_and_grad(
+            params["stages"], params["tok_emb"], head, targets, m,
+            embed_fn, stage_fn, head_loss, with_aux=True,
+        )
+        grads = {
+            # tied embedding: embed-side + head-side contributions
+            "tok_emb": jax.tree.map(
+                lambda a, b_: a + b_, dembed, dhead["tok_emb"]
+            ),
+            "norm_f": dhead["norm_f"],
+            "stages": dstage,
+        }
+
+        # routing-state update + metrics through the ONE recombination
+        # path (_mutate's PP branch; the schedule's aux sums take the
+        # GPipe layout with a leading v=1 dim)
+        mutated = self._mutate(
+            bias_stack, jax.tree.map(lambda a: a[None], aux),
+            cfg.n_microbatches, {"moe_state", "moe_metrics"},
+            deterministic=False, ms_all=ms_all,
+        )
+        new_ms = {"moe_state": mutated["moe_state"]}
+        stats = mutated["moe_metrics"]["pipeline"]["stats"][0]
+        metrics = {f"moe_{k}": v for k, v in stats.items()}
+        return loss, grads, new_ms, metrics
+
     # ---------------------------------------------------------------- export
 
     def to_dense(self, params: dict, moe_state: dict):

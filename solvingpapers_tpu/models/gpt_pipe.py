@@ -296,10 +296,12 @@ class GPTPipe:
 
     # ------------------------------------------------------------------ 1f1b
 
-    def f1b_value_and_grad(self, params, batch, rng=None):
+    def f1b_value_and_grad(self, params, batch, rng=None,
+                           model_state=None):
         """Loss AND grads in one 1F1B pass (sharding.pipeline
         .pipeline_1f1b_value_and_grad) — call INSIDE a shard_map whose
-        'pipe' axis shards the stage stack. Returns (loss, grads) with
+        'pipe' axis shards the stage stack. Returns (loss, grads,
+        model_state) — state passed through unchanged (stateless) — with
         `grads` matching the params tree (stage grads keep this device's
         leading-1 stage dim; head/embedding grads are pipe-invariant).
         With `rng` and dropout > 0, masks come from the schedule's
@@ -349,7 +351,7 @@ class GPTPipe:
             "stages": dstage,
             "ln_f": dhead["ln_f"], "lm_head": dhead["lm_head"],
         }
-        return loss, grads
+        return loss, grads, model_state
 
     # ---------------------------------------------------------------- export
 

@@ -244,7 +244,8 @@ class LlamaPipe:
     def max_positions(self) -> int:
         return self.cfg.max_seq_len
 
-    def f1b_value_and_grad(self, params, batch, rng=None):
+    def f1b_value_and_grad(self, params, batch, rng=None,
+                           model_state=None):
         """Loss AND grads in one 1F1B pass — same contract as
         GPTPipe.f1b_value_and_grad (call inside the Trainer's 'pipe'
         shard_map via TrainConfig.pp_schedule='1f1b'; with `rng`,
@@ -286,7 +287,7 @@ class LlamaPipe:
             "tok_emb": dembed, "stages": dstage,
             "norm_f": dhead["norm_f"], "lm_head": dhead["lm_head"],
         }
-        return loss, grads
+        return loss, grads, model_state
 
     def to_dense(self, params: dict):
         """Restack into the dense Llama layout (block_{i} keys) — the

@@ -53,7 +53,7 @@ def init_stage_stack(
 
 def f1b_lm_value_and_grad(stage_params, embed_params, head_params, targets,
                           n_microbatches: int, embed_fn, stage_fn,
-                          head_loss, rng=None):
+                          head_loss, rng=None, with_aux=False):
     """Shared 1F1B scaffold for the staged LM families (the per-family
     f1b_value_and_grad methods differ only in their embed and loss-head):
     embed -> pipeline_1f1b_value_and_grad -> backprop the schedule's input
@@ -72,11 +72,14 @@ def f1b_lm_value_and_grad(stage_params, embed_params, head_params, targets,
         )
     micro, embed_vjp = jax.vjp(embed_fn, embed_params)
     targets_m = targets.reshape(n_microbatches, b // n_microbatches, s)
-    loss, dstage, dhead, dmicro = pipeline_1f1b_value_and_grad(
+    out = pipeline_1f1b_value_and_grad(
         stage_params, head_params, micro, targets_m, stage_fn, head_loss,
-        rng=rng,
+        rng=rng, with_aux=with_aux,
     )
+    loss, dstage, dhead, dmicro = out[:4]
     (dembed,) = embed_vjp(dmicro.astype(micro.dtype))
+    if with_aux:
+        return loss, dstage, dhead, dembed, out[4]
     return loss, dstage, dhead, dembed
 
 

@@ -352,6 +352,7 @@ def pipeline_1f1b_value_and_grad(
     loss_fn,
     axis_name: str = "pipe",
     rng=None,
+    with_aux: bool = False,
 ):
     """One-forward-one-backward schedule (SURVEY.md §2.3 PP row): loss AND
     gradients in a single pass whose live activation memory is bounded by
@@ -363,6 +364,13 @@ def pipeline_1f1b_value_and_grad(
     backward unit derives the IDENTICAL key before its recompute-vjp,
     dropout masks regenerate exactly and the grads are the true grads of
     the masked forward.
+
+    With `with_aux`, stage_fn returns (y, aux_pytree) and the schedule
+    SUMS aux over this device's valid FORWARD units only (each (stage,
+    microbatch) counted once; the backward recompute's aux is discarded)
+    — the same per-stage coverage as `_pipeline_local`'s aux channel, for
+    the flagship's MoE routing loads. An extra aux_sum is appended to the
+    return tuple.
 
     GPipe (jax.grad over `_pipeline_local`'s scan) must stash every tick's
     residuals — activation memory grows with n_micro, which is exactly what
@@ -456,11 +464,28 @@ def pipeline_1f1b_value_and_grad(
 
     def call_stage(p, x, mb_idx):
         if rng is None:
-            return stage_fn(p, x)
-        return stage_fn(p, x, jax.random.fold_in(stage_rng, mb_idx))
+            res = stage_fn(p, x)
+        else:
+            res = stage_fn(p, x, jax.random.fold_in(stage_rng, mb_idx))
+        return res if with_aux else (res, None)
+
+    aux_shapes = (
+        jax.eval_shape(
+            lambda p, x: call_stage(p, x, jnp.zeros((), jnp.int32))[1],
+            params, mark(jnp.zeros(mb, f32)).astype(probe.dtype),
+        )
+        if with_aux else None
+    )
+    aux0 = (
+        jax.tree.map(
+            lambda sh: mark(jnp.zeros(sh.shape, f32)), aux_shapes
+        )
+        if with_aux else None
+    )
 
     def tick(carry, t):
-        (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc) = carry
+        (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc,
+         aux_acc) = carry
         rel_f = t - stage_id
         i_f = rel_f // 2
         do_f = (rel_f >= 0) & (rel_f % 2 == 0) & (i_f < n_micro)
@@ -472,7 +497,8 @@ def pipeline_1f1b_value_and_grad(
         i_b_c = jnp.clip(i_b, 0, n_micro - 1)
 
         def fwd_unit(op):
-            fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc = op
+            (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc,
+             aux_acc) = op
             x_in = jnp.where(
                 stage_id == 0, microbatches[i_f_c].astype(f32), fwd_buf
             )
@@ -486,16 +512,25 @@ def pipeline_1f1b_value_and_grad(
                 ),
                 stash,
             )
-            y = call_stage(params, x_in.astype(probe.dtype), i_f_c).astype(
-                f32
-            )
+            y, aux = call_stage(params, x_in.astype(probe.dtype), i_f_c)
+            y = y.astype(f32)
+            if with_aux:
+                # each real (stage, microbatch) forward counted once;
+                # idle-tick garbage masked out
+                aux_acc = jax.tree.map(
+                    lambda acc, a: acc + jnp.where(do_f, a, 0.0).astype(
+                        acc.dtype
+                    ),
+                    aux_acc, aux,
+                )
             return jax.tree.map(mark, (
                 y, jnp.zeros(mb, f32), stash, dparams, dhead, dmicro,
-                loss_acc,
+                loss_acc, aux_acc,
             ))
 
         def bwd_unit(op):
-            fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc = op
+            (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc,
+             aux_acc) = op
             x_in = jax.lax.dynamic_index_in_dim(
                 stash, i_b_c % n_stages, 0, keepdims=False
             )
@@ -503,8 +538,10 @@ def pipeline_1f1b_value_and_grad(
 
             def unit_scalar(p, hp, x, cot, target):
                 # same key as the forward unit -> identical dropout masks
-                # in the recompute, so the vjp is exact
-                y = call_stage(p, x.astype(probe.dtype), i_b_c).astype(f32)
+                # in the recompute, so the vjp is exact; the recompute's
+                # aux is discarded (already counted at the forward unit)
+                y, _ = call_stage(p, x.astype(probe.dtype), i_b_c)
+                y = y.astype(f32)
                 per_mb = loss_fn(hp, y, target)
                 pulled = jnp.vdot(y, cot)
                 return jnp.where(is_last, per_mb, pulled), (y, per_mb)
@@ -531,7 +568,7 @@ def pipeline_1f1b_value_and_grad(
             loss_acc = loss_acc + jnp.where(is_last, per_mb, 0.0)
             return jax.tree.map(mark, (
                 jnp.zeros(mb, f32), dx, stash, dparams, dhead, dmicro,
-                loss_acc,
+                loss_acc, aux_acc,
             ))
 
         # F and B ticks strictly alternate per device, so exactly one (or
@@ -539,8 +576,9 @@ def pipeline_1f1b_value_and_grad(
         # clipped index and the result is never consumed
         res = jax.lax.cond(do_b, bwd_unit, fwd_unit,
                            (fwd_buf, bwd_buf, stash, dparams, dhead,
-                            dmicro, loss_acc))
-        y_send, cot_send, stash, dparams, dhead, dmicro, loss_acc = res
+                            dmicro, loss_acc, aux_acc))
+        (y_send, cot_send, stash, dparams, dhead, dmicro, loss_acc,
+         aux_acc) = res
         y_send = jnp.where(do_f, y_send, jnp.zeros(mb, f32))
         cot_send = jnp.where(do_b, cot_send, jnp.zeros(mb, f32))
         fwd_buf = jax.lax.ppermute(y_send, axis_name, down)
@@ -553,12 +591,12 @@ def pipeline_1f1b_value_and_grad(
         )
         bwd_buf = jnp.where(sender_did_b, bwd_buf_new, bwd_buf)
         return (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro,
-                loss_acc), None
+                loss_acc, aux_acc), None
 
-    carry0 = (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc)
-    (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc), _ = (
-        jax.lax.scan(tick, carry0, jnp.arange(ticks))
-    )
+    carry0 = (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc,
+              aux0 if with_aux else mark(jnp.zeros(())))
+    (fwd_buf, bwd_buf, stash, dparams, dhead, dmicro, loss_acc,
+     aux_sum), _ = jax.lax.scan(tick, carry0, jnp.arange(ticks))
     loss = jax.lax.psum(
         jnp.where(is_last, loss_acc, 0.0), axis_name
     ) / n_micro
@@ -568,6 +606,8 @@ def pipeline_1f1b_value_and_grad(
         jnp.where(stage_id == 0, dmicro, jnp.zeros_like(dmicro)), axis_name
     ) / n_micro
     dstage = jax.tree.map(lambda a: (a / n_micro)[None], dparams)
+    if with_aux:
+        return loss, dstage, dhead, dmicro, aux_sum
     return loss, dstage, dhead, dmicro
 
 

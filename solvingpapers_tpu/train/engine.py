@@ -456,7 +456,11 @@ class Trainer:
                 "pipe depth; pp_grad_groups adds only bubbles — use one "
                 "or the other"
             )
-        if self.loss_fn is not lm_loss_fn:
+        from solvingpapers_tpu.train.objectives import (
+            dsv3_loss_fn as _dsv3_loss_fn,
+        )
+
+        if self.loss_fn is not lm_loss_fn and self.loss_fn is not _dsv3_loss_fn:
             raise NotImplementedError(
                 "pp_schedule='1f1b' computes its objective inside the "
                 "schedule (the model's f1b_value_and_grad), so a custom "
@@ -480,20 +484,27 @@ class Trainer:
                 # count (the mean the replicated-param grads need)
                 return jax.lax.psum(a, ("data", "fsdp")) / n_shards
 
-            def local(params, batch, rng):
+            def local(params, ms, batch, rng):
                 # decorrelate dropout masks across data shards (pipe
                 # devices share the key: they must agree on the masks the
                 # schedule's units regenerate)
                 rng = jax.random.fold_in(
                     rng, jax.lax.axis_index(("data", "fsdp"))
                 )
-                loss, grads = self.model.f1b_value_and_grad(
-                    params, batch, rng=rng
+                out = self.model.f1b_value_and_grad(
+                    params, batch, rng=rng, model_state=ms
                 )
+                loss, grads, new_ms = out[0], out[1], out[2]
+                # optional 4th element: extra train metrics (the
+                # flagship's MoE routing stats)
+                extra = out[3] if len(out) > 3 else {}
                 loss = mean_over_data(loss)
                 grads = jax.tree.map(mean_over_data, grads)
-                aux = {"perplexity": jnp.exp(loss)}
-                return loss, aux, grads
+                aux = {
+                    "perplexity": jnp.exp(loss),
+                    **{k: mean_over_data(v) for k, v in extra.items()},
+                }
+                return loss, aux, grads, new_ms
 
             # check_vma OFF deliberately (not just for flash models): under
             # the vma checker, vjp cotangents w.r.t. data-replicated params
@@ -504,13 +515,13 @@ class Trainer:
             # holds its shard-local grads (verified against per-shard
             # oracles), and the ONE explicit psum/n above is the whole
             # cross-shard story.
-            loss, aux, grads = jax.shard_map(
+            loss, aux, grads, new_ms = jax.shard_map(
                 local, mesh=self.mesh,
-                in_specs=(p_specs, batch_specs, P()),
-                out_specs=(P(), P(), p_specs),
+                in_specs=(p_specs, P(), batch_specs, P()),
+                out_specs=(P(), P(), p_specs, P()),
                 check_vma=False,
-            )(params, batch, rng)
-            return loss, aux, model_state, grads
+            )(params, model_state, batch, rng)
+            return loss, aux, new_ms, grads
 
         return call
 

@@ -331,6 +331,42 @@ def test_dsv3_pp_interleaved_trainer_matches_dense(devices):
                                    rtol=3e-4, atol=3e-4)
 
 
+def test_dsv3_pp_1f1b_trainer_matches_gpipe(devices):
+    """The FLAGSHIP through TrainConfig.pp_schedule='1f1b': MoE routing
+    loads ride the schedule's aux channel and the aux-free bias update
+    recombines exactly like the GPipe path — loss, params AND moe_state
+    must match the GPipe-schedule trainer."""
+    batch = _batch(jax.random.key(3))
+    mesh_cfg = MeshConfig(data=2, pipe=2)
+
+    def run(schedule):
+        model, train = _cfgs(True, mesh_cfg, n_stages=2, n_microbatches=4)
+        train = dataclasses.replace(train, steps=1, pp_schedule=schedule)
+        state, metrics = _run(model, train, mesh_cfg, devices[:4], batch,
+                              steps=1)
+        return (float(jax.device_get(metrics["train_loss"])),
+                jax.device_get(state.params),
+                jax.device_get(state.model_state))
+
+    l_ref, p_ref, ms_ref = run("gpipe")
+    l_new, p_new, ms_new = run("1f1b")
+    np.testing.assert_allclose(l_new, l_ref, rtol=1e-5)
+    # tree.map verifies STRUCTURE too (a dropped passthrough state key
+    # must fail, not silently truncate a leaf zip)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        ),
+        ms_new, ms_ref,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-4
+        ),
+        p_new, p_ref,
+    )
+
+
 def test_dsv3_pipe_interleaved_to_dense_roundtrip():
     """Interleaved storage layout (row d*v + j = global stage j*P + d):
     the dense oracle and to_dense export must agree with the GPipe-layout
