@@ -13,9 +13,24 @@ Layout (see SURVEY.md §7):
     data/       tokenizers + dataset/batch pipelines
     train/      the single training engine
     infer/      jitted prefill/decode with KV caches
+    serve/      continuous-batching engine: slot pool, FIFO scheduler, mixed step
     checkpoint/ Orbax checkpoint manager + params-only export
     metrics/    console/JSONL metrics writers, MFU accounting
     configs/    typed run configs for every workload
 """
 
 __version__ = "0.1.0"
+
+_SERVE_API = ("ServeEngine", "ServeConfig", "KVSlotPool", "FIFOScheduler",
+              "Request", "ServeMetrics")
+
+
+def __getattr__(name):
+    # serve API re-exported lazily (PEP 562): `solvingpapers_tpu.ServeEngine`
+    # works without `import solvingpapers_tpu` dragging in jax/flax for
+    # consumers that only want metadata
+    if name in _SERVE_API:
+        from solvingpapers_tpu import serve
+
+        return getattr(serve, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -382,6 +382,39 @@ def cmd_sample(args) -> int:
     return 0
 
 
+def cmd_serve_bench(args) -> int:
+    """Continuous-batching engine vs sequential one-shot generate on a
+    synthetic Poisson arrival stream (serve/bench.py); prints the BENCH-
+    shaped JSON and optionally writes it to --out."""
+    if args.checkpoint_dir or args.data_path:
+        print(
+            "serve-bench benchmarks scheduling throughput on random-init "
+            "params; --checkpoint-dir/--data-path are not consumed",
+            file=sys.stderr,
+        )
+        return 2
+    from solvingpapers_tpu.serve.bench import run_serve_bench
+
+    result = run_serve_bench(
+        config=args.config,
+        n_requests=args.requests,
+        n_slots=args.slots,
+        max_new=args.max_new_tokens,
+        decode_block=args.decode_block,
+        prompt_lens=tuple(args.prompt_lens),
+        mean_interarrival_s=args.mean_interarrival,
+        seed=args.seed,
+        skip_sequential=args.skip_sequential,
+    )
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"[serve-bench] wrote {args.out}", file=sys.stderr)
+    return 0
+
+
 def _restore_for_inference(cfg, model, checkpoint_dir, example_batch, trainer=None):
     """Shared restore path: returns (state, params, extra_variables) from
     the newest checkpoint, or None if the directory is empty."""
@@ -521,6 +554,25 @@ def main(argv=None) -> int:
     )
     p_sample.add_argument("--seed", type=int, default=0)
 
+    p_serve = sub.add_parser("serve-bench")
+    _add_common(p_serve)
+    p_serve.add_argument("--requests", type=int, default=32)
+    p_serve.add_argument("--slots", type=int, default=8)
+    p_serve.add_argument("--max-new-tokens", type=int, default=64)
+    p_serve.add_argument("--decode-block", type=int, default=16)
+    p_serve.add_argument("--prompt-lens", type=int, nargs="+",
+                         default=[16, 32, 48, 64],
+                         help="prompt-length cycle (bounded set => bounded "
+                              "compiles in both arms)")
+    p_serve.add_argument("--mean-interarrival", type=float, default=0.001,
+                         help="Poisson arrival mean gap in seconds")
+    p_serve.add_argument("--seed", type=int, default=0)
+    p_serve.add_argument("--skip-sequential", action="store_true",
+                         help="only run the engine arm")
+    p_serve.add_argument("--out", default=None,
+                         help="also write the JSON result here "
+                              "(tools/bench_serve.py default: BENCH_serve.json)")
+
     p_eval = sub.add_parser("eval")
     _add_common(p_eval)
 
@@ -536,6 +588,7 @@ def main(argv=None) -> int:
         "list": cmd_list,
         "train": cmd_train,
         "sample": cmd_sample,
+        "serve-bench": cmd_serve_bench,
         "eval": cmd_eval,
         "export": cmd_export,
     }[args.cmd](args)

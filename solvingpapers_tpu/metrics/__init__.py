@@ -5,8 +5,10 @@ from solvingpapers_tpu.metrics.writer import (
     ConsoleWriter,
     JSONLWriter,
     MultiWriter,
+    Ring,
     TensorBoardWriter,
     WandbWriter,
+    percentiles,
 )
 from solvingpapers_tpu.metrics.mfu import (
     transformer_flops_per_token,

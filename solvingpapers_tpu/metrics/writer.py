@@ -14,7 +14,67 @@ import json
 import os
 import sys
 import time
-from typing import IO, Mapping
+from typing import IO, Iterable, Mapping
+
+import numpy as np
+
+
+def percentiles(
+    values: Iterable[float], qs: tuple[float, ...] = (50, 95, 99)
+) -> dict[str, float]:
+    """Summarize observations as ``{"p50": ..., "p95": ..., "p99": ...}``.
+
+    One shared aggregation for every latency-style metric (serve TTFT /
+    inter-token latency, step times) so sinks don't hand-roll their own.
+    Keys drop a trailing ``.0`` (``p99.9`` stays ``p99.9``). Empty input
+    returns ``{}`` — absent beats NaN in a metrics line.
+    """
+    arr = np.asarray(list(values), dtype=np.float64)
+    if arr.size == 0:
+        return {}
+    out = {}
+    for q in qs:
+        label = f"p{int(q)}" if float(q).is_integer() else f"p{q}"
+        out[label] = float(np.percentile(arr, q))
+    return out
+
+
+class Ring:
+    """Bounded ring buffer of scalar observations with percentile summary.
+
+    Long-lived serving loops observe unbounded streams (one latency per
+    token); the ring keeps the last `capacity` of them so memory stays
+    constant and the summary tracks recent behavior.
+    """
+
+    def __init__(self, capacity: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._buf = np.empty(capacity, np.float64)
+        self._n = 0  # total ever added; min(_n, capacity) are live
+
+    def __len__(self) -> int:
+        return min(self._n, self.capacity)
+
+    @property
+    def total_added(self) -> int:
+        return self._n
+
+    def add(self, value: float) -> None:
+        self._buf[self._n % self.capacity] = float(value)
+        self._n += 1
+
+    def values(self) -> np.ndarray:
+        return self._buf[: len(self)].copy()
+
+    def mean(self) -> float:
+        return float(self._buf[: len(self)].mean()) if len(self) else float("nan")
+
+    def percentiles(
+        self, qs: tuple[float, ...] = (50, 95, 99)
+    ) -> dict[str, float]:
+        return percentiles(self._buf[: len(self)], qs)
 
 
 class MetricsWriter:

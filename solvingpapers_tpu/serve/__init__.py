@@ -1,0 +1,7 @@
+"""Continuous-batching serving: slot pool + FIFO scheduler + mixed
+prefill/decode engine + latency metrics."""
+
+from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
+from solvingpapers_tpu.serve.metrics import ServeMetrics
+from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request

@@ -1,0 +1,221 @@
+"""Serving benchmark: continuous batching vs sequential one-shot generate.
+
+Synthetic open-loop workload: request arrivals are a Poisson process
+(exponential interarrivals, seeded), prompts are slices of the
+deterministic synthetic corpus (`data/synthetic.synthetic_text`) encoded
+to model token ids. Two arms replay the SAME arrival offsets:
+
+* engine — one `ServeEngine`; the driver submits each request when the
+  wall clock passes its arrival offset and keeps calling `step()`.
+* sequential — the status quo ante: per-request one-shot
+  `infer.generate` (batch 1), each request starting at
+  ``max(previous finish, its arrival)``.
+
+Both arms are warmed first (every compiled shape traced before timing)
+so the comparison is steady-state serving throughput, not tracing time.
+Requests/s = n_requests / (last finish - first arrival).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+
+_DECODER_FAMILIES = ("gpt", "llama3", "gemma", "deepseekv3")
+
+
+def build_serve_model(config_name: str):
+    """(model, params, extra_variables, vocab_size) for a registered
+    decoder config — the serve-side analogue of `cli.cmd_sample`'s setup,
+    minus data/tokenizer plumbing (the bench feeds raw token ids)."""
+    import dataclasses
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_model
+
+    cfg = get_config(config_name)
+    if cfg.model_family not in _DECODER_FAMILIES:
+        raise ValueError(
+            f"config {config_name!r} is family {cfg.model_family!r}; "
+            f"serve-bench needs a decoder family {_DECODER_FAMILIES}"
+        )
+    if cfg.train.pipeline_parallel:
+        raise ValueError(
+            "pipeline-parallel configs have stage-stacked params; export "
+            "to the dense family before serving"
+        )
+    if getattr(cfg.model, "context_parallel", False):
+        # params are replicated at rest: serve through the dense twin,
+        # exactly like cmd_sample's single-chip path
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, context_parallel=False)
+        )
+    model = build_model(cfg)
+    toks = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.key(0)}, toks)
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    return model, params, extra or None, cfg.model.vocab_size
+
+
+def synthetic_requests(
+    n: int,
+    vocab_size: int,
+    prompt_lens=(8, 16, 24, 32),
+    mean_interarrival_s: float = 0.002,
+    seed: int = 0,
+):
+    """[(arrival_offset_s, prompt ids)] — Poisson arrivals, corpus prompts.
+
+    Prompt lengths cycle through a small fixed set so both arms compile a
+    bounded number of shapes (the sequential arm retraces `generate` per
+    distinct prompt length).
+    """
+    from solvingpapers_tpu.data.synthetic import synthetic_text
+
+    rng = np.random.default_rng(seed)
+    text = synthetic_text(n_chars=max(4096, n * max(prompt_lens) * 2),
+                          seed=seed)
+    corpus = np.frombuffer(text.encode("ascii", "replace"), np.uint8)
+    ids = corpus.astype(np.int32) % vocab_size
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, size=n))
+    out = []
+    for i in range(n):
+        length = prompt_lens[i % len(prompt_lens)]
+        start = int(rng.integers(0, ids.size - length))
+        out.append((float(arrivals[i]), ids[start:start + length]))
+    return out
+
+
+def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new):
+    eng = ServeEngine(model, params, serve_cfg, extra_variables=extra)
+    pending = sorted(requests, key=lambda r: r[0])
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or eng.has_work():
+        elapsed = time.monotonic() - t0
+        while i < len(pending) and pending[i][0] <= elapsed:
+            handles.append(eng.submit(pending[i][1], max_new_tokens=max_new))
+            i += 1
+        if eng.has_work():
+            eng.step()
+        elif i < len(pending):
+            # engine idle before the next arrival: busy-wait is pointless
+            # on a bench box, sleep the remaining gap
+            time.sleep(max(0.0, pending[i][0] - (time.monotonic() - t0)))
+    makespan = (time.monotonic() - t0) - pending[0][0]
+    assert all(h.done for h in handles), "engine drained with unfinished work"
+    return eng, handles, makespan
+
+
+def _run_sequential_arm(model, params, extra, requests, max_new):
+    """Per-request one-shot generate at the same arrival offsets."""
+    from solvingpapers_tpu.infer import generate
+
+    rng = jax.random.key(0)
+    ttfts = []
+    cursor = None
+    for arrival, prompt in sorted(requests, key=lambda r: r[0]):
+        start = arrival if cursor is None else max(cursor, arrival)
+        t0 = time.monotonic()
+        out = generate(
+            model, params, jnp.asarray(prompt)[None, :], rng,
+            max_new_tokens=max_new, sampler=ops.sample_greedy,
+            extra_variables=extra,
+        )
+        jax.block_until_ready(out)
+        dur = time.monotonic() - t0
+        cursor = start + dur
+        # one-shot generate emits nothing until the whole batch finishes:
+        # first-token latency == completion latency
+        ttfts.append(cursor - arrival)
+    makespan = cursor - min(a for a, _ in requests)
+    return makespan, float(np.mean(ttfts))
+
+
+def run_serve_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    seed: int = 0,
+    skip_sequential: bool = False,
+) -> dict:
+    """Run both arms, return the BENCH-shaped result dict."""
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    serve_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_prompt + max_new,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        # throughput-oriented: refill the whole pool in one iteration
+        # (the default 1-prefill/step decode-priority protects ITL, but
+        # under a drain-the-queue workload it leaves slots idle)
+        max_prefills_per_step=n_slots,
+        # open-loop arrivals can queue every request at once; the bench
+        # must never shed load or the drained-handles assert trips
+        max_waiting=max(256, n_requests),
+        seed=seed,
+    )
+
+    # warm both arms: trace every compiled shape outside the timed window
+    # (one request per distinct prompt length covers every prefill bucket
+    # and every sequential-arm generate trace; decode is one shape)
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
+    if not skip_sequential:
+        _run_sequential_arm(model, params, extra, warm, max_new)
+
+    eng, handles, makespan = _run_engine_arm(
+        model, params, extra, requests, serve_cfg, max_new
+    )
+    snap = eng.metrics.snapshot()
+    rps = n_requests / makespan
+    detail = {
+        "config": config,
+        "n_requests": n_requests,
+        "n_slots": n_slots,
+        "max_new_tokens": max_new,
+        "decode_block": decode_block,
+        "prompt_lens": list(prompt_lens),
+        "mean_interarrival_s": mean_interarrival_s,
+        "engine_requests_per_sec": round(rps, 2),
+        "engine_tokens_per_sec": round(snap.get("serve/tokens_per_sec", 0.0), 1),
+        "mean_ttft_s": round(snap.get("serve/ttft_s_mean", float("nan")), 4),
+        "ttft_p95_s": round(snap.get("serve/ttft_s_p95", float("nan")), 4),
+        "itl_p95_s": round(snap.get("serve/itl_s_p95", float("nan")), 5),
+        "slot_occupancy": round(snap.get("serve/slot_occupancy", 0.0), 3),
+    }
+    result = {
+        "metric": "serve_requests_per_sec",
+        "value": round(rps, 2),
+        "unit": "req/s",
+        "detail": detail,
+    }
+    if not skip_sequential:
+        seq_makespan, seq_ttft = _run_sequential_arm(
+            model, params, extra, requests, max_new
+        )
+        seq_rps = n_requests / seq_makespan
+        detail["sequential_requests_per_sec"] = round(seq_rps, 2)
+        detail["sequential_mean_ttft_s"] = round(seq_ttft, 4)
+        result["vs_baseline"] = round(rps / seq_rps, 2)
+    return result

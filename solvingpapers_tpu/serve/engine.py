@@ -1,0 +1,409 @@
+"""Continuous-batching serving engine: the long-lived mixed prefill/decode
+step over a slot pool.
+
+`infer.decode.generate` is one static batch to completion — a new request
+waits for the whole previous batch. `ServeEngine` instead advances a pool
+of S independent slots one iteration at a time (Orca-style iteration-level
+scheduling): each `step()` admits waiting requests into free lanes
+(chunked prefill, same end-aligned attend_len contract as `generate`),
+then advances every active slot by a block of single-token steps, emitting
+per-request token streams as they materialize. A slot freed by an
+early-EOS sequence is re-acquired by the next queued request immediately
+— the batch never drains.
+
+Static shapes throughout (XLA requirement): the batch dimension of every
+jitted program is the slot count, inactive slots run masked dummy steps
+(their writes land in lane slot 0, overwritten by the next prefill;
+masked-softmax zeros annihilate stale finite values exactly — see
+`serve/kv_pool.py`). Per-slot positions are made possible by `vmap`ping a
+batch-1 single-token apply over the slot axis: the models' cached
+attention writes at ``positions[0, 0]`` (one scalar per call), and under
+vmap that scalar is per-slot — so every decoder family (gpt, llama3,
+gemma, deepseekv3) serves unmodified.
+
+Compiled-program inventory (bounded by construction): ONE decode program
+(every block runs the full `decode_block`; a slot that hits EOS or its
+budget mid-block keeps stepping and the host discards its overshoot —
+the wasted writes stay inside that slot's own lane, which the next
+prefill overwrites), one prefill program per prompt bucket (prompts pad
+right to a multiple of ``bucket``; the pad region is causally invisible
+to real tokens and its cache slots are overwritten by the decode stream
+before ever being attended).
+
+Greedy streams are token-exact vs per-request one-shot `generate`
+(tests/test_serve.py); stochastic samplers draw from a different rng
+chain than `generate` and match only in distribution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from solvingpapers_tpu import ops
+from solvingpapers_tpu.serve import metrics as smetrics
+from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
+from solvingpapers_tpu.serve.metrics import ServeMetrics
+from solvingpapers_tpu.serve.scheduler import (
+    ACTIVE,
+    FINISHED,
+    FIFOScheduler,
+    Request,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Engine shape/policy knobs.
+
+    `decode_block` amortizes host dispatch: each decode program advances
+    all slots `block` tokens in one `lax.scan` before the host looks at
+    the stream again (termination granularity = one block; EOS discovered
+    mid-block discards the padded tail, matching `generate`'s
+    pad-with-EOS semantics). `bucket` quantizes prefill lengths so the
+    number of compiled prefill programs stays bounded — use a multiple of
+    128 for `use_flash` models (the Pallas q-block constraint).
+    """
+
+    n_slots: int = 8
+    max_len: int = 512
+    decode_block: int = 8
+    bucket: int = 64
+    prefill_chunk: int | None = None
+    max_waiting: int = 256
+    decode_priority: bool = True
+    max_prefills_per_step: int = 1
+    max_wait_steps: int = 64
+    eos_id: int | None = None  # default per-request EOS (None = run to budget)
+    seed: int = 0
+
+
+_UNSET = object()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "sampler", "padded", "chunk"),
+    donate_argnames=("caches",),
+)
+def _prefill_program(model, sampler, padded, chunk, variables, caches, prompt, ctl, rng):
+    """Prefill one request into lane `ctl[0]` and sample its first token.
+
+    `prompt` is (padded,) right-padded; `ctl = [slot, length, step]` is
+    the host's packed control word (one transfer instead of three — the
+    host loop's dispatch overhead is the serving bottleneck on small
+    models, see tools/bench_serve.py), where `length` is the real token
+    count, so one compiled program serves every prompt in the bucket.
+    `rng` is the engine's base key, decorrelated per call by folding in
+    the step counter. Chunks mirror `generate`'s static-bound python
+    loop; the logits row for the LAST REAL token is gathered from
+    whichever chunk contains it (padding makes that not-necessarily-the-
+    last chunk).
+    """
+    slot, length = ctl[0], ctl[1]
+    rng = jax.random.fold_in(rng, ctl[2])
+    lane = extract_lane(caches, slot)
+    toks = prompt[None, :]
+    step = chunk or padded
+    last = None
+    for start in range(0, padded, step):
+        end = min(start + step, padded)
+        tok_chunk = jax.lax.slice_in_dim(toks, start, end, axis=1)
+        positions = jnp.broadcast_to(jnp.arange(start, end), (1, end - start))
+        logits, lane = model.apply(
+            variables, tok_chunk, positions=positions, caches=lane,
+            deterministic=True, attend_len=end,
+        )
+        idx = jnp.clip(length - 1 - start, 0, end - start - 1)
+        row = jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0,
+                                           keepdims=False)
+        sel = (length - 1 >= start) & (length - 1 < end)
+        last = row if last is None else jnp.where(sel, row, last)
+    first = sampler(last[None], rng)[0].astype(jnp.int32)
+    return store_lane(caches, lane, slot), first
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "sampler", "block"),
+    donate_argnames=("caches",),
+)
+def _decode_program(model, sampler, block, variables, caches, state, rng):
+    """Advance every slot `block` tokens; inactive slots run masked.
+
+    `state` is the host's packed (5, n_slots) int32 control block —
+    rows [toks, pos, active, eos, step] — so each call costs ONE
+    host->device transfer; the host keeps a numpy mirror of toks/pos and
+    only the emitted stream `out` comes back. `rng` is the engine's base
+    key (a constant buffer), decorrelated per block by folding in the
+    step counter riding row 4.
+
+    The per-slot apply is a batch-1 single-token forward vmapped over the
+    slot axis — per-slot positions and per-slot cache writes fall out of
+    the models' ``positions[0, 0]`` write contract under vmap. EOS
+    padding is sticky by induction (an emitted EOS forces every later
+    emission to EOS), mirroring `generate`'s done-flag semantics.
+    """
+    toks, pos = state[0], state[1]
+    active, eos = state[2].astype(bool), state[3]
+    rng = jax.random.fold_in(rng, state[4, 0])
+
+    def one(tok, p, slot_caches):
+        lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
+        logits, lane = model.apply(
+            variables, tok[None, None], positions=jnp.reshape(p, (1, 1)),
+            caches=lane, deterministic=True,
+        )
+        return logits[0, 0], jax.tree_util.tree_map(
+            lambda a: jnp.squeeze(a, axis=0), lane
+        )
+
+    def step(carry, sub):
+        toks, pos, caches = carry
+        logits, caches = jax.vmap(one)(toks, pos, caches)
+        nxt = sampler(logits, sub).astype(toks.dtype)
+        hit_eos = (eos >= 0) & (toks == eos)
+        nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
+        nxt = jnp.where(active, nxt, toks)
+        pos = jnp.where(active, pos + 1, pos)
+        return (nxt, pos, caches), nxt
+
+    (toks, pos, caches), out = jax.lax.scan(
+        step, (toks, pos, caches), jax.random.split(rng, block)
+    )
+    return caches, out
+
+
+class ServeEngine:
+    """Long-lived continuous-batching engine over one decoder model.
+
+    >>> eng = ServeEngine(model, params, ServeConfig(n_slots=4))
+    >>> reqs = [eng.submit(p, max_new_tokens=64) for p in prompts]
+    >>> eng.run()              # drain: step() until queue + slots empty
+    >>> reqs[0].tokens         # per-request generated ids
+
+    `submit` is non-blocking (admission control may mark the request
+    ``rejected``); `step()` is one scheduler iteration and may be driven
+    by an external loop that interleaves new submissions — that is the
+    point of continuous batching.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        config: ServeConfig | None = None,
+        *,
+        sampler=ops.sample_greedy,
+        extra_variables: dict | None = None,
+        metrics_window: int = 4096,
+    ):
+        cfg = config or ServeConfig()
+        limit = getattr(model, "max_positions", None)
+        if limit is not None and cfg.max_len > limit:
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's max positions "
+                f"{limit}"
+            )
+        self.model = model
+        self.config = cfg
+        self.sampler = sampler
+        self.variables = {"params": params, **(extra_variables or {})}
+        self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
+        self.scheduler = FIFOScheduler(
+            max_waiting=cfg.max_waiting,
+            decode_priority=cfg.decode_priority,
+            max_prefills_per_step=cfg.max_prefills_per_step,
+            max_wait_steps=cfg.max_wait_steps,
+        )
+        self.metrics = ServeMetrics(window=metrics_window)
+        self._slot_req: list[Request | None] = [None] * cfg.n_slots
+        # host-side numpy mirrors of per-slot decode state: shipped to the
+        # device as ONE packed array per jitted call — eager .at[].set
+        # bookkeeping was half the drain time on small models
+        self._toks = np.zeros(cfg.n_slots, np.int32)
+        self._pos = np.zeros(cfg.n_slots, np.int32)
+        self._rng = jax.random.key(cfg.seed)  # base key; folded per call
+        self._rng_step = 0
+        self._last_emit = np.zeros(cfg.n_slots)  # per-slot last emit time
+
+    # ------------------------------------------------------------- submit
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 64,
+        eos_id=_UNSET,
+    ) -> Request:
+        """Enqueue one request; returns its live handle immediately."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + max_new_tokens
+        limit = getattr(self.model, "max_positions", None)
+        cap = min(self.config.max_len, limit or self.config.max_len)
+        if total > cap:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds the engine capacity {cap} "
+                "(min of ServeConfig.max_len and the model's max positions)"
+            )
+        req = Request(
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            eos_id=self.config.eos_id if eos_id is _UNSET else eos_id,
+        )
+        if not self.scheduler.submit(req):
+            self.metrics.record_reject()
+        return req
+
+    # --------------------------------------------------------------- step
+
+    def has_work(self) -> bool:
+        return bool(len(self.scheduler)) or self.pool.n_active > 0
+
+    def step(self) -> list[Request]:
+        """One engine iteration: admit + prefill, then one decode block.
+
+        Returns the requests that FINISHED this iteration.
+        """
+        finished: list[Request] = []
+        for req in self.scheduler.pick(self.pool.n_free, self.pool.n_active):
+            if self._admit(req):
+                finished.append(req)  # prefill-only finish (eos/budget 1)
+        if self.pool.n_active > 0:
+            finished.extend(self._decode_block())
+        self.scheduler.tick()
+        self.metrics.record_step(self.pool.occupancy)
+        return finished
+
+    def run(self, max_steps: int | None = None) -> None:
+        """Drive step() until queue and slots drain (or `max_steps`)."""
+        steps = 0
+        while self.has_work():
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                return
+
+    # ------------------------------------------------------------ private
+
+    def _bucketed(self, length: int) -> int:
+        b = self.config.bucket
+        padded = -(-length // b) * b
+        limit = getattr(self.model, "max_positions", None)
+        return max(length, min(padded, self.config.max_len,
+                               limit or padded))
+
+    def _admit(self, req: Request) -> bool:
+        """Prefill `req` into a free lane; True if it finished already."""
+        slot = self.pool.acquire()
+        assert slot is not None, "scheduler admitted beyond free slots"
+        now = smetrics.now()
+        req.state = ACTIVE
+        req.slot = slot
+        req.admit_time = now
+        self.metrics.record_admit(req, now)
+
+        length = int(req.prompt.size)
+        padded = self._bucketed(length)
+        chunk = self.config.prefill_chunk
+        if chunk is None and padded > 4096:
+            chunk = 2048  # same auto-chunk threshold as infer.decode.generate
+        if chunk is not None and chunk >= padded:
+            chunk = None
+        prompt_padded = np.zeros(padded, np.int32)
+        prompt_padded[:length] = req.prompt
+        ctl = np.asarray([slot, length, self._rng_step], np.int32)
+        self._rng_step += 1
+        self.pool.caches, first = _prefill_program(
+            self.model, self.sampler, padded, chunk, self.variables,
+            self.pool.caches, jnp.asarray(prompt_padded), jnp.asarray(ctl),
+            self._rng,
+        )
+        first = int(first)
+        now = smetrics.now()
+        req.first_token_time = now
+        req.tokens.append(first)
+        self.metrics.record_first_token(req, now)
+        self._last_emit[slot] = now
+        self.pool.positions[slot] = length
+        self._toks[slot] = first
+        self._pos[slot] = length
+        self._slot_req[slot] = req
+        if req.eos_id is not None and first == req.eos_id:
+            reason = "eos"
+        elif req.remaining == 0:
+            reason = "length"
+        else:
+            return False
+        self._finish(req, reason, now)
+        return True
+
+    def _decode_block(self) -> list[Request]:
+        cfg = self.config
+        block = cfg.decode_block
+        state = np.zeros((5, cfg.n_slots), np.int32)
+        state[0] = self._toks
+        state[1] = self._pos
+        state[3] = -1
+        for slot, r in enumerate(self._slot_req):
+            if r is not None:
+                state[2, slot] = 1
+                if r.eos_id is not None:
+                    state[3, slot] = r.eos_id
+        state[4] = self._rng_step
+        self._rng_step += 1
+        self.pool.caches, out = _decode_program(
+            self.model, self.sampler, block, self.variables,
+            self.pool.caches, jnp.asarray(state), self._rng,
+        )
+        out = np.asarray(out)  # (block, n_slots); overshoot truncated below
+        now = smetrics.now()
+        finished: list[Request] = []
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            appended = 0
+            reason = None
+            for t in out[:, slot]:
+                req.tokens.append(int(t))
+                appended += 1
+                if req.eos_id is not None and int(t) == req.eos_id:
+                    reason = "eos"  # tail of the block is EOS padding
+                    break
+                if req.remaining == 0:
+                    reason = "length"
+                    break
+            self.metrics.record_tokens(
+                req, appended, now - self._last_emit[slot], now
+            )
+            self._last_emit[slot] = now
+            self.pool.positions[slot] += appended
+            if reason is not None:
+                self._finish(req, reason, now)
+                finished.append(req)
+            else:
+                # mirror the device carry: the slot ran the full block
+                self._toks[slot] = out[-1, slot]
+                self._pos[slot] += block
+        return finished
+
+    def _finish(self, req: Request, reason: str, now: float) -> None:
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.finish_time = now
+        self.metrics.record_finish(req, now)
+        slot = req.slot
+        self._slot_req[slot] = None
+        # park the idle lane at position 0: its masked dummy writes land
+        # in slot 0, which the next prefill overwrites first
+        self._toks[slot] = 0
+        self._pos[slot] = 0
+        self.pool.release(slot)

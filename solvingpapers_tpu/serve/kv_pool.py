@@ -1,0 +1,102 @@
+"""Fixed-size pool of per-slot KV/latent cache lanes.
+
+Continuous batching needs slot-granular cache reuse: when one sequence
+finishes, its cache storage must be handed to the next queued request
+immediately, without waiting for the rest of the batch (the vLLM
+PagedAttention insight, applied at lane granularity — one lane per slot
+rather than paged blocks, because the repo's caches are preallocated
+static-shape pytrees and XLA wants the batch dimension fixed).
+
+The pool is carved out of the existing cache machinery unchanged: the
+pooled pytrees come from ``model.init_caches(n_slots, max_len)``
+(`infer/cache.py` KVCache / LatentCache — any family works), so the batch
+dimension IS the slot dimension. Lane extraction/insertion are pytree
+``dynamic_slice`` helpers meant to be traced inside the engine's jitted
+programs (`serve/engine.py`); acquire/release bookkeeping is host-side.
+
+Stale-data contract: a freed lane is NOT zeroed. Reuse is safe because
+(a) prefill overwrites slots ``[0, P)`` of the lane before any attention
+over it, and (b) decode masks with ``kv_index <= position`` (the cache
+masking contract of `infer/cache.py`), so slots beyond the current length
+never contribute — and every stale value is finite (written by a real
+forward), so masked-softmax zeros annihilate it exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def extract_lane(caches, slot):
+    """Slice slot `slot`'s batch-1 lane out of pooled caches (traced)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=0), caches
+    )
+
+
+def store_lane(caches, lane, slot):
+    """Write a batch-1 lane back into the pooled caches at `slot` (traced)."""
+    return jax.tree_util.tree_map(
+        lambda a, l: jax.lax.dynamic_update_slice_in_dim(
+            a, l.astype(a.dtype), slot, axis=0
+        ),
+        caches,
+        lane,
+    )
+
+
+class KVSlotPool:
+    """`n_slots` cache lanes + free-list bookkeeping.
+
+    `caches` is the pooled pytree (list of per-layer caches, batch dim =
+    slot); the engine reassigns it after every jitted step (functional
+    updates, donated buffers). `positions[slot]` is the pool's public
+    per-lane fill level — how many cache slots hold real KV entries:
+    prompt plus every emitted token except the newest (a sampled token's
+    KV is only written when it is fed back on the next step) — for
+    introspection and capacity accounting. It is deliberately distinct
+    from the engine's private device-carry mirror, which also counts the
+    discarded overshoot of full-block decode steps. Freed lanes reset
+    to 0.
+    """
+
+    def __init__(self, model, n_slots: int, max_len: int):
+        if n_slots < 1:
+            raise ValueError(f"need at least one slot, got {n_slots}")
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.caches = model.init_caches(n_slots, max_len)
+        self.positions = np.zeros(n_slots, np.int32)
+        # LIFO free list, seeded so acquire() hands out slot 0 first —
+        # recently-freed lanes are reused while their buffers are warm
+        self._free = list(range(n_slots - 1, -1, -1))
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_active / self.n_slots
+
+    def acquire(self) -> int | None:
+        """Claim a free lane (or None when the pool is exhausted)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.positions[slot] = 0
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return a lane to the pool; it is immediately reusable."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free (double release)")
+        self.positions[slot] = 0
+        self._free.append(slot)

@@ -1,0 +1,112 @@
+"""Serving observability: TTFT, inter-token latency, throughput, occupancy.
+
+Latency observations flow into bounded rings (`metrics.writer.Ring`) and
+summaries flow out through the existing `MetricsWriter` sink interface —
+the same channel train-loop metrics ride, so a serve process logs to
+console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
+
+    serve/ttft_s_*           submit -> first token (includes queue wait)
+    serve/itl_s_*            gap between consecutive token emissions
+    serve/queue_wait_s_*     submit -> slot admission
+    serve/tokens_per_sec     generated tokens / elapsed wall time
+    serve/requests_per_sec   finished requests / elapsed wall time
+    serve/slot_occupancy     mean fraction of slots decoding, per iteration
+"""
+
+from __future__ import annotations
+
+import time
+
+from solvingpapers_tpu.metrics.writer import MetricsWriter, Ring
+
+
+class ServeMetrics:
+    """Engine-side collector; one instance per `ServeEngine`."""
+
+    def __init__(self, window: int = 4096):
+        self.ttft = Ring(window)
+        self.itl = Ring(window)
+        self.queue_wait = Ring(window)
+        self.occupancy = Ring(window)
+        self.tokens_out = 0
+        self.prefill_tokens = 0
+        self.requests_finished = 0
+        self.requests_rejected = 0
+        self.steps = 0
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+
+    def _touch(self, now: float) -> None:
+        if self._t_first is None:
+            self._t_first = now
+        self._t_last = now
+
+    @property
+    def elapsed_s(self) -> float:
+        if self._t_first is None or self._t_last is None:
+            return 0.0
+        return self._t_last - self._t_first
+
+    def record_reject(self) -> None:
+        self.requests_rejected += 1
+
+    def record_admit(self, req, now: float) -> None:
+        self._touch(now)
+        self.queue_wait.add(now - req.submit_time)
+
+    def record_first_token(self, req, now: float) -> None:
+        self._touch(now)
+        self.ttft.add(now - req.submit_time)
+        self.tokens_out += 1
+        self.prefill_tokens += len(req.prompt)
+
+    def record_tokens(self, req, n: int, span_s: float, now: float) -> None:
+        """`n` tokens emitted for `req` over `span_s` seconds (a decode
+        block emits in bursts; the per-token gap is the amortized span)."""
+        self._touch(now)
+        self.tokens_out += n
+        if n > 0:
+            per_tok = span_s / n
+            for _ in range(n):
+                self.itl.add(per_tok)
+
+    def record_finish(self, req, now: float) -> None:
+        self._touch(now)
+        self.requests_finished += 1
+
+    def record_step(self, occupancy: float) -> None:
+        self.steps += 1
+        self.occupancy.add(occupancy)
+
+    def snapshot(self) -> dict[str, float]:
+        """Current aggregate view, flat keys ready for a MetricsWriter."""
+        out = {
+            "serve/tokens_out": float(self.tokens_out),
+            "serve/requests_finished": float(self.requests_finished),
+            "serve/requests_rejected": float(self.requests_rejected),
+            "serve/steps": float(self.steps),
+        }
+        elapsed = self.elapsed_s
+        if elapsed > 0:
+            out["serve/tokens_per_sec"] = self.tokens_out / elapsed
+            out["serve/requests_per_sec"] = self.requests_finished / elapsed
+        if len(self.occupancy):
+            out["serve/slot_occupancy"] = self.occupancy.mean()
+        for name, ring in (
+            ("ttft_s", self.ttft),
+            ("itl_s", self.itl),
+            ("queue_wait_s", self.queue_wait),
+        ):
+            if len(ring):
+                out[f"serve/{name}_mean"] = ring.mean()
+                for k, v in ring.percentiles().items():
+                    out[f"serve/{name}_{k}"] = v
+        return out
+
+    def emit(self, writer: MetricsWriter, step: int | None = None) -> None:
+        writer.write(self.steps if step is None else step, self.snapshot())
+
+
+def now() -> float:
+    """The engine's clock (monotonic; patchable in tests)."""
+    return time.monotonic()

@@ -1,0 +1,122 @@
+"""Request lifecycle + FIFO admission/interleaving policy (Orca-style
+iteration-level scheduling).
+
+A `Request` is the unit of work the engine tracks from submit to finish;
+the `FIFOScheduler` decides, once per engine iteration, which waiting
+requests get prefilled into free slots. Policy knobs:
+
+* admission control — the waiting queue is bounded (`max_waiting`);
+  submissions beyond it are rejected up front instead of growing an
+  unbounded backlog (the engine surfaces this as `state == "rejected"`).
+* decode priority (default) — while any slot is decoding, at most
+  `max_prefills_per_step` waiting requests are admitted per iteration, so
+  a burst of arrivals cannot stall in-flight token streams behind a wall
+  of prefills. With the pool idle, prefill fills every free slot at once.
+* waiting budget — a request queued for more than `max_wait_steps`
+  engine iterations overrides decode priority: the scheduler admits up to
+  all free slots that iteration, bounding starvation under sustained
+  decode load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+
+import numpy as np
+
+from solvingpapers_tpu.serve import metrics as smetrics
+
+_ids = itertools.count()
+
+WAITING = "waiting"
+ACTIVE = "active"
+FINISHED = "finished"
+REJECTED = "rejected"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its evolving state.
+
+    `tokens` is the output stream: generated ids appended as the engine
+    produces them, ending with the request's `eos_id` when it stopped on
+    EOS (`finish_reason == "eos"`) or after `max_new_tokens` ids
+    (`finish_reason == "length"`).
+    """
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_id: int | None
+    id: int = dataclasses.field(default_factory=lambda: next(_ids))
+    state: str = WAITING
+    tokens: list[int] = dataclasses.field(default_factory=list)
+    finish_reason: str | None = None
+    slot: int | None = None
+    waited_steps: int = 0
+    # late-bound so every engine timestamp shares one clock domain with
+    # serve.metrics.now (patchable in tests/simulation)
+    submit_time: float = dataclasses.field(
+        default_factory=lambda: smetrics.now()
+    )
+    admit_time: float | None = None
+    first_token_time: float | None = None
+    finish_time: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+
+class FIFOScheduler:
+    """Bounded FIFO queue with decode-priority prefill interleaving."""
+
+    def __init__(
+        self,
+        max_waiting: int = 256,
+        decode_priority: bool = True,
+        max_prefills_per_step: int = 1,
+        max_wait_steps: int = 64,
+    ):
+        self.max_waiting = max_waiting
+        self.decode_priority = decode_priority
+        self.max_prefills_per_step = max(1, max_prefills_per_step)
+        self.max_wait_steps = max_wait_steps
+        self.queue: deque[Request] = deque()
+
+    def __len__(self) -> int:
+        return len(self.queue)
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue, or reject when the waiting queue is at capacity."""
+        if len(self.queue) >= self.max_waiting:
+            req.state = REJECTED
+            return False
+        self.queue.append(req)
+        return True
+
+    def pick(self, n_free: int, n_active: int) -> list[Request]:
+        """Pop the requests to prefill this iteration (FIFO order)."""
+        if not self.queue or n_free == 0:
+            return []
+        budget = n_free
+        if (
+            self.decode_priority
+            and n_active > 0
+            and self.queue[0].waited_steps <= self.max_wait_steps
+        ):
+            budget = self.max_prefills_per_step
+        picked = []
+        while self.queue and len(picked) < min(budget, n_free):
+            picked.append(self.queue.popleft())
+        return picked
+
+    def tick(self) -> None:
+        """One engine iteration elapsed for everything still queued."""
+        for req in self.queue:
+            req.waited_steps += 1

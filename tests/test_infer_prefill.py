@@ -124,6 +124,55 @@ def test_generate_autochunks_long_flash_prefill():
                                   np.asarray(prompt))
 
 
+def test_batched_generate_per_sequence_eos_pads_with_eos():
+    """Per-sequence eos_id early-stop in a batched generate: sequences
+    hitting EOS at different steps must pad the rest of their row with
+    EOS (done-flag semantics), not keep sampling — and rows that never
+    emit EOS must be byte-identical to the eos_id=None stream."""
+    model = GPT(GPT_TINY)
+    rng = jax.random.key(7)
+    params = model.init(
+        {"params": rng}, jnp.zeros((1, 8), jnp.int32))["params"]
+    n = 12
+    eos = prompts = ref_gen = None
+    for seed in range(8):
+        prompts = jax.random.randint(jax.random.key(seed), (3, 9), 0,
+                                     GPT_TINY.vocab_size)
+        ref = generate(model, params, prompts, jax.random.key(0),
+                       max_new_tokens=n)
+        ref_gen = np.asarray(ref[:, 9:])
+        # an eos candidate the greedy streams emit at >= 2 DIFFERENT
+        # steps, early enough that the padded tail is non-empty
+        for cand in range(GPT_TINY.vocab_size):
+            firsts = [np.flatnonzero(row == cand) for row in ref_gen]
+            hits = [f[0] for f in firsts if f.size]
+            if len(set(hits)) >= 2 and all(h < n - 1 for h in hits):
+                eos = cand
+                break
+        if eos is not None:
+            break
+    assert eos is not None, "no staggered-EOS candidate in 8 seeds"
+
+    out = generate(model, params, prompts, jax.random.key(0),
+                   max_new_tokens=n, eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out[:, :9]),
+                                  np.asarray(prompts))
+    out_gen = np.asarray(out[:, 9:])
+    stops = []
+    for row_ref, row_out in zip(ref_gen, out_gen):
+        first = np.flatnonzero(row_ref == eos)
+        if first.size:
+            i = int(first[0])
+            stops.append(i)
+            np.testing.assert_array_equal(row_out[: i + 1], row_ref[: i + 1])
+            assert (row_out[i + 1:] == eos).all(), (
+                f"row kept sampling past its EOS at step {i}: {row_out}"
+            )
+        else:
+            np.testing.assert_array_equal(row_out, row_ref)
+    assert len(set(stops)) >= 2, "rows did not finish at different steps"
+
+
 def test_llama_prefill_matches_full_forward():
     cfg = LlamaConfig(vocab_size=64, max_seq_len=64, dim=32, n_layers=2,
                       n_heads=4, n_kv_heads=2, dropout=0.0)

@@ -1,11 +1,15 @@
 """MFU accounting unit tests (SURVEY.md hard part #5; VERDICT r1 item 8:
-the device table must warn, not silently assume v5e)."""
+the device table must warn, not silently assume v5e) + the shared
+percentile/ring-buffer aggregation serve latency metrics ride on."""
 
 import importlib
 import warnings
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
+
+from solvingpapers_tpu.metrics import Ring, percentiles
 
 # sub-minute correctness core: `pytest -m fast` is the ~4-minute gate
 pytestmark = pytest.mark.fast
@@ -60,6 +64,40 @@ def test_active_param_count_discounts_routed_experts():
     assert active == total - (3 * 8 * 24 - 3 * 8 * 24 * 2 // 8)
     # without MoE info: plain total
     assert mfu_mod.active_param_count(params) == total
+
+
+def test_percentiles_keys_and_values():
+    vals = list(range(1, 101))  # 1..100
+    out = percentiles(vals)
+    assert set(out) == {"p50", "p95", "p99"}
+    assert out["p50"] == pytest.approx(50.5)
+    assert out["p95"] == pytest.approx(np.percentile(vals, 95))
+    # non-integer quantile keeps its fractional label
+    assert set(percentiles(vals, qs=(99.9,))) == {"p99.9"}
+    assert percentiles([]) == {}
+
+
+def test_ring_bounds_memory_and_tracks_recent():
+    ring = Ring(capacity=4)
+    for v in [1.0, 2.0, 3.0]:
+        ring.add(v)
+    assert len(ring) == 3
+    assert ring.mean() == pytest.approx(2.0)
+    for v in [4.0, 5.0, 6.0]:  # wraps: live window is now {3,4,5,6}
+        ring.add(v)
+    assert len(ring) == 4
+    assert ring.total_added == 6
+    assert sorted(ring.values().tolist()) == [3.0, 4.0, 5.0, 6.0]
+    assert ring.percentiles(qs=(50,))["p50"] == pytest.approx(4.5)
+
+
+def test_ring_empty_and_invalid_capacity():
+    ring = Ring(capacity=8)
+    assert len(ring) == 0
+    assert ring.percentiles() == {}
+    assert np.isnan(ring.mean())
+    with pytest.raises(ValueError, match="capacity"):
+        Ring(capacity=0)
 
 
 def test_parity_regression_check():

@@ -1,0 +1,35 @@
+"""Serving-throughput benchmark -> BENCH_serve.json.
+
+Thin wrapper over `python -m solvingpapers_tpu.cli serve-bench` (one
+parser, one call site — the two entry points cannot drift) that defaults
+--config to llama3_shakespeare and --out to BENCH_serve.json, keeping the
+artifact in the same {metric, value, unit, vs_baseline, detail} shape as
+the BENCH_r0*.json scorecards so the serving trajectory stays comparable
+across rounds.
+
+Usage: python tools/bench_serve.py [--config llama3_shakespeare]
+       [--requests 32] [--slots 8] [--out BENCH_serve.json]
+       (any `cli serve-bench` flag passes through)
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from solvingpapers_tpu.cli import main as cli_main
+
+    argv = list(sys.argv[1:])
+    if not any(a == "--config" or a.startswith("--config=") for a in argv):
+        argv += ["--config", "llama3_shakespeare"]
+    if not any(a == "--out" or a.startswith("--out=") for a in argv):
+        argv += ["--out", "BENCH_serve.json"]
+    return cli_main(["serve-bench", *argv])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
