@@ -13,7 +13,7 @@ Layout (see SURVEY.md §7):
     data/       tokenizers + dataset/batch pipelines
     train/      the single training engine
     infer/      jitted prefill/decode with KV caches
-    serve/      continuous-batching engine: slot pool, FIFO scheduler, mixed step
+    serve/      continuous-batching engine: slot pool, FIFO scheduler, mixed step, radix prefix cache
     checkpoint/ Orbax checkpoint manager + params-only export
     metrics/    console/JSONL metrics writers, MFU accounting
     configs/    typed run configs for every workload
@@ -22,7 +22,7 @@ Layout (see SURVEY.md §7):
 __version__ = "0.1.0"
 
 _SERVE_API = ("ServeEngine", "ServeConfig", "KVSlotPool", "FIFOScheduler",
-              "Request", "ServeMetrics")
+              "Request", "ServeMetrics", "PrefixCache", "PrefixMatch")
 
 
 def __getattr__(name):

@@ -384,8 +384,9 @@ def cmd_sample(args) -> int:
 
 def cmd_serve_bench(args) -> int:
     """Continuous-batching engine vs sequential one-shot generate on a
-    synthetic Poisson arrival stream (serve/bench.py); prints the BENCH-
-    shaped JSON and optionally writes it to --out."""
+    synthetic Poisson arrival stream — or, with --shared-prefix, prefix
+    cache on vs off over K shared system prompts (serve/bench.py); prints
+    the BENCH-shaped JSON and optionally writes it to --out."""
     if args.checkpoint_dir or args.data_path:
         print(
             "serve-bench benchmarks scheduling throughput on random-init "
@@ -393,25 +394,50 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 2
-    from solvingpapers_tpu.serve.bench import run_serve_bench
+    from solvingpapers_tpu.serve.bench import run_prefix_bench, run_serve_bench
 
-    result = run_serve_bench(
-        config=args.config,
-        n_requests=args.requests,
-        n_slots=args.slots,
-        max_new=args.max_new_tokens,
-        decode_block=args.decode_block,
-        prompt_lens=tuple(args.prompt_lens),
-        mean_interarrival_s=args.mean_interarrival,
-        seed=args.seed,
-        skip_sequential=args.skip_sequential,
-    )
+    max_new = args.max_new_tokens
+    if max_new is None:
+        max_new = 4 if args.shared_prefix else 64
+    decode_block = args.decode_block
+    if decode_block is None:
+        decode_block = 4 if args.shared_prefix else 16
+    n_requests = args.requests
+    if n_requests is None:
+        n_requests = 48 if args.shared_prefix else 32
+    if args.shared_prefix:
+        result = run_prefix_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            n_prefixes=args.n_prefixes,
+            prefix_len=args.prefix_len,
+            suffix_len=args.suffix_len,
+            mean_interarrival_s=args.mean_interarrival,
+            prefix_page=args.prefix_page,
+            seed=args.seed,
+        )
+    else:
+        result = run_serve_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(args.prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            seed=args.seed,
+            skip_sequential=args.skip_sequential,
+        )
     line = json.dumps(result)
     print(line)
     if args.out:
-        with open(args.out, "w") as f:
+        with open(args.out, "a" if args.append else "w") as f:
             f.write(line + "\n")
-        print(f"[serve-bench] wrote {args.out}", file=sys.stderr)
+        verb = "appended to" if args.append else "wrote"
+        print(f"[serve-bench] {verb} {args.out}", file=sys.stderr)
     return 0
 
 
@@ -556,10 +582,14 @@ def main(argv=None) -> int:
 
     p_serve = sub.add_parser("serve-bench")
     _add_common(p_serve)
-    p_serve.add_argument("--requests", type=int, default=32)
+    p_serve.add_argument("--requests", type=int, default=None,
+                         help="default 32 (48 with --shared-prefix)")
     p_serve.add_argument("--slots", type=int, default=8)
-    p_serve.add_argument("--max-new-tokens", type=int, default=64)
-    p_serve.add_argument("--decode-block", type=int, default=16)
+    p_serve.add_argument("--max-new-tokens", type=int, default=None,
+                         help="default 64 (4 with --shared-prefix, whose "
+                              "TTFT story is prefill-bound)")
+    p_serve.add_argument("--decode-block", type=int, default=None,
+                         help="default 16 (4 with --shared-prefix)")
     p_serve.add_argument("--prompt-lens", type=int, nargs="+",
                          default=[16, 32, 48, 64],
                          help="prompt-length cycle (bounded set => bounded "
@@ -569,9 +599,28 @@ def main(argv=None) -> int:
     p_serve.add_argument("--seed", type=int, default=0)
     p_serve.add_argument("--skip-sequential", action="store_true",
                          help="only run the engine arm")
+    p_serve.add_argument("--shared-prefix", action="store_true",
+                         help="shared-prefix workload instead: N requests "
+                              "over K distinct system prompts, prefix "
+                              "cache on vs off (serve/bench.py "
+                              "run_prefix_bench)")
+    p_serve.add_argument("--n-prefixes", type=int, default=4,
+                         help="[--shared-prefix] distinct system prompts K")
+    p_serve.add_argument("--prefix-len", type=int, default=None,
+                         help="[--shared-prefix] shared stem length "
+                              "(default: stretch to the model's position "
+                              "budget, page-aligned)")
+    p_serve.add_argument("--suffix-len", type=int, default=8,
+                         help="[--shared-prefix] unique tail length")
+    p_serve.add_argument("--prefix-page", type=int, default=16,
+                         help="[--shared-prefix] radix-tree page size")
     p_serve.add_argument("--out", default=None,
                          help="also write the JSON result here "
                               "(tools/bench_serve.py default: BENCH_serve.json)")
+    p_serve.add_argument("--append", action="store_true",
+                         help="append to --out instead of overwriting "
+                              "(BENCH_serve.json is JSON-lines: one entry "
+                              "per workload)")
 
     p_eval = sub.add_parser("eval")
     _add_common(p_eval)

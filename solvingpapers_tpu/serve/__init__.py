@@ -1,7 +1,8 @@
 """Continuous-batching serving: slot pool + FIFO scheduler + mixed
-prefill/decode engine + latency metrics."""
+prefill/decode engine + radix-tree prefix cache + latency metrics."""
 
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
 from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
 from solvingpapers_tpu.serve.metrics import ServeMetrics
+from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request

@@ -14,6 +14,11 @@ to model token ids. Two arms replay the SAME arrival offsets:
 Both arms are warmed first (every compiled shape traced before timing)
 so the comparison is steady-state serving throughput, not tracing time.
 Requests/s = n_requests / (last finish - first arrival).
+
+`run_prefix_bench` is the second workload: N requests over K distinct
+shared system prompts (`shared_prefix_requests`), engine vs engine with
+the radix prefix cache on vs off — the TTFT win of splicing a cached
+prefix instead of re-prefilling it (`cli serve-bench --shared-prefix`).
 """
 
 from __future__ import annotations
@@ -91,6 +96,44 @@ def synthetic_requests(
         start = int(rng.integers(0, ids.size - length))
         out.append((float(arrivals[i]), ids[start:start + length]))
     return out
+
+
+def shared_prefix_requests(
+    n: int,
+    vocab_size: int,
+    n_prefixes: int = 4,
+    prefix_len: int = 64,
+    suffix_len: int = 8,
+    mean_interarrival_s: float = 0.002,
+    seed: int = 0,
+):
+    """[(arrival_offset_s, prompt ids)] — N requests over `n_prefixes`
+    distinct system prompts: each prompt is one of K shared `prefix_len`
+    stems plus a unique `suffix_len` tail. The workload real serving
+    traffic looks like (system prompts / few-shot templates), and the one
+    the radix prefix cache exists for: after each stem's first request,
+    only the tail needs prefill."""
+    rng = np.random.default_rng(seed)
+    stems = [
+        rng.integers(0, vocab_size, size=prefix_len).astype(np.int32)
+        for _ in range(n_prefixes)
+    ]
+    arrivals = np.cumsum(rng.exponential(mean_interarrival_s, size=n))
+    out = []
+    for i in range(n):
+        tail = rng.integers(0, vocab_size, size=suffix_len).astype(np.int32)
+        out.append(
+            (float(arrivals[i]),
+             np.concatenate([stems[i % n_prefixes], tail]))
+        )
+    return out
+
+
+def _round_if_present(snap: dict, key: str, out_key: str, digits: int) -> dict:
+    """{out_key: rounded value} when the metric was observed, else {}."""
+    if key in snap:
+        return {out_key: round(snap[key], digits)}
+    return {}
 
 
 def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new):
@@ -199,10 +242,20 @@ def run_serve_bench(
         "mean_interarrival_s": mean_interarrival_s,
         "engine_requests_per_sec": round(rps, 2),
         "engine_tokens_per_sec": round(snap.get("serve/tokens_per_sec", 0.0), 1),
-        "mean_ttft_s": round(snap.get("serve/ttft_s_mean", float("nan")), 4),
-        "ttft_p95_s": round(snap.get("serve/ttft_s_p95", float("nan")), 4),
-        "itl_p95_s": round(snap.get("serve/itl_s_p95", float("nan")), 5),
+        # absent beats NaN (json.dumps would emit a non-RFC-8259 'NaN'
+        # token): e.g. max_new=1 finishes every request at prefill and the
+        # ITL ring never gets an observation
+        **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
+        **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
+        **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
         "slot_occupancy": round(snap.get("serve/slot_occupancy", 0.0), 3),
+        # present only when the engine's prefix cache actually ran lookups
+        # (snapshot omits serve/prefix_* otherwise) — an unconditional 0.0
+        # would be indistinguishable from "cache on, nothing shared"
+        **_round_if_present(snap, "serve/prefix_hit_rate", "prefix_hit_rate", 3),
+        **({"tokens_prefilled_saved":
+            int(snap["serve/tokens_prefilled_saved"])}
+           if "serve/tokens_prefilled_saved" in snap else {}),
     }
     result = {
         "metric": "serve_requests_per_sec",
@@ -219,3 +272,113 @@ def run_serve_bench(
         detail["sequential_mean_ttft_s"] = round(seq_ttft, 4)
         result["vs_baseline"] = round(rps / seq_rps, 2)
     return result
+
+
+def run_prefix_bench(
+    config: str = "gpt_shakespeare",
+    n_requests: int = 48,
+    n_slots: int = 8,
+    max_new: int = 4,
+    decode_block: int = 4,
+    n_prefixes: int = 4,
+    prefix_len: int | None = None,
+    suffix_len: int = 8,
+    mean_interarrival_s: float = 0.002,
+    prefix_page: int = 16,
+    prefix_cache_bytes: int = 64 << 20,
+    seed: int = 0,
+) -> dict:
+    """Shared-prefix workload, prefix cache ON vs OFF — same engine, same
+    arrival trace; returns the BENCH-shaped dict with the TTFT speedup as
+    the headline (`vs_baseline` = cache-off mean TTFT / cache-on).
+
+    `prefix_len=None` stretches the shared stem to the model's position
+    budget (page-aligned), the regime the cache exists for — a long system
+    prompt ahead of a short per-request tail."""
+    model, params, extra, vocab = build_serve_model(config)
+    limit = getattr(model, "max_positions", None)
+    if prefix_len is None:
+        room = (limit or 256) - suffix_len - max_new
+        prefix_len = max(prefix_page, room // prefix_page * prefix_page)
+    requests = shared_prefix_requests(
+        n_requests, vocab, n_prefixes=n_prefixes, prefix_len=prefix_len,
+        suffix_len=suffix_len, mean_interarrival_s=mean_interarrival_s,
+        seed=seed,
+    )
+    max_prompt = prefix_len + suffix_len
+    if limit is not None and max_prompt + max_new > limit:
+        raise ValueError(
+            f"prefix_len + suffix_len + max_new = {max_prompt + max_new} "
+            f"exceeds the model's max positions {limit}"
+        )
+
+    def cfg(cache_on: bool) -> ServeConfig:
+        return ServeConfig(
+            n_slots=n_slots,
+            max_len=max_prompt + max_new,
+            decode_block=decode_block,
+            # tight bucket: a hit prefills ~suffix_len tokens, not a
+            # 32-padded program — the whole point of the workload
+            bucket=max(8, -(-suffix_len // 8) * 8),
+            max_prefills_per_step=n_slots,
+            max_waiting=max(256, n_requests),
+            seed=seed,
+            prefix_cache=cache_on,
+            prefix_page=prefix_page,
+            prefix_cache_bytes=prefix_cache_bytes,
+        )
+
+    arms = {}
+    raw_ttft = {}
+    for cache_on in (True, False):
+        # warm: a 2-requests-per-stem mini-trace compiles every shape both
+        # arms hit (miss-path full prefill AND hit-path suffix prefill —
+        # the jit cache is process-global, the prefix tree is per-engine
+        # so the TIMED engine still starts cold)
+        warm = shared_prefix_requests(
+            2 * n_prefixes, vocab, n_prefixes=n_prefixes,
+            prefix_len=prefix_len, suffix_len=suffix_len,
+            mean_interarrival_s=0.0, seed=seed + 1,
+        )
+        _run_engine_arm(model, params, extra, warm, cfg(cache_on), max_new)
+        eng, _, makespan = _run_engine_arm(
+            model, params, extra, requests, cfg(cache_on), max_new
+        )
+        snap = eng.metrics.snapshot()
+        arm = "cache_on" if cache_on else "cache_off"
+        raw_ttft[arm] = snap["serve/ttft_s_mean"]  # unrounded, for the ratio
+        arms[arm] = {
+            "requests_per_sec": round(n_requests / makespan, 2),
+            "mean_ttft_s": round(raw_ttft[arm], 4),
+            **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
+            "prefix_hit_rate": round(snap.get("serve/prefix_hit_rate", 0.0), 3),
+            "prefix_evictions": int(snap.get("serve/prefix_evictions", 0.0)),
+            "tokens_prefilled_saved": int(
+                snap.get("serve/tokens_prefilled_saved", 0.0)
+            ),
+            "prefix_hbm_bytes": int(snap.get("serve/prefix_hbm_bytes", 0.0)),
+        }
+    # ratio of the UNROUNDED means: 4-decimal-rounded values would distort
+    # (or zero-divide) on hardware where TTFT is tens of microseconds
+    speedup = raw_ttft["cache_off"] / raw_ttft["cache_on"]
+    return {
+        "metric": "serve_prefix_cache_ttft_speedup",
+        "value": round(speedup, 2),
+        "unit": "x (mean TTFT, cache off / on)",
+        "vs_baseline": round(speedup, 2),
+        "detail": {
+            "config": config,
+            "workload": "shared-prefix",
+            "n_requests": n_requests,
+            "n_prefixes": n_prefixes,
+            "prefix_len": prefix_len,
+            "suffix_len": suffix_len,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "mean_interarrival_s": mean_interarrival_s,
+            "prefix_page": prefix_page,
+            **{f"{arm}_{k}": v for arm, d in arms.items()
+               for k, v in d.items()},
+        },
+    }

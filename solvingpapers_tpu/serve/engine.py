@@ -30,9 +30,20 @@ right to a multiple of ``bucket``; the pad region is causally invisible
 to real tokens and its cache slots are overwritten by the decode stream
 before ever being attended).
 
+Cross-request prefix reuse (`serve/prefix_cache.py`, opt-in via
+`ServeConfig.prefix_cache` — see its docstring for the cost model):
+admission first splices the longest cached page-aligned prompt prefix
+into the freed lane
+(copy-on-acquire — one fused dynamic_update_slice program per segment)
+and prefills only the uncovered suffix from position `matched`, then
+snapshots the prompt's prefix back into the radix tree. Cached KV at
+position p depends only on tokens <= p, so greedy streams are token-exact
+with the cache on or off.
+
 Greedy streams are token-exact vs per-request one-shot `generate`
-(tests/test_serve.py); stochastic samplers draw from a different rng
-chain than `generate` and match only in distribution.
+(tests/test_serve.py, tests/test_prefix_cache.py); stochastic samplers
+draw from a different rng chain than `generate` and match only in
+distribution.
 """
 
 from __future__ import annotations
@@ -48,6 +59,7 @@ from solvingpapers_tpu import ops
 from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
 from solvingpapers_tpu.serve.metrics import ServeMetrics
+from solvingpapers_tpu.serve.prefix_cache import PrefixCache
 from solvingpapers_tpu.serve.scheduler import (
     ACTIVE,
     FINISHED,
@@ -67,6 +79,22 @@ class ServeConfig:
     pad-with-EOS semantics). `bucket` quantizes prefill lengths so the
     number of compiled prefill programs stays bounded — use a multiple of
     128 for `use_flash` models (the Pallas q-block constraint).
+
+    Prefix cache (`serve/prefix_cache.py`): with `prefix_cache` on, each
+    admitted request splices its longest cached page-aligned prompt
+    prefix into the lane and prefills only the uncovered suffix (start
+    position = matched length; the suffix pads to `bucket` as before, so
+    compiled prefill programs stay bounded by (page multiples x
+    buckets)). `prefix_cache_bytes` caps the HBM the radix tree may hold
+    (LRU leaf eviction; refcounted nodes are never evicted);
+    `prefix_page` is the match/segment granularity. `prefix_sched` makes
+    the scheduler prefer waiting requests with the shortest uncovered
+    suffix (the existing anti-starvation wait budget still overrides).
+    Greedy streams are token-exact with the cache on or off. Opt-in:
+    every admission pays a match + snapshot copy and the tree holds up
+    to `prefix_cache_bytes` of HBM, which is pure overhead on traffic
+    with no shared prefixes (~10% req/s on the Poisson bench) — turn it
+    on when prompts share stems (system prompts, few-shot, multi-turn).
     """
 
     n_slots: int = 8
@@ -80,6 +108,10 @@ class ServeConfig:
     max_wait_steps: int = 64
     eos_id: int | None = None  # default per-request EOS (None = run to budget)
     seed: int = 0
+    prefix_cache: bool = False
+    prefix_page: int = 16
+    prefix_cache_bytes: int = 64 << 20
+    prefix_sched: bool = False
 
 
 _UNSET = object()
@@ -87,10 +119,11 @@ _UNSET = object()
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "sampler", "padded", "chunk"),
+    static_argnames=("model", "sampler", "padded", "chunk", "start"),
     donate_argnames=("caches",),
 )
-def _prefill_program(model, sampler, padded, chunk, variables, caches, prompt, ctl, rng):
+def _prefill_program(model, sampler, padded, chunk, start, variables, caches,
+                     prompt, ctl, rng):
     """Prefill one request into lane `ctl[0]` and sample its first token.
 
     `prompt` is (padded,) right-padded; `ctl = [slot, length, step]` is
@@ -103,6 +136,14 @@ def _prefill_program(model, sampler, padded, chunk, variables, caches, prompt, c
     loop; the logits row for the LAST REAL token is gathered from
     whichever chunk contains it (padding makes that not-necessarily-the-
     last chunk).
+
+    `start` (static) is the prefix-cache match length: `prompt` is the
+    UNCOVERED SUFFIX, cache slots [0, start) already hold the spliced
+    prefix KV, and positions/attend_len shift by `start` — the same
+    end-aligned contract, so chunk i attends causally over every written
+    slot [0, start + end_i). `start=0` is a full prefill. Static because
+    `attend_len` drives a static slice; start values are page multiples,
+    keeping the compiled inventory bounded.
     """
     slot, length = ctl[0], ctl[1]
     rng = jax.random.fold_in(rng, ctl[2])
@@ -110,18 +151,20 @@ def _prefill_program(model, sampler, padded, chunk, variables, caches, prompt, c
     toks = prompt[None, :]
     step = chunk or padded
     last = None
-    for start in range(0, padded, step):
-        end = min(start + step, padded)
-        tok_chunk = jax.lax.slice_in_dim(toks, start, end, axis=1)
-        positions = jnp.broadcast_to(jnp.arange(start, end), (1, end - start))
+    for cs in range(0, padded, step):
+        ce = min(cs + step, padded)
+        tok_chunk = jax.lax.slice_in_dim(toks, cs, ce, axis=1)
+        positions = jnp.broadcast_to(
+            jnp.arange(start + cs, start + ce), (1, ce - cs)
+        )
         logits, lane = model.apply(
             variables, tok_chunk, positions=positions, caches=lane,
-            deterministic=True, attend_len=end,
+            deterministic=True, attend_len=start + ce,
         )
-        idx = jnp.clip(length - 1 - start, 0, end - start - 1)
+        idx = jnp.clip(length - 1 - cs, 0, ce - cs - 1)
         row = jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0,
                                            keepdims=False)
-        sel = (length - 1 >= start) & (length - 1 < end)
+        sel = (length - 1 >= cs) & (length - 1 < ce)
         last = row if last is None else jnp.where(sel, row, last)
     first = sampler(last[None], rng)[0].astype(jnp.int32)
     return store_lane(caches, lane, slot), first
@@ -213,12 +256,24 @@ class ServeEngine:
         self.config = cfg
         self.sampler = sampler
         self.variables = {"params": params, **(extra_variables or {})}
+        if cfg.prefix_sched and not cfg.prefix_cache:
+            raise ValueError(
+                "prefix_sched orders admission by cached-prefix match "
+                "length, which needs prefix_cache=True — without the radix "
+                "tree the knob would silently degrade to plain FIFO"
+            )
         self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
+        self.prefix_cache = (
+            PrefixCache(page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes)
+            if cfg.prefix_cache else None
+        )
         self.scheduler = FIFOScheduler(
             max_waiting=cfg.max_waiting,
             decode_priority=cfg.decode_priority,
             max_prefills_per_step=cfg.max_prefills_per_step,
             max_wait_steps=cfg.max_wait_steps,
+            prefer_cached=cfg.prefix_sched,
+            prefix_lookup=self._match_len if self.prefix_cache else None,
         )
         self.metrics = ServeMetrics(window=metrics_window)
         self._slot_req: list[Request | None] = [None] * cfg.n_slots
@@ -294,15 +349,30 @@ class ServeEngine:
 
     # ------------------------------------------------------------ private
 
-    def _bucketed(self, length: int) -> int:
+    def _bucketed(self, length: int, start: int = 0) -> int:
         b = self.config.bucket
         padded = -(-length // b) * b
         limit = getattr(self.model, "max_positions", None)
-        return max(length, min(padded, self.config.max_len,
-                               limit or padded))
+        cap = min(self.config.max_len, limit or self.config.max_len) - start
+        return max(length, min(padded, cap))
+
+    def _match_len(self, prompt: np.ndarray) -> int:
+        """Cached page-aligned prefix length for `prompt` (read-only; the
+        scheduler's admission lookup). Capped at len-1: the suffix prefill
+        must produce at least one logits row to sample from."""
+        if self.prefix_cache is None or prompt.size < 2:
+            return 0
+        return self.prefix_cache.peek(prompt[: prompt.size - 1])
 
     def _admit(self, req: Request) -> bool:
-        """Prefill `req` into a free lane; True if it finished already."""
+        """Prefill `req` into a free lane; True if it finished already.
+
+        With the prefix cache on: splice the longest cached page-aligned
+        prompt prefix into the lane (copy-on-acquire), prefill only the
+        uncovered suffix from position `matched`, then snapshot the
+        prompt's page-aligned prefix back into the tree so later requests
+        reuse it.
+        """
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
         now = smetrics.now()
@@ -312,26 +382,62 @@ class ServeEngine:
         self.metrics.record_admit(req, now)
 
         length = int(req.prompt.size)
-        padded = self._bucketed(length)
+        matched = 0
+        if self.prefix_cache is not None and length > 1:
+            match = self.prefix_cache.match(req.prompt[: length - 1])
+            matched = match.length
+            self.metrics.record_prefix_lookup(matched)
+            if matched:
+                # pin across the splice. In today's single-threaded engine
+                # nothing can evict between match and splice (eviction only
+                # runs inside insert, below) — the pin is the invariant a
+                # future async/threaded admission path must keep, kept live
+                # here so the refcount machinery stays exercised.
+                self.prefix_cache.pin(match)
+                offset = 0
+                for node in match.nodes:
+                    self.pool.splice_prefix(slot, node.segment, offset)
+                    offset += node.length
+                self.prefix_cache.unpin(match)
+
+        suffix = length - matched
+        padded = self._bucketed(suffix, start=matched)
         chunk = self.config.prefill_chunk
         if chunk is None and padded > 4096:
             chunk = 2048  # same auto-chunk threshold as infer.decode.generate
         if chunk is not None and chunk >= padded:
             chunk = None
         prompt_padded = np.zeros(padded, np.int32)
-        prompt_padded[:length] = req.prompt
-        ctl = np.asarray([slot, length, self._rng_step], np.int32)
+        prompt_padded[:suffix] = req.prompt[matched:]
+        ctl = np.asarray([slot, suffix, self._rng_step], np.int32)
         self._rng_step += 1
         self.pool.caches, first = _prefill_program(
-            self.model, self.sampler, padded, chunk, self.variables,
+            self.model, self.sampler, padded, chunk, matched, self.variables,
             self.pool.caches, jnp.asarray(prompt_padded), jnp.asarray(ctl),
             self._rng,
         )
         first = int(first)
+        if self.prefix_cache is not None:
+            # snapshot while the lane's [0, length) span is pristine (an
+            # active lane's decode writes land at positions >= length, and
+            # dummy writes only hit FREED lanes' slot 0)
+            page = self.prefix_cache.page
+            aligned = (length - 1) // page * page
+            # aligned == matched on a full hit: nothing new to cache, and
+            # insert's internal re-match would re-walk the whole prefix on
+            # the dispatch-bound host hot path for nothing
+            if aligned > matched:
+                self.prefix_cache.insert(
+                    req.prompt[:aligned],
+                    lambda off, n: self.pool.extract_prefix(slot, off, n),
+                )
+            self.metrics.record_prefix_state(
+                self.prefix_cache.bytes_held, self.prefix_cache.evictions
+            )
         now = smetrics.now()
         req.first_token_time = now
         req.tokens.append(first)
-        self.metrics.record_first_token(req, now)
+        self.metrics.record_first_token(req, now, prefilled=suffix)
         self._last_emit[slot] = now
         self.pool.positions[slot] = length
         self._toks[slot] = first

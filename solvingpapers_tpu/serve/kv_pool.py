@@ -20,12 +20,35 @@ over it, and (b) decode masks with ``kv_index <= position`` (the cache
 masking contract of `infer/cache.py`), so slots beyond the current length
 never contribute — and every stale value is finite (written by a real
 forward), so masked-softmax zeros annihilate it exactly.
+
+Prefix reuse (`serve/prefix_cache.py`): `splice_prefix` copies a cached
+batch-1 KV segment into a lane's leading slots before the suffix prefill
+(copy-on-acquire — the lane owns its copy, so tree eviction can never
+corrupt an in-flight stream), and `extract_prefix` snapshots a freshly
+prefilled prompt span back out for the radix tree to keep.
 """
 
 from __future__ import annotations
 
+import functools
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+
+def _require_same_dtype(pool_leaf, seg_leaf, op: str) -> None:
+    """Lane/segment writes never cast: a silent `astype` would down-cast
+    an fp32 segment into a bf16 pool (or vice versa) and quietly change
+    every stream decoded over it. Trace-time error instead — the caller
+    casts explicitly if a conversion is really intended."""
+    if seg_leaf.dtype != pool_leaf.dtype:
+        raise TypeError(
+            f"{op}: segment dtype {seg_leaf.dtype} != pool dtype "
+            f"{pool_leaf.dtype}; implicit casts are not performed (a "
+            "silent astype would corrupt precision) — cast explicitly "
+            "before the write"
+        )
 
 
 def extract_lane(caches, slot):
@@ -36,14 +59,47 @@ def extract_lane(caches, slot):
 
 
 def store_lane(caches, lane, slot):
-    """Write a batch-1 lane back into the pooled caches at `slot` (traced)."""
-    return jax.tree_util.tree_map(
-        lambda a, l: jax.lax.dynamic_update_slice_in_dim(
-            a, l.astype(a.dtype), slot, axis=0
-        ),
-        caches,
-        lane,
-    )
+    """Write a batch-1 lane back into the pooled caches at `slot` (traced).
+    Dtypes must match exactly — see `_require_same_dtype`."""
+
+    def upd(a, l):
+        _require_same_dtype(a, l, "store_lane")
+        return jax.lax.dynamic_update_slice_in_dim(a, l, slot, axis=0)
+
+    return jax.tree_util.tree_map(upd, caches, lane)
+
+
+@functools.partial(jax.jit, donate_argnames=("caches",))
+def _splice_program(caches, segment, ctl):
+    """Copy-on-acquire: write a batch-1 prefix `segment` (time length L,
+    static per compiled program) into lane `ctl[0]` at time offset
+    `ctl[1]`. One fused program — every layer's `dynamic_update_slice`
+    lands in a single dispatch, and donation reuses the pool's buffers.
+    Program inventory is bounded because segment lengths are multiples of
+    the prefix cache's page size."""
+    slot, offset = ctl[0], ctl[1]
+
+    def upd(a, s):
+        _require_same_dtype(a, s, "splice_prefix")
+        starts = (slot, offset) + (0,) * (a.ndim - 2)
+        return jax.lax.dynamic_update_slice(a, s, starts)
+
+    return jax.tree_util.tree_map(upd, caches, segment)
+
+
+@functools.partial(jax.jit, static_argnames=("length",))
+def _extract_program(caches, ctl, length):
+    """Snapshot lane `ctl[0]`'s time span [ctl[1], ctl[1]+length) as a
+    batch-1 segment pytree (a COPY — the lane can be overwritten or
+    released without invalidating it)."""
+    slot, offset = ctl[0], ctl[1]
+
+    def ext(a):
+        starts = (slot, offset) + (0,) * (a.ndim - 2)
+        sizes = (1, length) + a.shape[2:]
+        return jax.lax.dynamic_slice(a, starts, sizes)
+
+    return jax.tree_util.tree_map(ext, caches)
 
 
 class KVSlotPool:
@@ -100,3 +156,35 @@ class KVSlotPool:
             raise ValueError(f"slot {slot} is already free (double release)")
         self.positions[slot] = 0
         self._free.append(slot)
+
+    # --------------------------------------------------- prefix segments
+
+    def splice_prefix(self, slot: int, segment, offset: int = 0) -> None:
+        """Copy-on-acquire: splice a cached batch-1 prefix `segment` into
+        lane `slot` at time offset `offset` (one fused jitted program; the
+        lane owns the copy, so the source node may be evicted freely
+        afterwards). Must run before the suffix prefill that continues at
+        `offset + segment length`."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        length = jax.tree_util.tree_leaves(segment)[0].shape[1]
+        if offset < 0 or offset + length > self.max_len:
+            raise ValueError(
+                f"segment span [{offset}, {offset + length}) exceeds the "
+                f"lane capacity {self.max_len}"
+            )
+        ctl = jnp.asarray([slot, offset], jnp.int32)
+        self.caches = _splice_program(self.caches, segment, ctl)
+
+    def extract_prefix(self, slot: int, offset: int, length: int):
+        """Snapshot lane `slot`'s KV span [offset, offset+length) as an
+        independent batch-1 segment (the prefix cache's insert path)."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        if offset < 0 or length < 1 or offset + length > self.max_len:
+            raise ValueError(
+                f"extract span [{offset}, {offset + length}) exceeds the "
+                f"lane capacity {self.max_len}"
+            )
+        ctl = jnp.asarray([slot, offset], jnp.int32)
+        return _extract_program(self.caches, ctl, length)

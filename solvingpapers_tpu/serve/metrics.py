@@ -11,6 +11,20 @@ console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
     serve/tokens_per_sec     generated tokens / elapsed wall time
     serve/requests_per_sec   finished requests / elapsed wall time
     serve/slot_occupancy     mean fraction of slots decoding, per iteration
+    serve/tokens_prefilled   prompt tokens the engine actually prefilled
+                             (excludes prefix-cache-spliced tokens)
+
+Prefix-cache counters (serve/prefix_cache.py; present when the engine's
+prefix cache is on):
+
+    serve/prefix_lookups           admission-time radix-tree matches
+    serve/prefix_hits              lookups that matched >= 1 page
+    serve/prefix_hit_rate          hits / lookups
+    serve/prefix_cached_tokens     prompt tokens served by splicing
+    serve/tokens_prefilled_saved   alias of the above: prefill compute
+                                   avoided, the bench's headline saving
+    serve/prefix_evictions         LRU leaf evictions so far
+    serve/prefix_hbm_bytes         device bytes the radix tree holds now
 """
 
 from __future__ import annotations
@@ -33,6 +47,11 @@ class ServeMetrics:
         self.requests_finished = 0
         self.requests_rejected = 0
         self.steps = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_cached_tokens = 0
+        self.prefix_evictions = 0
+        self.prefix_bytes_held = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
 
@@ -54,11 +73,17 @@ class ServeMetrics:
         self._touch(now)
         self.queue_wait.add(now - req.submit_time)
 
-    def record_first_token(self, req, now: float) -> None:
+    def record_first_token(self, req, now: float,
+                           prefilled: int | None = None) -> None:
+        """`prefilled` = prompt tokens the engine actually ran prefill
+        over (the uncovered suffix when the prefix cache spliced the
+        rest); defaults to the full prompt length."""
         self._touch(now)
         self.ttft.add(now - req.submit_time)
         self.tokens_out += 1
-        self.prefill_tokens += len(req.prompt)
+        self.prefill_tokens += (
+            len(req.prompt) if prefilled is None else prefilled
+        )
 
     def record_tokens(self, req, n: int, span_s: float, now: float) -> None:
         """`n` tokens emitted for `req` over `span_s` seconds (a decode
@@ -78,14 +103,40 @@ class ServeMetrics:
         self.steps += 1
         self.occupancy.add(occupancy)
 
+    def record_prefix_lookup(self, matched_tokens: int) -> None:
+        """One admission-time radix match; `matched_tokens` prompt tokens
+        were served by splicing instead of prefill (0 = miss)."""
+        self.prefix_lookups += 1
+        if matched_tokens > 0:
+            self.prefix_hits += 1
+            self.prefix_cached_tokens += matched_tokens
+
+    def record_prefix_state(self, bytes_held: int, evictions: int) -> None:
+        """Latest radix-tree gauges (HBM held, cumulative evictions)."""
+        self.prefix_bytes_held = bytes_held
+        self.prefix_evictions = evictions
+
     def snapshot(self) -> dict[str, float]:
         """Current aggregate view, flat keys ready for a MetricsWriter."""
         out = {
             "serve/tokens_out": float(self.tokens_out),
+            "serve/tokens_prefilled": float(self.prefill_tokens),
             "serve/requests_finished": float(self.requests_finished),
             "serve/requests_rejected": float(self.requests_rejected),
             "serve/steps": float(self.steps),
         }
+        if self.prefix_lookups:
+            out["serve/prefix_lookups"] = float(self.prefix_lookups)
+            out["serve/prefix_hits"] = float(self.prefix_hits)
+            out["serve/prefix_hit_rate"] = (
+                self.prefix_hits / self.prefix_lookups
+            )
+            out["serve/prefix_cached_tokens"] = float(self.prefix_cached_tokens)
+            out["serve/tokens_prefilled_saved"] = float(
+                self.prefix_cached_tokens
+            )
+            out["serve/prefix_evictions"] = float(self.prefix_evictions)
+            out["serve/prefix_hbm_bytes"] = float(self.prefix_bytes_held)
         elapsed = self.elapsed_s
         if elapsed > 0:
             out["serve/tokens_per_sec"] = self.tokens_out / elapsed

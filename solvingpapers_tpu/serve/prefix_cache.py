@@ -1,0 +1,275 @@
+"""Host-side radix tree over token-id prefixes with device-resident KV
+segments — cross-request prefix reuse for the serving engine.
+
+Real serving traffic shares long prompt prefixes (system prompts, few-shot
+templates, multi-turn chat); re-running prefill from token 0 for every
+request recomputes identical KV. This module keeps a radix tree keyed by
+token ids whose nodes own batch-1 KV/latent segments (the same pytree
+layout `model.init_caches(1, seg_len)` would produce, sliced along the
+time axis) so a new request's matched prefix can be SPLICED into its lane
+(`KVSlotPool.splice_prefix`) instead of prefilled — the RadixAttention
+idea (SGLang), adapted to the repo's lane-granular pool.
+
+Exactness: cached K/V for a token at absolute position p depends only on
+the token ids at positions <= p (causal attention + RoPE/learned tables
+keyed by absolute position), so splicing a segment produced by an earlier
+request with an identical prefix is bitwise the same computation the lane
+would have run itself. The engine never caches a prompt's final token
+(the suffix prefill must produce at least one logits row to sample from).
+
+Page granularity: all edges and match lengths are multiples of `page`.
+This bounds the jitted splice/extract program inventory (segment time
+lengths are page multiples <= max_len) and keeps node splits aligned so a
+split never has to cut a device segment at an arbitrary offset mid-walk.
+
+Memory: segments are COPIES (snapshotted out of a lane after prefill by
+`extract_fn`), accounted against `max_bytes`; LRU leaves are evicted once
+the budget is exceeded. `refcount` pins a matched path while its splice
+is in flight — a pinned node (or any ancestor of one; `_split` preserves
+the invariant) is never evicted, so eviction under pressure cannot
+corrupt an active lane's stream. Lanes own their spliced copy, so once
+the splice returns the pins can drop and later evictions are irrelevant
+to in-flight requests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+
+def segment_bytes(segment) -> int:
+    """Device bytes held by a batch-1 segment pytree."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(segment)
+    )
+
+
+def segment_length(segment) -> int:
+    """Time-axis length of a batch-1 segment pytree (axis 1 by the
+    KVCache/LatentCache layout contract)."""
+    return jax.tree_util.tree_leaves(segment)[0].shape[1]
+
+
+def slice_segment(segment, start: int, end: int):
+    """Time-axis sub-segment [start, end) — static bounds, eager ops."""
+    return jax.tree_util.tree_map(lambda a: a[:, start:end], segment)
+
+
+class _Node:
+    """One radix edge: `tokens` (page-multiple id array) + the device
+    segment holding their KV, rooted at absolute prefix offset
+    = sum of ancestor edge lengths.
+
+    `children` is keyed by the child edge's FIRST PAGE (`tokens[:page]`
+    as bytes), not its first token: matches only ever advance in whole
+    pages, so the next page is the exact lookup unit — and siblings that
+    diverge mid-page (different pages, same first token) can coexist,
+    which single-token keys would force into collision."""
+
+    __slots__ = ("tokens", "segment", "children", "parent", "refcount",
+                 "stamp", "nbytes")
+
+    def __init__(self, tokens: np.ndarray, segment, parent: "_Node | None"):
+        self.tokens = tokens
+        self.segment = segment
+        self.children: dict[bytes, _Node] = {}
+        self.parent = parent
+        self.refcount = 0
+        self.stamp = 0
+        self.nbytes = 0 if segment is None else segment_bytes(segment)
+
+    @property
+    def length(self) -> int:
+        return int(self.tokens.size)
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Result of `PrefixCache.match`: the root->leaf node path covering
+    `length` tokens (edge lengths sum to `length`). Pin protects the path
+    from EVICTION only; `nodes`' identities/segments are only valid until
+    the next match/insert (either can split an edge and re-slice its
+    segment) — splice immediately after matching, as the engine does."""
+
+    nodes: list
+    length: int
+
+
+class PrefixCache:
+    """Radix tree + LRU byte-budget eviction + refcount pinning."""
+
+    def __init__(self, page: int = 16, max_bytes: int = 64 << 20):
+        if page < 1:
+            raise ValueError(f"page must be >= 1, got {page}")
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        self.page = page
+        self.max_bytes = max_bytes
+        self.root = _Node(np.zeros(0, np.int32), None, None)
+        self.evictions = 0
+        self.bytes_held = 0
+        self._clock = 0
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def n_nodes(self) -> int:
+        return sum(1 for _ in self._walk()) - 1  # exclude root
+
+    def _walk(self):
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def _key(self, tokens: np.ndarray, i: int = 0) -> bytes:
+        return tokens[i:i + self.page].tobytes()
+
+    def _common(self, edge: np.ndarray, tokens: np.ndarray, i: int) -> int:
+        n = min(edge.size, tokens.size - i)
+        neq = np.flatnonzero(edge[:n] != tokens[i:i + n])
+        return n if neq.size == 0 else int(neq[0])
+
+    def peek(self, tokens) -> int:
+        """Read-only match length (page-aligned); no LRU touch, no splits.
+        What the prefix-aware scheduler calls per queued request."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        node, i = self.root, 0
+        while tokens.size - i >= self.page:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            common = self._common(child.tokens, tokens, i)
+            if common == child.tokens.size:
+                i += common
+                node = child
+                continue
+            i += common // self.page * self.page
+            break
+        return i
+
+    def match(self, tokens) -> PrefixMatch:
+        """Longest page-aligned cached prefix of `tokens`.
+
+        Touches the matched path's LRU stamps and splits a partially
+        matched edge at the page-aligned common length, so every returned
+        node is usable whole — splice `match.nodes` in order at offsets
+        accumulating each node's `length`.
+        """
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        node, i, path = self.root, 0, []
+        while tokens.size - i >= self.page:
+            child = node.children.get(self._key(tokens, i))
+            if child is None:
+                break
+            common = self._common(child.tokens, tokens, i)
+            if common == child.tokens.size:
+                path.append(child)
+                i += common
+                node = child
+                continue
+            aligned = common // self.page * self.page
+            if aligned > 0:
+                path.append(self._split(child, aligned))
+                i += aligned
+            break
+        stamp = self._tick()
+        for nd in path:
+            nd.stamp = stamp
+        return PrefixMatch(nodes=path, length=i)
+
+    # ---------------------------------------------------------- mutation
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _split(self, node: _Node, k: int) -> _Node:
+        """Split `node`'s edge at page-aligned k: a new upper node takes
+        tokens[:k]; `node` (keeping its children, segment tail, and its
+        own refcount) becomes the lower part. Returns the upper node.
+        The upper needs no refcount of its own: eviction only ever takes
+        CHILDLESS leaves, and the pinned lower is its child — so a pinned
+        path stays eviction-safe across splits without the upper carrying
+        a count that no `unpin` would ever drop."""
+        assert 0 < k < node.tokens.size and k % self.page == 0
+        old_bytes = node.nbytes
+        upper = _Node(
+            node.tokens[:k].copy(), slice_segment(node.segment, 0, k),
+            node.parent,
+        )
+        upper.stamp = node.stamp
+        node.parent.children[self._key(upper.tokens)] = upper
+        node.segment = slice_segment(node.segment, k, node.tokens.size)
+        node.tokens = node.tokens[k:].copy()
+        node.nbytes = segment_bytes(node.segment)
+        node.parent = upper
+        upper.children[self._key(node.tokens)] = node
+        self.bytes_held += upper.nbytes + node.nbytes - old_bytes
+        return upper
+
+    def pin(self, match: PrefixMatch) -> None:
+        """Protect every node on the matched path from eviction until
+        `unpin` — call immediately after `match`, before any other tree
+        mutation can restructure the path."""
+        for node in match.nodes:
+            node.refcount += 1
+
+    def unpin(self, match: PrefixMatch) -> None:
+        for node in match.nodes:
+            node.refcount -= 1
+
+    def insert(self, tokens, extract_fn) -> int:
+        """Cache `tokens` (length must be a page multiple); the portion not
+        already in the tree is snapshotted via ``extract_fn(offset,
+        length) -> segment`` (offset/length in token positions within the
+        prompt — the engine binds this to `KVSlotPool.extract_prefix` for
+        the freshly prefilled lane). Returns the number of NEW tokens
+        cached. May evict LRU leaves to respect `max_bytes`.
+        """
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size % self.page:
+            raise ValueError(
+                f"insert length {tokens.size} is not a multiple of the "
+                f"page size {self.page}"
+            )
+        if tokens.size == 0:
+            return 0
+        m = self.match(tokens)
+        rem = tokens[m.length:]
+        if rem.size == 0:
+            return 0
+        parent = m.nodes[-1] if m.nodes else self.root
+        # page-keyed children make a collision structurally impossible: an
+        # existing child with rem's first page would have matched (and the
+        # match advanced past it). Defensive first-come-wins regardless —
+        # overwriting would orphan a subtree and leak its byte accounting.
+        if self._key(rem) in parent.children:
+            return 0
+        node = _Node(rem.copy(), extract_fn(m.length, int(rem.size)), parent)
+        node.stamp = self._tick()
+        parent.children[self._key(rem)] = node
+        self.bytes_held += node.nbytes
+        self._evict_to_budget()
+        return int(rem.size)
+
+    def _evict_to_budget(self) -> None:
+        """Drop LRU unpinned leaves until under budget. Interior nodes
+        become evictable once their children go; pinned nodes never do."""
+        while self.bytes_held > self.max_bytes:
+            victim = None
+            for node in self._walk():
+                if node is self.root or node.children or node.refcount > 0:
+                    continue
+                if victim is None or node.stamp < victim.stamp:
+                    victim = node
+            if victim is None:
+                return  # everything left is pinned or interior
+            del victim.parent.children[self._key(victim.tokens)]
+            self.bytes_held -= victim.nbytes
+            self.evictions += 1

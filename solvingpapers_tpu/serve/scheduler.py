@@ -16,6 +16,13 @@ requests get prefilled into free slots. Policy knobs:
   engine iterations overrides decode priority: the scheduler admits up to
   all free slots that iteration, bounding starvation under sustained
   decode load.
+* prefix awareness (`prefer_cached`, off by default) — with a
+  `prefix_lookup` bound (the engine wires `ServeEngine._match_len`), the
+  scheduler looks up each waiting request's cached-prefix match length
+  and admits shortest-uncovered-suffix first (cheapest prefills =
+  fastest TTFT under load, and hot prefixes stay hot). Requests past the
+  wait budget still go first, in FIFO order — the anti-starvation
+  guarantee is unchanged.
 """
 
 from __future__ import annotations
@@ -36,9 +43,12 @@ FINISHED = "finished"
 REJECTED = "rejected"
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
-    """One generation request and its evolving state.
+    """One generation request and its evolving state. Identity semantics
+    (eq=False): a request is the one object the engine threads from
+    submit to finish — the generated `==` would compare numpy prompts
+    elementwise and raise on mixed lengths (e.g. inside deque.remove).
 
     `tokens` is the output stream: generated ids appended as the engine
     produces them, ending with the request's `eos_id` when it stopped on
@@ -55,6 +65,11 @@ class Request:
     finish_reason: str | None = None
     slot: int | None = None
     waited_steps: int = 0
+    # memoized cached-prefix match length for prefix-aware scheduling:
+    # computed once at first pick() (a per-request tree walk per iteration
+    # would burden the dispatch-bound host loop). Slightly stale by design
+    # — it only orders admission; the engine re-matches at admit time.
+    prefix_hint: int | None = None
     # late-bound so every engine timestamp shares one clock domain with
     # serve.metrics.now (patchable in tests/simulation)
     submit_time: float = dataclasses.field(
@@ -82,11 +97,16 @@ class FIFOScheduler:
         decode_priority: bool = True,
         max_prefills_per_step: int = 1,
         max_wait_steps: int = 64,
+        prefer_cached: bool = False,
+        prefix_lookup=None,
     ):
         self.max_waiting = max_waiting
         self.decode_priority = decode_priority
         self.max_prefills_per_step = max(1, max_prefills_per_step)
         self.max_wait_steps = max_wait_steps
+        self.prefer_cached = prefer_cached
+        # prompt (np.ndarray) -> cached-prefix match length; read-only
+        self.prefix_lookup = prefix_lookup
         self.queue: deque[Request] = deque()
 
     def __len__(self) -> int:
@@ -101,7 +121,13 @@ class FIFOScheduler:
         return True
 
     def pick(self, n_free: int, n_active: int) -> list[Request]:
-        """Pop the requests to prefill this iteration (FIFO order)."""
+        """Pop the requests to prefill this iteration.
+
+        FIFO order by default; with `prefer_cached` and a bound
+        `prefix_lookup`, requests within the wait budget are ordered by
+        shortest uncovered suffix (ties stay FIFO), while overdue
+        requests keep strict FIFO priority ahead of everything.
+        """
         if not self.queue or n_free == 0:
             return []
         budget = n_free
@@ -111,9 +137,20 @@ class FIFOScheduler:
             and self.queue[0].waited_steps <= self.max_wait_steps
         ):
             budget = self.max_prefills_per_step
-        picked = []
-        while self.queue and len(picked) < min(budget, n_free):
-            picked.append(self.queue.popleft())
+        k = min(budget, n_free, len(self.queue))
+        if not (self.prefer_cached and self.prefix_lookup is not None):
+            return [self.queue.popleft() for _ in range(k)]
+        overdue = [r for r in self.queue
+                   if r.waited_steps > self.max_wait_steps]
+        fresh = [r for r in self.queue
+                 if r.waited_steps <= self.max_wait_steps]
+        for r in fresh:
+            if r.prefix_hint is None:
+                r.prefix_hint = self.prefix_lookup(r.prompt)
+        fresh.sort(key=lambda r: r.prompt.size - r.prefix_hint)
+        picked = (overdue + fresh)[:k]
+        taken = {id(r) for r in picked}
+        self.queue = deque(r for r in self.queue if id(r) not in taken)
         return picked
 
     def tick(self) -> None:

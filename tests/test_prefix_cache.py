@@ -1,0 +1,465 @@
+"""Radix-tree prefix cache tests (solvingpapers_tpu/serve/prefix_cache.py).
+
+Two contracts under test. Tree mechanics: page-aligned matching, edge
+splits, LRU eviction under a byte budget, refcount pinning (a pinned
+path survives any pressure). Engine exactness: greedy streams must be
+token-exact with the prefix cache enabled vs disabled vs per-request
+one-shot `generate`, for all four decoder families — splicing a cached
+prefix segment into a lane is bitwise the same computation the lane
+would have run itself, and eviction churn must never corrupt an active
+lane's stream (lanes own copy-on-acquire copies).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from solvingpapers_tpu.infer import generate
+from solvingpapers_tpu.serve import (
+    FIFOScheduler,
+    PrefixCache,
+    Request,
+    ServeConfig,
+    ServeEngine,
+)
+from solvingpapers_tpu.serve.prefix_cache import segment_bytes, segment_length
+
+# ------------------------------------------------------------- tree units
+
+
+def _seg(length, fill=0.0, dtype=jnp.bfloat16):
+    """A fake batch-1 KV segment pytree: one 'layer', k and v leaves."""
+    return [{"k": jnp.full((1, length, 2, 4), fill, dtype),
+             "v": jnp.full((1, length, 2, 4), fill, dtype)}]
+
+
+def _toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+def test_match_miss_then_hit_after_insert():
+    pc = PrefixCache(page=4, max_bytes=1 << 20)
+    tokens = np.arange(12, dtype=np.int32)
+    assert pc.match(tokens).length == 0
+    new = pc.insert(tokens, lambda off, n: _seg(n, fill=off))
+    assert new == 12 and pc.n_nodes == 1
+    m = pc.match(tokens)
+    assert m.length == 12 and len(m.nodes) == 1
+    assert segment_length(m.nodes[0].segment) == 12
+    assert pc.bytes_held == segment_bytes(m.nodes[0].segment)
+
+
+def test_partial_match_splits_at_page_boundary():
+    pc = PrefixCache(page=4, max_bytes=1 << 20)
+    pc.insert(np.arange(12, dtype=np.int32), lambda off, n: _seg(n))
+    # diverges at token 6 -> common prefix 6, page-aligned match 4
+    probe = np.concatenate([np.arange(6), [99, 99]]).astype(np.int32)
+    m = pc.match(probe[:8])
+    assert m.length == 4
+    assert [n.length for n in m.nodes] == [4]
+    # the original 12-token edge is now 4 + 8 under it
+    assert pc.n_nodes == 2
+    full = pc.match(np.arange(12, dtype=np.int32))
+    assert m.nodes[0] is full.nodes[0]
+    assert [n.length for n in full.nodes] == [4, 8]
+    # segments were sliced consistently with the split
+    assert segment_length(full.nodes[0].segment) == 4
+    assert segment_length(full.nodes[1].segment) == 8
+
+
+def test_sub_page_common_prefix_is_a_miss():
+    pc = PrefixCache(page=8, max_bytes=1 << 20)
+    pc.insert(np.arange(8, dtype=np.int32), lambda off, n: _seg(n))
+    probe = np.concatenate([np.arange(5), [99, 99, 99]]).astype(np.int32)
+    assert pc.match(probe).length == 0
+    assert pc.n_nodes == 1  # no split happened
+
+
+def test_peek_is_readonly():
+    pc = PrefixCache(page=4, max_bytes=1 << 20)
+    pc.insert(np.arange(12, dtype=np.int32), lambda off, n: _seg(n))
+    probe = np.concatenate([np.arange(6), [99]]).astype(np.int32)
+    assert pc.peek(probe) == 4
+    assert pc.n_nodes == 1, "peek must not split edges"
+    assert pc.peek(np.arange(12, dtype=np.int32)) == 12
+
+
+def test_insert_rejects_unaligned_length():
+    pc = PrefixCache(page=8, max_bytes=1 << 20)
+    with pytest.raises(ValueError, match="not a multiple"):
+        pc.insert(np.arange(10, dtype=np.int32), lambda off, n: _seg(n))
+
+
+def test_insert_extracts_only_the_uncached_tail():
+    pc = PrefixCache(page=4, max_bytes=1 << 20)
+    calls = []
+
+    def extract(off, n):
+        calls.append((off, n))
+        return _seg(n)
+
+    pc.insert(np.arange(8, dtype=np.int32), extract)
+    pc.insert(np.arange(16, dtype=np.int32), extract)  # 8 cached, 8 new
+    assert calls == [(0, 8), (8, 8)]
+    assert pc.insert(np.arange(16, dtype=np.int32), extract) == 0  # all cached
+    assert calls == [(0, 8), (8, 8)]
+
+
+def test_subpage_divergence_caches_both_stems_as_siblings():
+    """Two stems sharing less than a page (4 of 16 tokens) start with the
+    same token but different first PAGES — page-keyed children let both
+    live side by side (single-token keys would collide, and either insert
+    would clobber the other's subtree)."""
+    pc = PrefixCache(page=16, max_bytes=1 << 20)
+    a = np.arange(64, dtype=np.int32)
+    pc.insert(a, lambda off, n: _seg(n))
+    held = pc.bytes_held
+    b = np.concatenate([a[:4], np.full(60, 99)]).astype(np.int32)
+    assert pc.insert(b, lambda off, n: _seg(n)) == 64
+    assert pc.peek(a) == 64, "existing stem was clobbered"
+    assert pc.peek(b) == 64
+    assert pc.bytes_held == 2 * held and pc.n_nodes == 2
+
+
+def test_subpage_divergence_past_aligned_split_branches_lower():
+    """Divergence at token 20 with page 16: the edge splits at 16 and the
+    remainders (same first token, different pages) become SIBLINGS under
+    the split-off upper — both full stems stay cacheable."""
+    pc = PrefixCache(page=16, max_bytes=1 << 20)
+    a = np.arange(64, dtype=np.int32)
+    pc.insert(a, lambda off, n: _seg(n))
+    b = np.concatenate([a[:20], np.full(44, 99)]).astype(np.int32)
+    assert pc.insert(b, lambda off, n: _seg(n)) == 48
+    assert pc.peek(a) == 64
+    assert pc.peek(b) == 64
+    assert pc.n_nodes == 3  # shared upper [0,16) + two 48-token branches
+    # matching each stem walks its own branch, segments sliced consistently
+    ma, mb = pc.match(a), pc.match(b)
+    assert ma.nodes[0] is mb.nodes[0]
+    assert ma.nodes[1] is not mb.nodes[1]
+    assert segment_length(mb.nodes[1].segment) == 48
+
+
+def test_lru_eviction_respects_budget():
+    one_seg_bytes = segment_bytes(_seg(8))
+    pc = PrefixCache(page=8, max_bytes=2 * one_seg_bytes)
+    rng = np.random.default_rng(0)
+    branches = [rng.integers(100, 200, size=8).astype(np.int32)
+                for _ in range(4)]
+    for b in branches:
+        pc.insert(b, lambda off, n: _seg(n))
+    assert pc.bytes_held <= pc.max_bytes
+    assert pc.evictions == 2 and pc.n_nodes == 2
+    # the two most recently inserted branches survived
+    assert pc.match(branches[0]).length == 0
+    assert pc.match(branches[3]).length == 8
+
+
+def test_match_refreshes_lru_order():
+    one_seg_bytes = segment_bytes(_seg(8))
+    pc = PrefixCache(page=8, max_bytes=2 * one_seg_bytes)
+    a = np.arange(100, 108, dtype=np.int32)
+    b = np.arange(200, 208, dtype=np.int32)
+    pc.insert(a, lambda off, n: _seg(n))
+    pc.insert(b, lambda off, n: _seg(n))
+    pc.match(a)  # a is now the most recently used
+    pc.insert(np.arange(300, 308, dtype=np.int32), lambda off, n: _seg(n))
+    assert pc.match(a).length == 8
+    assert pc.match(b).length == 0  # b was the LRU victim
+
+
+def test_pinned_path_survives_eviction_pressure():
+    one_seg_bytes = segment_bytes(_seg(8))
+    pc = PrefixCache(page=8, max_bytes=one_seg_bytes)  # room for ONE node
+    a = np.arange(100, 108, dtype=np.int32)
+    pc.insert(a, lambda off, n: _seg(n))
+    m = pc.match(a)
+    pc.pin(m)
+    # inserting another branch overflows the budget; the pinned node must
+    # survive, so the NEW node is the only evictable leaf and goes instead
+    pc.insert(np.arange(200, 208, dtype=np.int32), lambda off, n: _seg(n))
+    assert pc.match(a).length == 8, "pinned node was evicted"
+    pc.unpin(m)
+    pc.insert(np.arange(300, 308, dtype=np.int32), lambda off, n: _seg(n))
+    assert pc.match(a).length == 0, "unpinned LRU node should now be evictable"
+
+
+def test_split_preserves_pin_protection_and_unpin_balances():
+    pc = PrefixCache(page=4, max_bytes=1 << 20)
+    tokens = np.arange(12, dtype=np.int32)
+    pc.insert(tokens, lambda off, n: _seg(n))
+    m = pc.match(tokens)
+    pc.pin(m)
+    # a partial match splits the pinned 12-edge at 4; the pinned original
+    # keeps its count as the lower half, and the new upper is protected
+    # transitively (eviction only takes CHILDLESS leaves)
+    probe = np.concatenate([np.arange(6), [99, 99]]).astype(np.int32)
+    pc.match(probe)
+    upper, lower = pc.match(tokens).nodes
+    assert upper.refcount == 0 and lower.refcount == 1
+    pc.max_bytes = 0
+    pc._evict_to_budget()
+    assert pc.match(tokens).length == 12, "pinned path evicted after split"
+    # unpin fully balances the counts (no leaked refs on the upper half)
+    pc.unpin(m)
+    assert upper.refcount == 0 and lower.refcount == 0
+    pc._evict_to_budget()
+    assert pc.n_nodes == 0 and pc.bytes_held == 0
+
+
+def test_evicting_leaf_exposes_parent():
+    one_seg = segment_bytes(_seg(4))
+    pc = PrefixCache(page=4, max_bytes=8 * one_seg)
+    pc.insert(np.arange(12, dtype=np.int32), lambda off, n: _seg(n))
+    probe = np.concatenate([np.arange(4), [50, 50, 50, 50]]).astype(np.int32)
+    pc.insert(probe, lambda off, n: _seg(n))  # splits -> 3 nodes
+    assert pc.n_nodes == 3
+    pc.max_bytes = 0
+    pc._evict_to_budget()
+    assert pc.n_nodes == 0 and pc.bytes_held == 0
+    assert pc.evictions == 3
+
+
+# --------------------------------------------------- scheduler integration
+
+
+def _req(prompt):
+    return Request(prompt=np.asarray(prompt, np.int32), max_new_tokens=4,
+                   eos_id=None)
+
+
+def test_scheduler_prefers_shortest_uncovered_suffix():
+    cached = {8: 0, 16: 12, 24: 24}  # prompt len -> match len
+
+    def lookup(prompt):
+        return cached[prompt.size]
+
+    sched = FIFOScheduler(decode_priority=False, prefer_cached=True,
+                          prefix_lookup=lookup)
+    reqs = [_req(np.arange(n)) for n in (8, 16, 24)]
+    for r in reqs:
+        sched.submit(r)
+    # uncovered suffixes: 8, 4, 0 -> admit order reversed vs FIFO
+    picked = sched.pick(n_free=2, n_active=0)
+    assert picked == [reqs[2], reqs[1]]
+    assert list(sched.queue) == [reqs[0]]
+
+
+def test_scheduler_wait_budget_beats_prefix_preference():
+    sched = FIFOScheduler(decode_priority=False, prefer_cached=True,
+                          max_wait_steps=2,
+                          prefix_lookup=lambda p: 0 if p.size == 8 else p.size)
+    starved = _req(np.arange(8))   # zero cached -> longest suffix
+    sched.submit(starved)
+    for _ in range(3):
+        sched.tick()               # starved is now past the wait budget
+    fresh = _req(np.arange(16))    # fully cached -> shortest suffix
+    sched.submit(fresh)
+    assert sched.pick(n_free=1, n_active=0) == [starved]
+
+
+# ------------------------------------------------------ engine exactness
+
+
+def _gpt_tiny():
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, None
+
+
+def _llama3_tiny():
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    model = Llama(LlamaConfig(vocab_size=64, max_seq_len=64, dim=32,
+                              n_layers=2, n_heads=4, n_kv_heads=2,
+                              dropout=0.0))
+    params = model.init({"params": jax.random.key(1)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, None
+
+
+def _gemma_tiny():
+    from solvingpapers_tpu.models.gemma import Gemma, GemmaConfig
+
+    model = Gemma(GemmaConfig(vocab_size=64, max_seq_len=64, dim=32,
+                              n_layers=2, n_heads=4, n_kv_heads=2,
+                              dropout=0.0))
+    params = model.init({"params": jax.random.key(2)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params, None
+
+
+def _dsv3_tiny():
+    from solvingpapers_tpu.models.deepseekv3 import (
+        DeepSeekV3, DeepSeekV3Config,
+    )
+
+    model = DeepSeekV3(DeepSeekV3Config(
+        vocab_size=64, block_size=64, dim=32, n_layers=2, n_heads=4,
+        latent_dim=8, rope_dim=8, n_experts=4, top_experts=2, dropout=0.0,
+        attn_dropout=0.0,
+    ))
+    variables = model.init({"params": jax.random.key(3)},
+                           jnp.zeros((1, 8), jnp.int32))
+    return model, variables["params"], {"moe_state": variables["moe_state"]}
+
+
+_FAMILIES = {
+    "gpt": _gpt_tiny,
+    "llama3": _llama3_tiny,
+    "gemma": _gemma_tiny,
+    "deepseekv3": _dsv3_tiny,
+}
+
+
+def _shared_prefix_prompts(n, n_stems=2, stem_len=14, tail_len=5, seed=0):
+    rng = np.random.default_rng(seed)
+    stems = [rng.integers(0, 64, size=stem_len).astype(np.int32)
+             for _ in range(n_stems)]
+    return [
+        np.concatenate(
+            [stems[i % n_stems],
+             rng.integers(0, 64, size=tail_len).astype(np.int32)]
+        )
+        for i in range(n)
+    ]
+
+
+def _ref_stream(model, params, extra, prompt, max_new, eos_id=None):
+    out = generate(model, params, jnp.asarray(prompt)[None, :],
+                   jax.random.key(0), max_new_tokens=max_new, eos_id=eos_id,
+                   extra_variables=extra)
+    gen = np.asarray(out[0, len(prompt):]).tolist()
+    if eos_id is not None and eos_id in gen:
+        gen = gen[: gen.index(eos_id) + 1]
+    return gen
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+def test_streams_token_exact_with_cache_on_off_all_families(family):
+    """Greedy streams: cache-on == cache-off == one-shot generate, across
+    shared-prefix traffic. The cache-on runs must actually hit."""
+    model, params, extra = _FAMILIES[family]()
+    prompts = _shared_prefix_prompts(6, seed=11)
+    streams = {}
+    for on in (True, False):
+        eng = ServeEngine(
+            model, params,
+            ServeConfig(n_slots=2, max_len=32, decode_block=4, bucket=8,
+                        prefix_cache=on, prefix_page=4),
+            extra_variables=extra,
+        )
+        handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run()
+        streams[on] = [h.tokens for h in handles]
+        if on:
+            snap = eng.metrics.snapshot()
+            assert snap["serve/prefix_hits"] >= 4, "shared stems never hit"
+            assert snap["serve/tokens_prefilled_saved"] >= 4 * 12
+    assert streams[True] == streams[False]
+    for p, got in zip(prompts, streams[True]):
+        assert got == _ref_stream(model, params, extra, p, 6), (
+            f"{family}: cached stream diverged from one-shot generate"
+        )
+
+
+def test_eviction_churn_never_corrupts_streams():
+    """A byte budget sized for ~2 segments forces constant LRU churn;
+    every stream must stay token-exact (lanes own their spliced copies,
+    pinned nodes never evict mid-splice)."""
+    model, params, extra = _gpt_tiny()
+    prompts = _shared_prefix_prompts(10, n_stems=3, stem_len=14, tail_len=5,
+                                     seed=23)
+    probe = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, prefix_cache=True, prefix_page=4,
+    ))
+    seg = probe.pool.extract_prefix(0, 0, 12)
+    from solvingpapers_tpu.serve.prefix_cache import segment_bytes as sb
+
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        prefix_cache=True, prefix_page=4, prefix_cache_bytes=2 * sb(seg),
+    ))
+    handles = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run()
+    assert eng.prefix_cache.evictions > 0, "budget never forced an eviction"
+    assert eng.prefix_cache.bytes_held <= eng.prefix_cache.max_bytes
+    for p, h in zip(prompts, handles):
+        assert h.tokens == _ref_stream(model, params, extra, p, 6)
+    assert eng.metrics.snapshot()["serve/prefix_evictions"] > 0
+
+
+def test_lane_reuse_after_early_eos_with_splice_pending():
+    """One slot: request A stops on early EOS, queued B (sharing A's
+    stem) immediately re-acquires the lane WITH a prefix splice into it —
+    the spliced prefix must overwrite A's leftovers exactly."""
+    model, params, extra = _gpt_tiny()
+    prompts = _shared_prefix_prompts(3, n_stems=1, stem_len=14, tail_len=5,
+                                     seed=31)
+    ref0 = _ref_stream(model, params, extra, prompts[0], 12)
+    # an EOS id the greedy stream emits early but not immediately
+    i, eos = next((i, t) for i, t in enumerate(ref0[1:-1], 1)
+                  if t not in ref0[:i])
+
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=48, decode_block=2, bucket=8,
+        prefix_cache=True, prefix_page=4,
+    ))
+    h0 = eng.submit(prompts[0], max_new_tokens=12, eos_id=eos)
+    rest = [eng.submit(p, max_new_tokens=12) for p in prompts[1:]]
+    eng.run()
+    assert h0.finish_reason == "eos"
+    assert h0.tokens == _ref_stream(model, params, extra, prompts[0], 12,
+                                    eos_id=eos)
+    for p, h in zip(prompts[1:], rest):
+        assert h.slot == h0.slot  # single lane: every request reused it
+        assert h.tokens == _ref_stream(model, params, extra, p, 12)
+    # B and C shared A's stem: both admissions spliced
+    assert eng.metrics.prefix_hits >= 2
+
+
+def test_prefix_metrics_flow_through_snapshot():
+    model, params, _ = _gpt_tiny()
+    prompts = _shared_prefix_prompts(4, n_stems=1, seed=7)
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        prefix_cache=True, prefix_page=4,
+    ))
+    for p in prompts:
+        eng.submit(p, max_new_tokens=4)
+    eng.run()
+    snap = eng.metrics.snapshot()
+    assert snap["serve/prefix_lookups"] == 4
+    assert 0 < snap["serve/prefix_hit_rate"] <= 1
+    assert snap["serve/prefix_cached_tokens"] == \
+        snap["serve/tokens_prefilled_saved"] > 0
+    assert snap["serve/prefix_hbm_bytes"] > 0
+    assert snap["serve/prefix_evictions"] == 0
+    # prefilled counts only what the engine actually ran prefill over
+    total_prompt = sum(len(p) for p in prompts)
+    assert snap["serve/tokens_prefilled"] == \
+        total_prompt - snap["serve/prefix_cached_tokens"]
+
+
+def test_prefix_sched_requires_prefix_cache():
+    model, params, _ = _gpt_tiny()
+    with pytest.raises(ValueError, match="prefix_cache=True"):
+        ServeEngine(model, params, ServeConfig(
+            n_slots=1, max_len=32, prefix_cache=False, prefix_sched=True,
+        ))
+
+
+def test_cache_disabled_has_no_tree_and_no_counters():
+    model, params, _ = _gpt_tiny()
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=32, prefix_cache=False,
+    ))
+    assert eng.prefix_cache is None
+    eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=2)
+    eng.run()
+    assert "serve/prefix_lookups" not in eng.metrics.snapshot()

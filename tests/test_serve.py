@@ -206,6 +206,73 @@ def test_kv_pool_acquire_release(gpt_tiny):
         pool.release(slots[1])
 
 
+def test_kv_pool_acquire_on_exhausted_is_stable(gpt_tiny):
+    """Exhaustion returns None (no exception, no state damage) and stays
+    None until a release; the released lane is handed out next."""
+    model, _ = gpt_tiny
+    pool = KVSlotPool(model, n_slots=2, max_len=16)
+    a, b = pool.acquire(), pool.acquire()
+    for _ in range(3):
+        assert pool.acquire() is None
+    assert pool.n_free == 0 and pool.n_active == 2
+    pool.release(a)
+    assert pool.acquire() == a
+    assert pool.acquire() is None
+
+
+def test_kv_pool_splice_and_extract_roundtrip(gpt_tiny):
+    """extract_prefix snapshots a COPY; splice_prefix writes it back at
+    an offset without touching other lanes or the rest of the lane."""
+    model, _ = gpt_tiny
+    pool = KVSlotPool(model, n_slots=2, max_len=16)
+    # fill lane 1's slots [0, 8) with a recognizable ramp
+    ramp = jax.tree_util.tree_map(
+        lambda a: jnp.arange(np.prod(a.shape[2:]) * 8, dtype=jnp.float32)
+        .reshape((1, 8) + a.shape[2:]).astype(a.dtype),
+        pool.extract_prefix(1, 0, 8),
+    )
+    pool.splice_prefix(1, ramp, offset=0)
+    seg = pool.extract_prefix(1, 4, 4)
+    np.testing.assert_array_equal(
+        np.asarray(seg[0].k), np.asarray(ramp[0].k[:, 4:8])
+    )
+    # splice the snapshot into lane 0 at offset 8; lane 1 is untouched
+    before_lane1 = np.asarray(pool.caches[0].k[1])
+    pool.splice_prefix(0, seg, offset=8)
+    np.testing.assert_array_equal(
+        np.asarray(pool.caches[0].k[0, 8:12]), np.asarray(ramp[0].k[0, 4:8])
+    )
+    np.testing.assert_array_equal(np.asarray(pool.caches[0].k[1]), before_lane1)
+    with pytest.raises(ValueError, match="exceeds the lane capacity"):
+        pool.splice_prefix(0, seg, offset=14)
+    with pytest.raises(ValueError, match="exceeds the lane capacity"):
+        pool.extract_prefix(0, 14, 4)
+
+
+def test_store_lane_and_splice_reject_dtype_mismatch(gpt_tiny):
+    """The pool write paths never cast: a silent astype would down-cast
+    an fp32 segment into a bf16 pool and quietly change every stream
+    decoded over it. Mismatches must raise at trace time."""
+    from solvingpapers_tpu.serve import extract_lane, store_lane
+
+    model, _ = gpt_tiny
+    pool = KVSlotPool(model, n_slots=2, max_len=16)
+    lane = extract_lane(pool.caches, 0)
+    pool_dtype = pool.caches[0].k.dtype
+    wrong_dtype = jnp.bfloat16 if pool_dtype == jnp.float32 else jnp.float32
+    wrong = jax.tree_util.tree_map(lambda a: a.astype(wrong_dtype), lane)
+    with pytest.raises(TypeError, match="cast explicitly"):
+        store_lane(pool.caches, wrong, 0)
+    seg = jax.tree_util.tree_map(
+        lambda a: a.astype(wrong_dtype), pool.extract_prefix(0, 0, 4)
+    )
+    with pytest.raises(TypeError, match="cast explicitly"):
+        pool.splice_prefix(0, seg, offset=0)
+    # matching dtypes round-trip fine
+    pool.caches = store_lane(pool.caches, lane, 0)
+    pool.splice_prefix(0, pool.extract_prefix(0, 0, 4), offset=4)
+
+
 def test_kv_pool_positions_track_lane_fill(gpt_tiny):
     """`pool.positions[slot]` is the lane's real KV fill level — prompt
     plus every emitted token except the newest (whose KV lands only when
