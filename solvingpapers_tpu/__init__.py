@@ -22,7 +22,8 @@ Layout (see SURVEY.md §7):
 __version__ = "0.1.0"
 
 _SERVE_API = ("ServeEngine", "ServeConfig", "KVSlotPool", "FIFOScheduler",
-              "Request", "ServeMetrics", "PrefixCache", "PrefixMatch")
+              "Request", "ServeMetrics", "PrefixCache", "PrefixMatch",
+              "SamplingParams")
 
 
 def __getattr__(name):

@@ -385,8 +385,10 @@ def cmd_sample(args) -> int:
 def cmd_serve_bench(args) -> int:
     """Continuous-batching engine vs sequential one-shot generate on a
     synthetic Poisson arrival stream — or, with --shared-prefix, prefix
-    cache on vs off over K shared system prompts (serve/bench.py); prints
-    the BENCH-shaped JSON and optionally writes it to --out."""
+    cache on vs off over K shared system prompts, or, with --sampling,
+    a per-request SamplingParams mix vs all-greedy on the same trace
+    (serve/bench.py); prints the BENCH-shaped JSON and optionally writes
+    it to --out."""
     if args.checkpoint_dir or args.data_path:
         print(
             "serve-bench benchmarks scheduling throughput on random-init "
@@ -394,7 +396,15 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 2
-    from solvingpapers_tpu.serve.bench import run_prefix_bench, run_serve_bench
+    if args.shared_prefix and args.sampling:
+        print("--shared-prefix and --sampling are separate workloads; "
+              "pick one per run", file=sys.stderr)
+        return 2
+    from solvingpapers_tpu.serve.bench import (
+        run_prefix_bench,
+        run_sampling_bench,
+        run_serve_bench,
+    )
 
     max_new = args.max_new_tokens
     if max_new is None:
@@ -405,7 +415,18 @@ def cmd_serve_bench(args) -> int:
     n_requests = args.requests
     if n_requests is None:
         n_requests = 48 if args.shared_prefix else 32
-    if args.shared_prefix:
+    if args.sampling:
+        result = run_sampling_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(args.prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            seed=args.seed,
+        )
+    elif args.shared_prefix:
         result = run_prefix_bench(
             config=args.config,
             n_requests=n_requests,
@@ -604,6 +625,11 @@ def main(argv=None) -> int:
                               "over K distinct system prompts, prefix "
                               "cache on vs off (serve/bench.py "
                               "run_prefix_bench)")
+    p_serve.add_argument("--sampling", action="store_true",
+                         help="sampling workload instead: the same Poisson "
+                              "trace decoded all-greedy vs with a "
+                              "per-request temperature/top-p/top-k/min-p "
+                              "mix (serve/bench.py run_sampling_bench)")
     p_serve.add_argument("--n-prefixes", type=int, default=4,
                          help="[--shared-prefix] distinct system prompts K")
     p_serve.add_argument("--prefix-len", type=int, default=None,

@@ -40,4 +40,9 @@ from solvingpapers_tpu.ops.sampling import (
     sample_greedy,
     sample_categorical,
     sample_top_k,
+    sample_top_p,
+    sample_min_p,
+    top_k_mask,
+    top_p_mask,
+    min_p_mask,
 )

@@ -6,15 +6,81 @@ loops, covering the reference's four decoding strategies:
   * categorical sampling    (llama3/LLaMA-jax.ipynb cell 14)
   * multinomial             (gemma/gemma.ipynb cell 20 — same as categorical)
   * temperature + top-k     (deepseekv3/deepseekv3.ipynb cell 40)
+plus nucleus (top-p, Holtzman et al., "The Curious Case of Neural Text
+Degeneration") and min-p truncation.
 
 All are jit-safe (static shapes, no python branching on values) so they can
 live inside a lax.while_loop/scan decode body (infer/decode.py).
+
+The `*_mask` helpers are the single source of the top-p/min-p truncation
+logic: `sample_top_p`/`sample_min_p` below AND the serving engine's fused
+per-slot sampler (`serve/sampling.py`) both call them. Unlike
+`lax.top_k`-based masking, they accept TRACED, per-row cutoffs
+(`k`/`p`/`min_p` may be arrays broadcastable against
+``logits[..., :1]``), which is what lets every slot of a vmapped decode
+block carry different sampling params without recompiling — disabled
+values (k <= 0, p >= 1, min_p <= 0) keep every token, so a greedy row
+rides the same program unchanged. `sample_top_k` keeps its own
+static-k `lax.top_k` threshold path on purpose: inside `generate`'s
+decode scan a partial selection is far cheaper than `top_k_mask`'s full
+sort, and its k is a static jit arg anyway (the serve path gets the same
+economics from its top-`sample_cap` pre-selection).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def top_k_mask(logits: jax.Array, k) -> jax.Array:
+    """Mask all but the `k` largest logits per row to -inf.
+
+    `k` may be a python int, a traced scalar, or an array broadcastable
+    against ``logits[..., :1]`` (per-row k). ``k <= 0`` disables the mask
+    for that row (all tokens kept). Ties at the k-th value are all kept,
+    matching `sample_top_k`'s threshold semantics.
+    """
+    k = jnp.asarray(k, jnp.int32)
+    vocab = logits.shape[-1]
+    sorted_desc = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+    idx = jnp.broadcast_to(
+        jnp.clip(k - 1, 0, vocab - 1), logits.shape[:-1] + (1,)
+    )
+    thresh = jnp.take_along_axis(sorted_desc, idx, axis=-1)
+    return jnp.where((logits >= thresh) | (k <= 0), logits, -jnp.inf)
+
+
+def top_p_mask(logits: jax.Array, p) -> jax.Array:
+    """Nucleus mask: keep the smallest prefix of descending-probability
+    tokens whose cumulative mass reaches `p`; mask the rest to -inf.
+
+    The token that crosses the `p` boundary is KEPT (standard nucleus
+    semantics: the kept set's mass is the least value >= p). `p` may be a
+    scalar or an array broadcastable against ``logits[..., :1]``;
+    ``p >= 1`` keeps every token with nonzero probability.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # token i (sorted) is kept iff the mass BEFORE it is < p: the first
+    # token is always kept, and the one crossing p is included
+    keep_sorted = (cum - sorted_probs) < jnp.asarray(p, logits.dtype)
+    kth = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+    thresh = jnp.take_along_axis(sorted_probs, kth, axis=-1)
+    return jnp.where(probs >= thresh, logits, -jnp.inf)
+
+
+def min_p_mask(logits: jax.Array, min_p) -> jax.Array:
+    """Keep tokens whose probability is >= ``min_p * max probability``;
+    mask the rest to -inf. ``min_p <= 0`` disables (all kept); the argmax
+    row is always kept, so the masked row is never empty. `min_p` may be
+    a scalar or an array broadcastable against ``logits[..., :1]``."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    thresh = jnp.asarray(min_p, logits.dtype) * jnp.max(
+        probs, axis=-1, keepdims=True
+    )
+    return jnp.where(probs >= thresh, logits, -jnp.inf)
 
 
 def sample_greedy(logits: jax.Array, rng: jax.Array | None = None) -> jax.Array:
@@ -44,3 +110,29 @@ def sample_top_k(
     thresh = top_vals[..., -1:]
     masked = jnp.where(logits >= thresh, logits, -jnp.inf)
     return jax.random.categorical(rng, masked, axis=-1)
+
+
+def sample_top_p(
+    logits: jax.Array,
+    rng: jax.Array,
+    p: float = 0.9,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """Temperature + nucleus (top-p) sampling: sample from the smallest
+    token set whose cumulative probability reaches `p`. ``p=1.0`` is plain
+    categorical sampling (same draw for the same rng)."""
+    logits = logits.astype(jnp.float32) / temperature
+    return jax.random.categorical(rng, top_p_mask(logits, p), axis=-1)
+
+
+def sample_min_p(
+    logits: jax.Array,
+    rng: jax.Array,
+    min_p: float = 0.05,
+    temperature: float = 1.0,
+) -> jax.Array:
+    """Temperature + min-p sampling: drop tokens whose probability is
+    below ``min_p`` times the top token's. ``min_p=0`` is plain
+    categorical sampling (same draw for the same rng)."""
+    logits = logits.astype(jnp.float32) / temperature
+    return jax.random.categorical(rng, min_p_mask(logits, min_p), axis=-1)

@@ -1,8 +1,25 @@
 """Continuous-batching serving: slot pool + FIFO scheduler + mixed
-prefill/decode engine + radix-tree prefix cache + latency metrics."""
+prefill/decode engine + radix-tree prefix cache + per-request sampling
+(SamplingParams / fused_sample) + latency metrics."""
 
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
 from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
+from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request
+
+__all__ = [
+    "ServeConfig",
+    "ServeEngine",
+    "KVSlotPool",
+    "extract_lane",
+    "store_lane",
+    "ServeMetrics",
+    "PrefixCache",
+    "PrefixMatch",
+    "SamplingParams",
+    "fused_sample",
+    "FIFOScheduler",
+    "Request",
+]

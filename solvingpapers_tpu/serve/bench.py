@@ -19,6 +19,12 @@ Requests/s = n_requests / (last finish - first arrival).
 shared system prompts (`shared_prefix_requests`), engine vs engine with
 the radix prefix cache on vs off — the TTFT win of splicing a cached
 prefix instead of re-prefilling it (`cli serve-bench --shared-prefix`).
+
+`run_sampling_bench` is the third: the same Poisson trace decoded twice,
+all-greedy vs a per-request temperature/top-p/top-k/min-p mix
+(`cli serve-bench --sampling`) — the cost of the fused per-slot sampler's
+sort-based masking relative to the sort-free greedy fast path, i.e. the
+price of SamplingParams when a batch actually uses them.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ import numpy as np
 
 from solvingpapers_tpu import ops
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.sampling import SamplingParams
 
 _DECODER_FAMILIES = ("gpt", "llama3", "gemma", "deepseekv3")
 
@@ -136,7 +143,10 @@ def _round_if_present(snap: dict, key: str, out_key: str, digits: int) -> dict:
     return {}
 
 
-def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new):
+def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new,
+                    params_for=None):
+    """`params_for` (index -> SamplingParams | None) attaches per-request
+    sampling params; None keeps every request greedy (the default)."""
     eng = ServeEngine(model, params, serve_cfg, extra_variables=extra)
     pending = sorted(requests, key=lambda r: r[0])
     handles = []
@@ -145,7 +155,10 @@ def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new):
     while i < len(pending) or eng.has_work():
         elapsed = time.monotonic() - t0
         while i < len(pending) and pending[i][0] <= elapsed:
-            handles.append(eng.submit(pending[i][1], max_new_tokens=max_new))
+            handles.append(eng.submit(
+                pending[i][1], max_new_tokens=max_new,
+                params=params_for(i) if params_for is not None else None,
+            ))
             i += 1
         if eng.has_work():
             eng.step()
@@ -380,5 +393,104 @@ def run_prefix_bench(
             "prefix_page": prefix_page,
             **{f"{arm}_{k}": v for arm, d in arms.items()
                for k, v in d.items()},
+        },
+    }
+
+
+def sampling_params_mix(i: int) -> SamplingParams:
+    """Request i's params in the --sampling workload: one greedy slot in
+    four, the rest a temperature/top-p/top-k/min-p rotation (seeded per
+    request so the workload itself is reproducible). The mix keeps every
+    decode block heterogeneous — the exact situation the fused per-slot
+    sampler exists for."""
+    mix = (
+        SamplingParams(),  # greedy — must coexist with the rest
+        SamplingParams(temperature=1.0, top_p=0.9, seed=i),
+        SamplingParams(temperature=1.2, top_k=50, seed=i),
+        SamplingParams(temperature=0.8, min_p=0.05, seed=i),
+    )
+    return mix[i % len(mix)]
+
+
+def run_sampling_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    seed: int = 0,
+) -> dict:
+    """Sampled vs greedy decode on the same Poisson trace.
+
+    Both arms run the SAME engine over the SAME arrival offsets; the only
+    difference is the per-request SamplingParams mix. The headline
+    (`vs_baseline`) is sampled req/s / greedy req/s — the fused sampler's
+    overhead when a batch actually mixes stochastic requests (greedy-only
+    batches take a sort-free runtime fast path and cost what the old
+    static greedy sampler did).
+    """
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    serve_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_prompt + max_new,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        seed=seed,
+    )
+
+    # warm every compiled shape (prefill buckets + decode; the sampled
+    # path adds NO programs — that is the point — but warm both arms so
+    # neither pays first-call dispatch differences)
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
+    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new,
+                    params_for=sampling_params_mix)
+
+    arms = {}
+    for name, params_for in (("greedy", None),
+                             ("sampled", sampling_params_mix)):
+        eng, _, makespan = _run_engine_arm(
+            model, params, extra, requests, serve_cfg, max_new,
+            params_for=params_for,
+        )
+        snap = eng.metrics.snapshot()
+        arms[name] = {
+            "requests_per_sec": n_requests / makespan,
+            "tokens_per_sec": snap.get("serve/tokens_per_sec", 0.0),
+            **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
+            **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
+        }
+    ratio = arms["sampled"]["requests_per_sec"] / arms["greedy"][
+        "requests_per_sec"]
+    return {
+        "metric": "serve_sampling_requests_per_sec",
+        "value": round(arms["sampled"]["requests_per_sec"], 2),
+        "unit": "req/s",
+        # > 1 would mean sampling was free (noise); ~0.9 = 10% overhead
+        "vs_baseline": round(ratio, 3),
+        "detail": {
+            "config": config,
+            "workload": "sampling-mix",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "sampling_overhead_pct": round((1.0 - ratio) * 100.0, 1),
+            **{f"{arm}_{k}": (round(v, 2) if isinstance(v, float) else v)
+               for arm, d in arms.items() for k, v in d.items()},
         },
     }

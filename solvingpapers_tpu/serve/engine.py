@@ -40,10 +40,24 @@ snapshots the prompt's prefix back into the radix tree. Cached KV at
 position p depends only on tokens <= p, so greedy streams are token-exact
 with the cache on or off.
 
-Greedy streams are token-exact vs per-request one-shot `generate`
-(tests/test_serve.py, tests/test_prefix_cache.py); stochastic samplers
-draw from a different rng chain than `generate` and match only in
-distribution.
+Per-request sampling (`serve/sampling.py`): every request carries
+`SamplingParams` (temperature / top-k / top-p / min-p / seed / stop sets /
+logprobs). The knobs live in slot-major struct-of-arrays mirrors packed
+into the jitted programs as TRACED control operands — one fused
+`fused_sample` serves the whole slot axis, so a greedy request and a
+temperature-1.2/top-p-0.9 request coexist in one vmapped decode block
+with zero extra compiled programs. Greedy slots (temperature 0) are
+token-exact vs per-request one-shot greedy `generate`
+(tests/test_serve.py, tests/test_prefix_cache.py,
+tests/test_serve_sampling.py); a seeded stochastic slot replays the same
+stream run-to-run (its rng chain folds only (seed, sample index) into the
+engine's base key — never the slot or step counter).
+
+Request lifecycle: `cancel()` and per-request deadlines free the lane at
+the next block boundary (finish reasons eos / length / stop / cancelled /
+timeout, counted in `ServeMetrics`); stop strings are matched host-side
+on the detokenized stream (matches may span block boundaries); stop
+token-id sets extend single-id EOS host-side.
 """
 
 from __future__ import annotations
@@ -55,14 +69,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from solvingpapers_tpu import ops
 from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache
+from solvingpapers_tpu.serve.sampling import (
+    GREEDY_ROW,
+    PackedSampling,
+    SamplingParams,
+    encode_params,
+    fused_sample,
+    request_key,
+    slot_keys,
+)
 from solvingpapers_tpu.serve.scheduler import (
     ACTIVE,
     FINISHED,
+    WAITING,
     FIFOScheduler,
     Request,
 )
@@ -101,6 +124,13 @@ class ServeConfig:
     max_len: int = 512
     decode_block: int = 8
     bucket: int = 64
+    # static support bound for stochastic sampling (clamped to the vocab):
+    # fused_sample draws inside the top `sample_cap` logits per step —
+    # bounded-support sampling keeps the per-step cost at one top-k
+    # selection instead of full-vocab sorts (~100x the forward on
+    # XLA:CPU). Requests' top_k must fit under it (submit validates);
+    # raise it (up to the vocab size) for exact full-support sampling.
+    sample_cap: int = 64
     prefill_chunk: int | None = None
     max_waiting: int = 256
     decode_priority: bool = True
@@ -119,23 +149,28 @@ _UNSET = object()
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "sampler", "padded", "chunk", "start"),
+    static_argnames=("model", "padded", "chunk", "start", "cap"),
     donate_argnames=("caches",),
 )
-def _prefill_program(model, sampler, padded, chunk, start, variables, caches,
-                     prompt, ctl, rng):
+def _prefill_program(model, padded, chunk, start, cap, variables, caches,
+                     prompt, ctl, samp, rng):
     """Prefill one request into lane `ctl[0]` and sample its first token.
 
-    `prompt` is (padded,) right-padded; `ctl = [slot, length, step]` is
-    the host's packed control word (one transfer instead of three — the
-    host loop's dispatch overhead is the serving bottleneck on small
-    models, see tools/bench_serve.py), where `length` is the real token
-    count, so one compiled program serves every prompt in the bucket.
-    `rng` is the engine's base key, decorrelated per call by folding in
-    the step counter. Chunks mirror `generate`'s static-bound python
-    loop; the logits row for the LAST REAL token is gathered from
-    whichever chunk contains it (padding makes that not-necessarily-the-
-    last chunk).
+    `prompt` is (padded,) right-padded; `ctl = [slot, length, step,
+    top_k, seed, need_lp]` is the host's packed int control word (one
+    transfer instead of six — the host loop's dispatch overhead is the
+    serving bottleneck on small models, see tools/bench_serve.py), where
+    `length` is the real token count, so one compiled program serves
+    every prompt in the bucket. `samp = [temperature, top_p, min_p]` is
+    the float half of the request's SamplingParams — every sampling knob
+    is a traced operand, so the compiled inventory is untouched by the
+    param mix (`cap` = ServeConfig.sample_cap is static but fixed per
+    engine).
+    `rng` is the engine's base key; the first token is sample index 0 of
+    the request's chain (see `serve.sampling.request_key`). Chunks mirror
+    `generate`'s static-bound python loop; the logits row for the LAST
+    REAL token is gathered from whichever chunk contains it (padding
+    makes that not-necessarily-the-last chunk).
 
     `start` (static) is the prefix-cache match length: `prompt` is the
     UNCOVERED SUFFIX, cache slots [0, start) already hold the spliced
@@ -146,7 +181,6 @@ def _prefill_program(model, sampler, padded, chunk, start, variables, caches,
     keeping the compiled inventory bounded.
     """
     slot, length = ctl[0], ctl[1]
-    rng = jax.random.fold_in(rng, ctl[2])
     lane = extract_lane(caches, slot)
     toks = prompt[None, :]
     step = chunk or padded
@@ -166,34 +200,54 @@ def _prefill_program(model, sampler, padded, chunk, start, variables, caches,
                                            keepdims=False)
         sel = (length - 1 >= cs) & (length - 1 < ce)
         last = row if last is None else jnp.where(sel, row, last)
-    first = sampler(last[None], rng)[0].astype(jnp.int32)
-    return store_lane(caches, lane, slot), first
+    packed = PackedSampling(
+        temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
+        top_k=ctl[3:4], need_lp=ctl[5:6],
+    )
+    key = request_key(rng, step_tag=ctl[2], slot=slot, seed=ctl[4],
+                      samp_idx=jnp.int32(0))
+    first, logprob = fused_sample(last[None], packed, key[None], cap=cap)
+    return store_lane(caches, lane, slot), first[0], logprob[0]
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "sampler", "block"),
+    static_argnames=("model", "block", "cap"),
     donate_argnames=("caches",),
 )
-def _decode_program(model, sampler, block, variables, caches, state, rng):
+def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     """Advance every slot `block` tokens; inactive slots run masked.
 
-    `state` is the host's packed (5, n_slots) int32 control block —
-    rows [toks, pos, active, eos, step] — so each call costs ONE
-    host->device transfer; the host keeps a numpy mirror of toks/pos and
-    only the emitted stream `out` comes back. `rng` is the engine's base
-    key (a constant buffer), decorrelated per block by folding in the
-    step counter riding row 4.
+    `state` is the host's packed (9, n_slots) int32 control block — rows
+    [toks, pos, active, eos, step, top_k, seed, samp_idx, need_lp] — and
+    `samp` the packed (3, n_slots) float32 half of every slot's
+    SamplingParams (rows [temperature, top_p, min_p]), so each call
+    costs two host->device transfers regardless of slot count or param
+    mix; the host keeps numpy mirrors and only the emitted streams come
+    back. Every sampling knob is traced, so the compiled decode program
+    count is identical to the static-greedy engine's (`cap` =
+    ServeConfig.sample_cap is static but fixed per engine). `rng` is the
+    engine's base key (a constant buffer); per-slot keys fold in the
+    request seed and sample index for seeded slots, or the step counter
+    riding row 4 for unseeded ones (`serve.sampling.slot_keys`).
 
     The per-slot apply is a batch-1 single-token forward vmapped over the
     slot axis — per-slot positions and per-slot cache writes fall out of
     the models' ``positions[0, 0]`` write contract under vmap. EOS
     padding is sticky by induction (an emitted EOS forces every later
     emission to EOS), mirroring `generate`'s done-flag semantics.
+
+    Returns ``(caches, (tokens (block, S) i32, logprobs (block, S)
+    f32))`` — the logprob row is the chosen token's log-softmax under the
+    raw logits (streamed to requests with ``params.logprobs``).
     """
     toks, pos = state[0], state[1]
     active, eos = state[2].astype(bool), state[3]
-    rng = jax.random.fold_in(rng, state[4, 0])
+    step_tag, seeds = state[4, 0], state[6]
+    packed = PackedSampling(
+        temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
+        need_lp=state[8],
+    )
 
     def one(tok, p, slot_caches):
         lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
@@ -205,18 +259,20 @@ def _decode_program(model, sampler, block, variables, caches, state, rng):
             lambda a: jnp.squeeze(a, axis=0), lane
         )
 
-    def step(carry, sub):
-        toks, pos, caches = carry
+    def step(carry, _):
+        toks, pos, samp_idx, caches = carry
         logits, caches = jax.vmap(one)(toks, pos, caches)
-        nxt = sampler(logits, sub).astype(toks.dtype)
+        keys = slot_keys(rng, step_tag, seeds, samp_idx)
+        nxt, logprob = fused_sample(logits, packed, keys, cap=cap)
+        nxt = nxt.astype(toks.dtype)
         hit_eos = (eos >= 0) & (toks == eos)
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
         nxt = jnp.where(active, nxt, toks)
         pos = jnp.where(active, pos + 1, pos)
-        return (nxt, pos, caches), nxt
+        return (nxt, pos, samp_idx + 1, caches), (nxt, logprob)
 
-    (toks, pos, caches), out = jax.lax.scan(
-        step, (toks, pos, caches), jax.random.split(rng, block)
+    (toks, pos, _, caches), out = jax.lax.scan(
+        step, (toks, pos, state[7], caches), None, length=block
     )
     return caches, out
 
@@ -233,6 +289,12 @@ class ServeEngine:
     ``rejected``); `step()` is one scheduler iteration and may be driven
     by an external loop that interleaves new submissions — that is the
     point of continuous batching.
+
+    Per-request sampling rides `submit(..., params=SamplingParams(...))`
+    (default greedy); there is no engine-wide sampler any more — the mix
+    of greedy and stochastic requests shares the same compiled programs.
+    `detokenize` (token ids -> text) is only needed when requests use
+    stop STRINGS; stop token-id sets and everything else work without it.
     """
 
     def __init__(
@@ -241,9 +303,9 @@ class ServeEngine:
         params,
         config: ServeConfig | None = None,
         *,
-        sampler=ops.sample_greedy,
         extra_variables: dict | None = None,
         metrics_window: int = 4096,
+        detokenize=None,
     ):
         cfg = config or ServeConfig()
         limit = getattr(model, "max_positions", None)
@@ -254,7 +316,7 @@ class ServeEngine:
             )
         self.model = model
         self.config = cfg
-        self.sampler = sampler
+        self.detokenize = detokenize
         self.variables = {"params": params, **(extra_variables or {})}
         if cfg.prefix_sched and not cfg.prefix_cache:
             raise ValueError(
@@ -282,9 +344,24 @@ class ServeEngine:
         # bookkeeping was half the drain time on small models
         self._toks = np.zeros(cfg.n_slots, np.int32)
         self._pos = np.zeros(cfg.n_slots, np.int32)
+        # slot-major SamplingParams mirrors, packed into the jitted calls
+        # as traced control arrays (serve/sampling.py). Free lanes rest at
+        # the greedy row so an all-greedy batch rides fused_sample's
+        # sort-free fast path.
+        self._samp_f = np.tile(
+            np.asarray(GREEDY_ROW, np.float32)[:, None], (1, cfg.n_slots)
+        )
+        self._top_k = np.zeros(cfg.n_slots, np.int32)
+        self._seed = np.full(cfg.n_slots, -1, np.int32)
+        self._need_lp = np.zeros(cfg.n_slots, np.int32)
         self._rng = jax.random.key(cfg.seed)  # base key; folded per call
         self._rng_step = 0
         self._last_emit = np.zeros(cfg.n_slots)  # per-slot last emit time
+        # deadline-bearing requests currently in the waiting queue: step()
+        # only scans the queue for expiries when this is nonzero, so
+        # deadline-free traffic pays nothing on the dispatch-bound host
+        # loop (updated at submit / admit / cancel / purge)
+        self._waiting_deadlines = 0
 
     # ------------------------------------------------------------- submit
 
@@ -293,13 +370,58 @@ class ServeEngine:
         prompt,
         max_new_tokens: int = 64,
         eos_id=_UNSET,
+        params: SamplingParams | None = None,
+        deadline_s: float | None = None,
     ) -> Request:
-        """Enqueue one request; returns its live handle immediately."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size < 1:
+        """Enqueue one request; returns its live handle immediately.
+
+        `params` attaches per-request SamplingParams (default greedy;
+        ``params.max_tokens`` overrides `max_new_tokens` when set).
+        `deadline_s` is a relative deadline: a request still waiting or
+        decoding `deadline_s` seconds after submit finishes "timeout" at
+        the next scheduler iteration / block boundary.
+
+        Bad inputs raise `ValueError` HERE, host-side — never inside a
+        traced program: non-integer or non-1-D prompts, empty prompts,
+        budgets < 1, prompts beyond the engine capacity, non-positive
+        deadlines, and stop strings without a `detokenize` callable.
+        """
+        arr = np.asarray(prompt)
+        # size first: np.asarray([]) defaults to float64, and leading with
+        # the dtype check would blame "float" ids on a prompt with no ids
+        if arr.size < 1:
             raise ValueError("prompt must have at least one token")
+        if arr.dtype.kind not in "iu":
+            raise ValueError(
+                f"prompt must be integer token ids, got dtype {arr.dtype} "
+                "(cast explicitly if the values really are ids)"
+            )
+        if arr.ndim != 1:
+            raise ValueError(
+                f"prompt must be 1-D (one request's token ids), got shape "
+                f"{arr.shape} — batch by submitting one request per row"
+            )
+        prompt = arr.astype(np.int32)
+        params = params or SamplingParams()
+        if params.max_tokens is not None:
+            max_new_tokens = params.max_tokens
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if params.stop and self.detokenize is None:
+            raise ValueError(
+                "params.stop (stop strings) needs the engine constructed "
+                "with a `detokenize` callable (token ids -> text); "
+                "stop_token_ids work without one"
+            )
+        if params.top_k > self.config.sample_cap:
+            raise ValueError(
+                f"top_k {params.top_k} exceeds ServeConfig.sample_cap "
+                f"{self.config.sample_cap} — the engine samples inside the "
+                "top sample_cap logits; raise the cap (costlier decode "
+                "steps) or lower top_k"
+            )
         total = prompt.size + max_new_tokens
         limit = getattr(self.model, "max_positions", None)
         cap = min(self.config.max_len, limit or self.config.max_len)
@@ -313,10 +435,29 @@ class ServeEngine:
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             eos_id=self.config.eos_id if eos_id is _UNSET else eos_id,
+            params=params,
         )
+        if deadline_s is not None:
+            req.deadline = req.submit_time + deadline_s
         if not self.scheduler.submit(req):
             self.metrics.record_reject()
+        elif req.deadline is not None:
+            self._waiting_deadlines += 1
         return req
+
+    def cancel(self, req: Request) -> None:
+        """Cancel a request: a WAITING one leaves the queue and finishes
+        "cancelled" immediately; an ACTIVE one keeps its lane until the
+        next block boundary, where the engine discards that block's
+        output, finishes it "cancelled", and frees the lane for the next
+        queued request. Finished/rejected requests are a no-op."""
+        if req.state == WAITING:
+            if self.scheduler.remove(req):
+                if req.deadline is not None:
+                    self._waiting_deadlines -= 1
+                self._finish_unadmitted(req, "cancelled", smetrics.now())
+        elif req.state == ACTIVE:
+            req.cancelled = True
 
     # --------------------------------------------------------------- step
 
@@ -329,7 +470,18 @@ class ServeEngine:
         Returns the requests that FINISHED this iteration.
         """
         finished: list[Request] = []
+        now = smetrics.now()
+        if self._waiting_deadlines > 0:
+            expired = [r for r in self.scheduler.queue
+                       if r.deadline is not None and now >= r.deadline]
+            for req in expired:
+                self.scheduler.remove(req)
+                self._waiting_deadlines -= 1
+                self._finish_unadmitted(req, "timeout", now)
+                finished.append(req)
         for req in self.scheduler.pick(self.pool.n_free, self.pool.n_active):
+            if req.deadline is not None:
+                self._waiting_deadlines -= 1  # left the queue via pick
             if self._admit(req):
                 finished.append(req)  # prefill-only finish (eos/budget 1)
         if self.pool.n_active > 0:
@@ -409,12 +561,20 @@ class ServeEngine:
             chunk = None
         prompt_padded = np.zeros(padded, np.int32)
         prompt_padded[:suffix] = req.prompt[matched:]
-        ctl = np.asarray([slot, suffix, self._rng_step], np.int32)
+        samp_row, top_k, seed = encode_params(req.params)
+        need_lp = int(req.params.logprobs)
+        self._samp_f[:, slot] = samp_row
+        self._top_k[slot] = top_k
+        self._seed[slot] = seed
+        self._need_lp[slot] = need_lp
+        ctl = np.asarray(
+            [slot, suffix, self._rng_step, top_k, seed, need_lp], np.int32
+        )
         self._rng_step += 1
-        self.pool.caches, first = _prefill_program(
-            self.model, self.sampler, padded, chunk, matched, self.variables,
-            self.pool.caches, jnp.asarray(prompt_padded), jnp.asarray(ctl),
-            self._rng,
+        self.pool.caches, first, logprob = _prefill_program(
+            self.model, padded, chunk, matched, self.config.sample_cap,
+            self.variables, self.pool.caches, jnp.asarray(prompt_padded),
+            jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
         )
         first = int(first)
         if self.prefix_cache is not None:
@@ -437,25 +597,67 @@ class ServeEngine:
         now = smetrics.now()
         req.first_token_time = now
         req.tokens.append(first)
+        if req.params.logprobs:
+            req.logprobs.append(float(logprob))
         self.metrics.record_first_token(req, now, prefilled=suffix)
         self._last_emit[slot] = now
         self.pool.positions[slot] = length
         self._toks[slot] = first
         self._pos[slot] = length
         self._slot_req[slot] = req
-        if req.eos_id is not None and first == req.eos_id:
-            reason = "eos"
-        elif req.remaining == 0:
-            reason = "length"
-        else:
+        reason = self._stop_reason(req, first)
+        if reason != "eos" and self._stop_string_at(req, 0) is not None:
+            reason = "stop"  # the first token alone completed a match
+        if reason is None:
             return False
         self._finish(req, reason, now)
         return True
 
+    def _stop_reason(self, req: Request, tok: int) -> str | None:
+        """Why the just-appended token `tok` ends `req`'s stream — "eos",
+        "stop" (stop token-id set), "length", or None (keep decoding).
+        Token-level checks only; stop STRINGS are matched once per block
+        by `_stop_string_at` (a per-token full-stream decode would make
+        the dispatch-bound host loop O(n^2) in stream length)."""
+        if req.eos_id is not None and tok == req.eos_id:
+            return "eos"
+        if req.params.stop_token_ids and tok in req.params.stop_token_ids:
+            return "stop"
+        if req.remaining == 0:
+            return "length"
+        return None
+
+    def _stop_string_at(self, req: Request, start: int) -> int | None:
+        """Earliest token index >= `start` whose appended text completes a
+        stop-string match over the decoded stream, or None. ONE full
+        decode per block (matches may span block boundaries because the
+        whole generated stream is searched); the per-prefix walk to
+        locate the completing token runs only on a hit — at most once in
+        a request's lifetime, since a hit finishes it.
+
+        Deliberately NOT a bounded tail-window re-decode (the vLLM
+        trick): `detokenize` is caller-supplied and need not be
+        prefix-stable — merge-y tokenizers can rewrite text at token
+        boundaries and tokens may decode to empty strings, so a
+        fixed-token window can miss or misplace a cross-boundary match.
+        The full re-decode is exact for ANY detokenizer at one O(stream)
+        host call per block, bounded by max_len."""
+        if not req.params.stop:
+            return None
+        text = self.detokenize(req.tokens)
+        if not any(s in text for s in req.params.stop):
+            return None
+        for k in range(start, len(req.tokens)):
+            prefix = self.detokenize(req.tokens[: k + 1])
+            if any(s in prefix for s in req.params.stop):
+                return k
+        return len(req.tokens) - 1  # decode-boundary quirk: match only
+        # materializes with the full stream; attribute it to the last token
+
     def _decode_block(self) -> list[Request]:
         cfg = self.config
         block = cfg.decode_block
-        state = np.zeros((5, cfg.n_slots), np.int32)
+        state = np.zeros((9, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
         state[3] = -1
@@ -464,29 +666,60 @@ class ServeEngine:
                 state[2, slot] = 1
                 if r.eos_id is not None:
                     state[3, slot] = r.eos_id
+                # sample index of this block's first draw: the request
+                # has emitted len(tokens) so far (index 0 was prefill's)
+                state[7, slot] = len(r.tokens)
         state[4] = self._rng_step
+        state[5] = self._top_k
+        state[6] = self._seed
+        state[8] = self._need_lp
         self._rng_step += 1
-        self.pool.caches, out = _decode_program(
-            self.model, self.sampler, block, self.variables,
-            self.pool.caches, jnp.asarray(state), self._rng,
+        self.pool.caches, (out, lps) = _decode_program(
+            self.model, block, self.config.sample_cap, self.variables,
+            self.pool.caches, jnp.asarray(state),
+            jnp.asarray(self._samp_f), self._rng,
         )
         out = np.asarray(out)  # (block, n_slots); overshoot truncated below
+        lps = np.asarray(lps)
         now = smetrics.now()
         finished: list[Request] = []
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
+            if req.cancelled:
+                # lifecycle kill at the block boundary: this block's
+                # output is discarded, the lane frees for the next pick
+                self._finish(req, "cancelled", now)
+                finished.append(req)
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._finish(req, "timeout", now)
+                finished.append(req)
+                continue
             appended = 0
             reason = None
-            for t in out[:, slot]:
+            base = len(req.tokens)
+            for t, lp in zip(out[:, slot], lps[:, slot]):
                 req.tokens.append(int(t))
+                if req.params.logprobs:
+                    req.logprobs.append(float(lp))
                 appended += 1
-                if req.eos_id is not None and int(t) == req.eos_id:
-                    reason = "eos"  # tail of the block is EOS padding
-                    break
-                if req.remaining == 0:
-                    reason = "length"
-                    break
+                reason = self._stop_reason(req, int(t))
+                if reason is not None:
+                    break  # the tail of the block is discarded overshoot
+            k = self._stop_string_at(req, base)
+            if k is not None:
+                # a stop string completed at token k; it wins over a
+                # token-level reason that fired LATER in the block (and
+                # over "length" at the same token — the old per-token
+                # check order), truncating the overshoot
+                last = len(req.tokens) - 1
+                if reason is None or k < last or reason == "length":
+                    del req.tokens[k + 1:]
+                    if req.params.logprobs:
+                        del req.logprobs[k + 1:]
+                    appended -= last - k
+                    reason = "stop"
             self.metrics.record_tokens(
                 req, appended, now - self._last_emit[slot], now
             )
@@ -508,8 +741,23 @@ class ServeEngine:
         self.metrics.record_finish(req, now)
         slot = req.slot
         self._slot_req[slot] = None
-        # park the idle lane at position 0: its masked dummy writes land
-        # in slot 0, which the next prefill overwrites first
+        # park the idle lane at position 0 with greedy sampling rows: the
+        # masked dummy writes land in slot 0 (overwritten by the next
+        # prefill), and an all-greedy resting state keeps idle batches on
+        # fused_sample's sort-free fast path
         self._toks[slot] = 0
         self._pos[slot] = 0
+        self._samp_f[:, slot] = GREEDY_ROW
+        self._top_k[slot] = 0
+        self._seed[slot] = -1
+        self._need_lp[slot] = 0
         self.pool.release(slot)
+
+    def _finish_unadmitted(self, req: Request, reason: str,
+                           now: float) -> None:
+        """Finish a request that never held a lane (cancelled or timed
+        out while still waiting in the queue)."""
+        req.state = FINISHED
+        req.finish_reason = reason
+        req.finish_time = now
+        self.metrics.record_finish(req, now)

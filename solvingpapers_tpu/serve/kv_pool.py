@@ -62,9 +62,9 @@ def store_lane(caches, lane, slot):
     """Write a batch-1 lane back into the pooled caches at `slot` (traced).
     Dtypes must match exactly — see `_require_same_dtype`."""
 
-    def upd(a, l):
-        _require_same_dtype(a, l, "store_lane")
-        return jax.lax.dynamic_update_slice_in_dim(a, l, slot, axis=0)
+    def upd(a, lane_leaf):
+        _require_same_dtype(a, lane_leaf, "store_lane")
+        return jax.lax.dynamic_update_slice_in_dim(a, lane_leaf, slot, axis=0)
 
     return jax.tree_util.tree_map(upd, caches, lane)
 

@@ -13,6 +13,9 @@ console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
     serve/slot_occupancy     mean fraction of slots decoding, per iteration
     serve/tokens_prefilled   prompt tokens the engine actually prefilled
                              (excludes prefix-cache-spliced tokens)
+    serve/finish_<reason>    finished requests by lifecycle outcome
+                             (eos / length / stop / cancelled / timeout —
+                             see serve/scheduler.py Request.finish_reason)
 
 Prefix-cache counters (serve/prefix_cache.py; present when the engine's
 prefix cache is on):
@@ -46,6 +49,7 @@ class ServeMetrics:
         self.prefill_tokens = 0
         self.requests_finished = 0
         self.requests_rejected = 0
+        self.finish_reasons: dict[str, int] = {}
         self.steps = 0
         self.prefix_lookups = 0
         self.prefix_hits = 0
@@ -98,6 +102,8 @@ class ServeMetrics:
     def record_finish(self, req, now: float) -> None:
         self._touch(now)
         self.requests_finished += 1
+        reason = req.finish_reason or "unknown"
+        self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
 
     def record_step(self, occupancy: float) -> None:
         self.steps += 1
@@ -125,6 +131,8 @@ class ServeMetrics:
             "serve/requests_rejected": float(self.requests_rejected),
             "serve/steps": float(self.steps),
         }
+        for reason in sorted(self.finish_reasons):
+            out[f"serve/finish_{reason}"] = float(self.finish_reasons[reason])
         if self.prefix_lookups:
             out["serve/prefix_lookups"] = float(self.prefix_lookups)
             out["serve/prefix_hits"] = float(self.prefix_hits)

@@ -1,0 +1,296 @@
+"""Per-request sampling for the serving engine.
+
+PRs 1–2 decoded every slot with one engine-wide static sampler
+(`ops.sample_greedy` baked into the jitted programs as a static arg).
+Real traffic wants vLLM-style `SamplingParams` attached to each request:
+one user greedy, the next temperature-1.2/top-p-0.9, both inside the same
+vmapped decode block. This module provides
+
+* `SamplingParams` — the per-request knobs (validated at construction, so
+  a bad request fails at `submit` instead of inside a traced program);
+* `encode_params` — the host-side packing of one request's knobs into the
+  engine's slot-major struct-of-arrays mirrors (float row triple + int
+  top_k/seed pair), shipped to the device as packed control arrays
+  exactly like the engine's existing decode-state block: one transfer per
+  jitted call, never a static arg;
+* `fused_sample` — one vectorized sampler applied to the whole slot axis
+  inside `_prefill_program`/`_decode_program`: ONE `lax.top_k` gathers
+  the `cap` most likely tokens per slot (static cap, a ServeConfig
+  knob), then temperature scaling and the shared sort-based
+  `ops.top_k_mask`/`top_p_mask`/`min_p_mask` truncations run in that
+  (S, cap) domain (the SAME masking code the one-shot `ops.sample_top_p`
+  etc. use — no duplicate logic), then per-slot categorical draws map
+  back through the gathered indices. The cap bounds the per-step
+  sampling cost at O(V) selection + O(cap log cap) masking instead of
+  full-vocab sorts — on a 50k-vocab model inside the decode scan,
+  XLA:CPU full-vocab sorts cost ~100x the whole forward pass, which is
+  why bounded-support sampling is the only shape that keeps the mixed
+  batch within the greedy arm's budget. Two runtime `lax.cond` fast
+  paths keep the rest free: an all-greedy batch skips the selection and
+  masking entirely, and the full-vocab log-softmax runs only when some
+  active request asked for logprobs;
+* `slot_keys` — per-slot rng derivation. Seeded requests fold
+  ``(seed, sample_index)`` into the engine's base key: the chain depends
+  only on the request, NOT on which slot it landed in or how many engine
+  iterations ran first, so a fixed-seed stream is reproducible
+  run-to-run under any interleaving. Unseeded requests fold the engine's
+  step counter + slot instead (fresh entropy, no reproducibility
+  contract).
+
+Compiled-program inventory is unchanged from the static-sampler engine:
+every knob enters as a traced array operand, so a greedy engine and a
+mixed stochastic engine share the same compiled decode program
+(tests/test_serve_sampling.py pins the jit cache size).
+
+Determinism contract:
+* temperature == 0.0 means greedy: the slot takes ``argmax(logits)`` and
+  is token-exact with solo greedy `generate`, regardless of what the
+  other slots in the batch are doing (the per-slot forward is batch-1
+  under vmap, and masking/sampling are per-row).
+* a request with ``seed=s`` draws from a chain keyed by
+  ``(engine base key, s, sample index)`` only — two engine runs with the
+  same `ServeConfig.seed` replay the same stream.
+* stochastic draws land inside the top ``ServeConfig.sample_cap`` logits
+  (bounded-support sampling; ``top_k`` must fit under the cap — submit
+  rejects larger values). With cap >= vocab the support is exact; below
+  it, top-p/min-p masses are computed over the capped support's
+  renormalized distribution, a truncation that is negligible for
+  trained LMs at practical caps and is the price of CPU-viable
+  per-step sampling.
+* `logprobs` reports the log-softmax of the model's RAW logits at the
+  chosen token (the model's own distribution — independent of
+  temperature/truncation, well-defined for greedy too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import operator
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from solvingpapers_tpu import ops
+
+# fold-in tags separating the seeded per-request rng chain from the
+# engine-step chain (both start from the engine's base key). BOTH chains
+# lead with their own constant tag: if only the seeded chain were tagged,
+# the unseeded chain's leading fold would be the engine step counter,
+# which EQUALS the tag after ~0x5EED engine iterations — at that point an
+# unseeded slot s would replay the exact draw stream of a seed=s request.
+_SEED_TAG = 0x5EED
+_STEP_TAG = 0x57E9
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling + termination knobs (vLLM-style).
+
+    temperature   0.0 = greedy argmax (the default; token-exact with solo
+                  greedy `generate`); > 0 scales logits before sampling.
+    top_k         keep only the k most likely tokens (0 = disabled).
+    top_p         nucleus sampling: keep the smallest token set with
+                  cumulative probability >= top_p (1.0 = disabled).
+    min_p         drop tokens below ``min_p * max token probability``
+                  (0.0 = disabled).
+    seed          rng seed for a reproducible stream (None = engine
+                  entropy, not reproducible run-to-run).
+    max_tokens    generation budget; overrides `submit`'s
+                  max_new_tokens when set.
+    stop_token_ids  finishing token ids beyond the request's `eos_id`
+                  (a multi-token EOS set); matched host-side, the
+                  matching token is kept in the stream, finish reason
+                  "stop".
+    stop          stop strings, matched host-side against the decoded
+                  generated text (the engine needs a `detokenize`
+                  callable); the stream ends at the token that completes
+                  the first match, finish reason "stop". A match may
+                  span decode-block boundaries.
+    logprobs      when True, the chosen token's log-softmax under the
+                  model's raw logits is streamed into
+                  `Request.logprobs`, one entry per generated token.
+    """
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    seed: int | None = None
+    max_tokens: int | None = None
+    stop_token_ids: tuple[int, ...] = ()
+    stop: tuple[str, ...] = ()
+    logprobs: bool = False
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature}"
+            )
+        if not 0 < self.top_p <= 1:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if not 0 <= self.min_p <= 1:
+            raise ValueError(f"min_p must be in [0, 1], got {self.min_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got {self.top_k}")
+        if self.seed is not None and not 0 <= self.seed < 2**31:
+            # the seed rides the engine's int32 control mirrors: negative
+            # values collide with the -1 "unseeded" sentinel and >= 2**31
+            # would overflow the packed array (crashing the shared engine
+            # loop under numpy 2.x, silently wrapping under 1.x)
+            raise ValueError(
+                f"seed must be None or in [0, 2**31), got {self.seed}"
+            )
+        if self.max_tokens is not None and self.max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {self.max_tokens}")
+        # normalize: a lone string is a single stop string, not chars
+        stop = (self.stop,) if isinstance(self.stop, str) else tuple(self.stop)
+        if any(not s for s in stop):
+            raise ValueError("stop strings must be non-empty")
+        object.__setattr__(self, "stop", stop)
+        ids = self.stop_token_ids
+        try:
+            ids = (operator.index(ids),)  # a lone id, like a lone string
+        except TypeError:
+            pass
+        try:
+            # operator.index keeps the ValueError-at-construction contract:
+            # int(50256.9) would silently stop on the WRONG token id
+            ids = tuple(operator.index(t) for t in ids)
+        except TypeError:
+            raise ValueError(
+                f"stop_token_ids must be integer token ids, got {ids!r}"
+            ) from None
+        object.__setattr__(self, "stop_token_ids", ids)
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+GREEDY = SamplingParams()
+
+# slot-major float mirror rows (engine's `_samp_f`): temperature, top_p,
+# min_p — the greedy/disabled resting state of a free lane
+GREEDY_ROW = (0.0, 1.0, 0.0)
+
+
+def encode_params(p: SamplingParams):
+    """One request's knobs -> ((temperature, top_p, min_p) float row,
+    top_k, seed) for the engine's slot-major device mirrors; seed -1
+    means unseeded (engine entropy)."""
+    seed = -1 if p.seed is None else int(p.seed)
+    return (p.temperature, p.top_p, p.min_p), int(p.top_k), seed
+
+
+class PackedSampling(NamedTuple):
+    """Slot-major struct-of-arrays view of every active request's params,
+    built inside the jitted programs from the packed control operands
+    (float rows + int rows) — all traced, never static."""
+
+    temperature: jax.Array  # (S,) f32; 0 => greedy
+    top_p: jax.Array        # (S,) f32; 1 => disabled
+    min_p: jax.Array        # (S,) f32; 0 => disabled
+    top_k: jax.Array        # (S,) i32; 0 => disabled
+    need_lp: jax.Array      # (S,) i32; 1 => stream chosen-token logprobs
+
+
+def request_key(base, step_tag, slot, seed, samp_idx):
+    """Per-slot sampling key (traced; vmap-able over the slot axis).
+
+    ``seed >= 0``: fold (seed tag, seed, sample index) into `base` — a
+    chain that depends only on the request, reproducible across runs and
+    slot assignments. ``seed < 0``: fold (step tag, engine step, slot,
+    sample index) — decorrelated fresh entropy per emission. The two
+    chains lead with DISTINCT constant tags so no engine-step value can
+    alias the seeded domain (see the tag comment above).
+    """
+    seeded = jax.random.fold_in(
+        jax.random.fold_in(jax.random.fold_in(base, _SEED_TAG), seed),
+        samp_idx,
+    )
+    unseeded = jax.random.fold_in(
+        jax.random.fold_in(
+            jax.random.fold_in(jax.random.fold_in(base, _STEP_TAG),
+                               step_tag),
+            slot,
+        ),
+        samp_idx,
+    )
+    return jax.random.wrap_key_data(
+        jnp.where(
+            seed >= 0,
+            jax.random.key_data(seeded),
+            jax.random.key_data(unseeded),
+        )
+    )
+
+
+def slot_keys(base, step_tag, seeds, samp_idx):
+    """(S,) sampling keys for one decode step: `request_key` vmapped over
+    the slot axis (`seeds`/`samp_idx` are the packed (S,) i32 rows)."""
+    slots = jnp.arange(seeds.shape[0], dtype=jnp.int32)
+    return jax.vmap(
+        lambda slot, seed, idx: request_key(base, step_tag, slot, seed, idx)
+    )(slots, seeds, samp_idx)
+
+
+def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64):
+    """Sample one token per slot under per-slot params; returns
+    ``(tokens (S,) i32, logprobs (S,) f32)``.
+
+    `logits` is (S, vocab); `rngs` is (S,) typed keys (from `slot_keys`);
+    `cap` is the STATIC support bound (ServeConfig.sample_cap, clamped to
+    the vocab). Greedy rows (temperature 0) take argmax of the raw
+    logits. Stochastic rows draw from the top-`cap` logits: one
+    `lax.top_k` selection, then temperature scaling and the shared
+    `ops.top_k_mask`/`top_p_mask`/`min_p_mask` truncations (all cutoffs
+    traced, per-row) in the small (S, cap) domain, then per-slot
+    categorical draws mapped back through the gathered indices. The
+    returned logprob is the log-softmax of the RAW full-vocab logits at
+    the chosen token, or 0 where `need_lp` is unset.
+
+    Runtime `lax.cond` fast paths: an all-greedy batch runs argmax only
+    (no selection, no masking — the cost of the old static greedy
+    sampler), and the full-vocab log-softmax runs only when some slot
+    wants logprobs. Full-vocab sorts would be correct but are ~100x the
+    model forward on XLA:CPU inside the decode scan — the cap is what
+    makes a mixed batch affordable (see the module docstring for the
+    semantics of the truncation).
+    """
+    cap = min(cap, logits.shape[-1])
+    greedy = packed.temperature <= 0.0
+    # one f32 cast up front: selection/reduction ops over bf16 are
+    # scalar-emulated on XLA:CPU (a bf16 top_k here measured ~27x the f32
+    # one — slower than the whole model forward)
+    logits32 = logits.astype(jnp.float32)
+
+    def _all_greedy():
+        return jnp.argmax(logits32, axis=-1).astype(jnp.int32)
+
+    def _mixed():
+        top_vals, top_idx = jax.lax.top_k(logits32, cap)  # sorted desc
+        temp = jnp.where(greedy, 1.0, packed.temperature)[:, None]
+        scaled = top_vals / temp
+        masked = ops.top_k_mask(scaled, packed.top_k[:, None])
+        masked = ops.top_p_mask(masked, packed.top_p[:, None])
+        masked = ops.min_p_mask(masked, packed.min_p[:, None])
+        sel = jax.vmap(
+            lambda row, key: jax.random.categorical(key, row)
+        )(masked, rngs)
+        drawn = jnp.take_along_axis(top_idx, sel[:, None], axis=-1)[:, 0]
+        return jnp.where(greedy, _all_greedy(), drawn.astype(jnp.int32))
+
+    toks = jax.lax.cond(jnp.all(greedy), _all_greedy, _mixed)
+
+    def _logprobs():
+        chosen = jnp.take_along_axis(logits32, toks[:, None], axis=-1)[:, 0]
+        lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+        return chosen - lse
+
+    logprobs = jax.lax.cond(
+        jnp.any(packed.need_lp > 0), _logprobs,
+        lambda: jnp.zeros(toks.shape, jnp.float32),
+    )
+    return toks, logprobs

@@ -34,6 +34,7 @@ from collections import deque
 import numpy as np
 
 from solvingpapers_tpu.serve import metrics as smetrics
+from solvingpapers_tpu.serve.sampling import GREEDY, SamplingParams
 
 _ids = itertools.count()
 
@@ -51,20 +52,38 @@ class Request:
     elementwise and raise on mixed lengths (e.g. inside deque.remove).
 
     `tokens` is the output stream: generated ids appended as the engine
-    produces them, ending with the request's `eos_id` when it stopped on
-    EOS (`finish_reason == "eos"`) or after `max_new_tokens` ids
-    (`finish_reason == "length"`).
+    produces them. `finish_reason` says why it ended:
+
+        eos        the request's `eos_id` was emitted (kept in the stream)
+        length     `max_new_tokens` (or `params.max_tokens`) ids emitted
+        stop       a `params.stop_token_ids` id or `params.stop` string
+                   matched (the matching token is kept in the stream)
+        cancelled  `engine.cancel(request)` — a waiting request finishes
+                   immediately, an active one at the next block boundary
+        timeout    the request's deadline passed (waiting requests are
+                   purged from the queue; active ones freed at the next
+                   block boundary, the expired block's tokens discarded)
+
+    `params` is the request's `SamplingParams`; `logprobs` streams the
+    chosen-token logprob per generated token when `params.logprobs`.
     """
 
     prompt: np.ndarray
     max_new_tokens: int
     eos_id: int | None
+    params: SamplingParams = GREEDY
     id: int = dataclasses.field(default_factory=lambda: next(_ids))
     state: str = WAITING
     tokens: list[int] = dataclasses.field(default_factory=list)
+    logprobs: list[float] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
     slot: int | None = None
     waited_steps: int = 0
+    # absolute deadline on the engine clock (serve.metrics.now), or None
+    deadline: float | None = None
+    # set by engine.cancel on an ACTIVE request; the lane is freed (and
+    # the request finished "cancelled") at the next block boundary
+    cancelled: bool = False
     # memoized cached-prefix match length for prefix-aware scheduling:
     # computed once at first pick() (a per-request tree walk per iteration
     # would burden the dispatch-bound host loop). Slightly stale by design
@@ -152,6 +171,15 @@ class FIFOScheduler:
         taken = {id(r) for r in picked}
         self.queue = deque(r for r in self.queue if id(r) not in taken)
         return picked
+
+    def remove(self, req: Request) -> bool:
+        """Drop a waiting request from the queue (identity match — the
+        engine's cancel/deadline paths); False if it was not queued."""
+        try:
+            self.queue.remove(req)
+            return True
+        except ValueError:
+            return False
 
     def tick(self) -> None:
         """One engine iteration elapsed for everything still queued."""

@@ -221,3 +221,86 @@ def test_samplers():
     # categorical at tiny temperature is effectively greedy
     tok2 = ops.sample_categorical(logits, rng, temperature=1e-4)
     assert int(tok2[0]) == 1
+
+
+def test_sample_top_p_disabled_equals_categorical():
+    """top_p=1.0 keeps every token, so the draw is bit-identical to plain
+    categorical sampling under the same key (the mask is a no-op and the
+    gumbel noise is the same shape)."""
+    logits = jax.random.normal(jax.random.key(5), (3, 32))
+    for i in range(8):
+        rng = jax.random.key(100 + i)
+        want = ops.sample_categorical(logits, rng)
+        got = ops.sample_top_p(logits, rng, p=1.0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_sample_min_p_disabled_equals_categorical():
+    logits = jax.random.normal(jax.random.key(6), (3, 32))
+    for i in range(8):
+        rng = jax.random.key(200 + i)
+        want = ops.sample_categorical(logits, rng)
+        got = ops.sample_min_p(logits, rng, min_p=0.0)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_top_k_one_is_greedy():
+    """k=1 truncation leaves only the argmax, so any draw is greedy —
+    both through the static sample_top_k and the traced top_k_mask."""
+    logits = jax.random.normal(jax.random.key(7), (4, 32))
+    want = np.asarray(ops.sample_greedy(logits))
+    for i in range(8):
+        rng = jax.random.key(300 + i)
+        np.testing.assert_array_equal(
+            np.asarray(ops.sample_top_k(logits, rng, k=1)), want
+        )
+        masked = ops.top_k_mask(logits, jnp.ones((4, 1), jnp.int32))
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.categorical(rng, masked, axis=-1)), want
+        )
+
+
+def test_top_p_mass_cutoff_on_handbuilt_distribution():
+    """Hand-built distribution [0.5, 0.3, 0.15, 0.05]: p=0.7 keeps the
+    smallest prefix reaching 0.7 = {0, 1} (token 1 crosses the boundary
+    and is kept); p=0.81 pulls in token 2; every draw stays inside the
+    nucleus."""
+    probs = np.array([0.5, 0.3, 0.15, 0.05])
+    logits = jnp.log(jnp.asarray(probs))[None, :]
+    masked = np.asarray(ops.top_p_mask(logits, 0.7))[0]
+    assert np.isfinite(masked[:2]).all() and np.isinf(masked[2:]).all()
+    masked = np.asarray(ops.top_p_mask(logits, 0.81))[0]
+    assert np.isfinite(masked[:3]).all() and np.isinf(masked[3:]).all()
+    draws = {
+        int(ops.sample_top_p(logits, jax.random.key(i), p=0.7)[0])
+        for i in range(64)
+    }
+    assert draws <= {0, 1} and len(draws) == 2
+
+
+def test_min_p_cutoff_on_handbuilt_distribution():
+    """min_p=0.35 with max prob 0.5 sets the floor at 0.175: keeps
+    {0.5, 0.3}, drops {0.15, 0.05}."""
+    probs = np.array([0.5, 0.3, 0.15, 0.05])
+    logits = jnp.log(jnp.asarray(probs))[None, :]
+    masked = np.asarray(ops.min_p_mask(logits, 0.35))[0]
+    assert np.isfinite(masked[:2]).all() and np.isinf(masked[2:]).all()
+    draws = {
+        int(ops.sample_min_p(logits, jax.random.key(i), min_p=0.35)[0])
+        for i in range(64)
+    }
+    assert draws <= {0, 1} and len(draws) == 2
+
+
+def test_truncation_masks_accept_per_row_traced_cutoffs():
+    """The serve path's requirement: one (S, V) logits block, DIFFERENT
+    k/p/min_p per row, all traced — row 0 disabled, row 1 truncated."""
+    logits = jnp.stack([jnp.arange(8.0), jnp.arange(8.0)])
+    k = jnp.asarray([[0], [2]], jnp.int32)
+    masked = np.asarray(ops.top_k_mask(logits, k))
+    assert np.isfinite(masked[0]).all()
+    assert np.isinf(masked[1][:6]).all() and np.isfinite(masked[1][6:]).all()
+    p = jnp.asarray([[1.0], [1e-6]])
+    masked = np.asarray(ops.top_p_mask(logits, p))
+    assert np.isfinite(masked[0]).all()
+    assert np.isfinite(masked[1]).sum() == 1  # only the top token survives

@@ -175,6 +175,62 @@ def test_submit_validates_capacity(gpt_tiny):
         eng.submit(np.zeros(0, np.int32))
 
 
+def test_submit_validates_prompt_dtype_and_shape(gpt_tiny):
+    """Bad prompts raise host-side at submit, never inside a traced
+    program: float dtypes (silent truncation hazard), non-1-D shapes,
+    non-positive budgets and deadlines."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(n_slots=1, max_len=32))
+    with pytest.raises(ValueError, match="integer token ids"):
+        eng.submit(np.asarray([1.0, 2.5, 3.0]), max_new_tokens=4)
+    with pytest.raises(ValueError, match="must be 1-D"):
+        eng.submit(np.zeros((2, 4), np.int32), max_new_tokens=4)
+    with pytest.raises(ValueError, match="must be 1-D"):
+        eng.submit(np.int32(3), max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens must be >= 1"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=0)
+    with pytest.raises(ValueError, match="deadline_s must be > 0"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=4,
+                   deadline_s=0.0)
+    # a python list of ints is still fine (integer-kind after asarray)
+    h = eng.submit([1, 2, 3], max_new_tokens=4)
+    assert h.prompt.dtype == np.int32
+
+
+def test_submit_rejects_bad_sampling_params(gpt_tiny):
+    """SamplingParams validates at construction (so the error carries the
+    bad field, not a trace-time shape error), and stop strings demand a
+    detokenizer."""
+    from solvingpapers_tpu.serve import SamplingParams
+
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(n_slots=1, max_len=32))
+    for bad in (
+        dict(temperature=-0.5),
+        dict(top_p=0.0),
+        dict(top_p=1.5),
+        dict(min_p=1.0001),
+        dict(top_k=-1),
+        dict(seed=-3),
+        dict(seed=2**31),  # must fit the engine's int32 control mirrors
+        dict(max_tokens=0),
+        dict(stop=("",)),
+        dict(stop_token_ids=(50256.9,)),  # int() would stop on wrong id
+        dict(stop_token_ids="abc"),
+    ):
+        with pytest.raises(ValueError):
+            SamplingParams(**bad)
+    # a lone id normalizes like a lone stop string does
+    assert SamplingParams(stop_token_ids=7).stop_token_ids == (7,)
+    with pytest.raises(ValueError, match="detokenize"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=4,
+                   params=SamplingParams(stop=("xy",)))
+    # max_tokens overrides the submit budget and still checks capacity
+    with pytest.raises(ValueError, match="exceeds the engine capacity"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=1,
+                   params=SamplingParams(max_tokens=64))
+
+
 def test_admission_control_rejects_beyond_queue(gpt_tiny):
     model, params = gpt_tiny
     eng = ServeEngine(model, params, ServeConfig(

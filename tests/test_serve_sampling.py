@@ -1,0 +1,368 @@
+"""Per-request sampling + request lifecycle (solvingpapers_tpu/serve/).
+
+The contracts under test (serve/sampling.py, engine integration):
+
+* mixed-batch determinism — a greedy-params request decoded alongside
+  stochastic slots is token-exact with solo one-shot `generate`, and a
+  fixed-seed stochastic request replays the same stream across two
+  engine runs (its rng chain folds only (seed, sample index), never the
+  slot or engine step), for the gpt AND llama3 families;
+* no compile explosion — sampling params are traced operands, so a mixed
+  stochastic engine adds ZERO compiled prefill/decode programs over a
+  greedy one (pinned via the jit caches);
+* lifecycle — cancel mid-stream frees the lane for a waiting request,
+  deadlines expire waiting AND active requests ("timeout"), stop strings
+  match across block boundaries, stop token-id sets act as multi-token
+  EOS ("stop"), and finish reasons are counted in ServeMetrics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.infer import generate
+from solvingpapers_tpu.serve import SamplingParams, ServeConfig, ServeEngine
+from solvingpapers_tpu.serve import metrics as smetrics
+from solvingpapers_tpu.serve.engine import _decode_program, _prefill_program
+
+
+def _gpt_tiny():
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _llama3_tiny():
+    from solvingpapers_tpu.models.llama3 import Llama, LlamaConfig
+
+    model = Llama(LlamaConfig(vocab_size=64, max_seq_len=64, dim=32,
+                              n_layers=2, n_heads=4, n_kv_heads=2,
+                              dropout=0.0))
+    params = model.init({"params": jax.random.key(1)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+_FAMILIES = {"gpt": _gpt_tiny, "llama3": _llama3_tiny}
+
+
+def _prompts(n, seed=0, lo=4, hi=16):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, size=int(rng.integers(lo, hi)))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _ref_stream(model, params, prompt, max_new):
+    out = generate(model, params, jnp.asarray(prompt)[None, :],
+                   jax.random.key(0), max_new_tokens=max_new)
+    return np.asarray(out[0, len(prompt):]).tolist()
+
+
+# ------------------------------------------------- mixed-batch determinism
+
+
+@pytest.mark.parametrize("family", sorted(_FAMILIES))
+def test_greedy_in_mixed_batch_exact_and_seeded_reproducible(family):
+    """One greedy + two stochastic (seeded) requests share every decode
+    block. The greedy stream must equal solo generate; the seeded streams
+    must replay identically on a fresh engine."""
+    model, params = _FAMILIES[family]()
+    prompts = _prompts(3, seed=3)
+
+    def run():
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=3, max_len=64, decode_block=4, bucket=8,
+        ))
+        handles = [
+            eng.submit(prompts[0], max_new_tokens=10),
+            eng.submit(prompts[1], max_new_tokens=10, params=SamplingParams(
+                temperature=1.2, top_p=0.9, seed=7)),
+            eng.submit(prompts[2], max_new_tokens=10, params=SamplingParams(
+                temperature=0.8, top_k=8, min_p=0.02, seed=11)),
+        ]
+        eng.run()
+        return handles
+
+    a, b = run(), run()
+    assert all(h.done for h in a)
+    assert a[0].tokens == _ref_stream(model, params, prompts[0], 10), (
+        f"{family}: greedy request diverged inside the stochastic batch"
+    )
+    assert a[1].tokens == b[1].tokens, f"{family}: seed=7 stream not stable"
+    assert a[2].tokens == b[2].tokens, f"{family}: seed=11 stream not stable"
+
+
+def test_seeded_stream_independent_of_batch_composition():
+    """The seeded chain folds (seed, sample index) only: the same seeded
+    request must replay the same stream whether it shares the engine with
+    other traffic or runs alone (different slot, different step counters)."""
+    model, params = _gpt_tiny()
+    prompt = _prompts(1, seed=9)[0]
+    sp = SamplingParams(temperature=1.1, top_p=0.95, seed=42)
+
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8,
+    ))
+    filler = eng.submit(_prompts(1, seed=10)[0], max_new_tokens=6)
+    eng.step()  # filler decodes first: the seeded req lands in slot 1 later
+    h_batched = eng.submit(prompt, max_new_tokens=8, params=sp)
+    eng.run()
+    assert filler.done
+
+    solo = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8,
+    ))
+    h_solo = solo.submit(prompt, max_new_tokens=8, params=sp)
+    solo.run()
+    assert h_batched.tokens == h_solo.tokens
+
+
+def test_no_compile_explosion_from_param_mix():
+    """Sampling params are traced operands: a mixed stochastic engine
+    must add ZERO compiled decode/prefill programs over a greedy-only
+    engine with the same shapes."""
+    model, params = _gpt_tiny()
+    prompts = _prompts(4, seed=5, lo=4, hi=8)  # one bucket
+    cfg = ServeConfig(n_slots=2, max_len=64, decode_block=4, bucket=8)
+
+    eng = ServeEngine(model, params, cfg)
+    for p in prompts:
+        eng.submit(p, max_new_tokens=6)
+    eng.run()
+    decode_progs = _decode_program._cache_size()
+    prefill_progs = _prefill_program._cache_size()
+
+    eng = ServeEngine(model, params, cfg)
+    mixes = (
+        None,
+        SamplingParams(temperature=1.3, top_p=0.8, seed=1),
+        SamplingParams(temperature=0.7, top_k=5),
+        SamplingParams(temperature=1.0, min_p=0.1, seed=2, logprobs=True),
+    )
+    for p, sp in zip(prompts, mixes):
+        eng.submit(p, max_new_tokens=6, params=sp)
+    eng.run()
+    assert _decode_program._cache_size() == decode_progs
+    assert _prefill_program._cache_size() == prefill_progs
+
+
+def test_logprobs_stream_per_token_and_reproducible():
+    model, params = _gpt_tiny()
+    prompt = _prompts(1, seed=6)[0]
+
+    def run(sp):
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=1, max_len=64, decode_block=4, bucket=8,
+        ))
+        h = eng.submit(prompt, max_new_tokens=7, params=sp)
+        eng.run()
+        return h
+
+    g = run(SamplingParams(logprobs=True))
+    assert len(g.logprobs) == len(g.tokens) == 7
+    assert all(np.isfinite(lp) and lp <= 0 for lp in g.logprobs)
+    s1 = run(SamplingParams(temperature=1.2, seed=3, logprobs=True))
+    s2 = run(SamplingParams(temperature=1.2, seed=3, logprobs=True))
+    assert s1.logprobs == s2.logprobs and len(s1.logprobs) == 7
+    # logprobs off: nothing accumulates
+    off = run(SamplingParams(temperature=1.2, seed=3))
+    assert off.logprobs == [] and off.tokens == s1.tokens
+
+
+def test_params_max_tokens_overrides_submit_budget():
+    model, params = _gpt_tiny()
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    h = eng.submit(_prompts(1)[0], max_new_tokens=20,
+                   params=SamplingParams(max_tokens=3))
+    eng.run()
+    assert h.finish_reason == "length" and len(h.tokens) == 3
+
+
+# --------------------------------------------------------------- lifecycle
+
+
+def test_cancel_mid_stream_frees_lane_for_waiting_request():
+    """Cancel an ACTIVE request: it finishes "cancelled" at the next
+    block boundary and its lane is re-acquired by the queued request
+    (which must still produce an exact greedy stream)."""
+    model, params = _gpt_tiny()
+    prompts = _prompts(2, seed=12, lo=6, hi=10)
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    h1 = eng.submit(prompts[0], max_new_tokens=30)
+    h2 = eng.submit(prompts[1], max_new_tokens=6)
+    eng.step()
+    assert h1.state == "active" and not h1.done
+    emitted = len(h1.tokens)
+    eng.cancel(h1)
+    eng.run()
+    assert h1.finish_reason == "cancelled" and h1.done
+    assert len(h1.tokens) == emitted  # the cancelled block was discarded
+    assert h2.done and h2.finish_reason == "length"
+    assert h2.slot == h1.slot, "cancel never freed the lane"
+    assert h2.tokens == _ref_stream(model, params, prompts[1], 6)
+    assert eng.metrics.finish_reasons == {"cancelled": 1, "length": 1}
+
+
+def test_cancel_waiting_request_leaves_queue_immediately():
+    model, params = _gpt_tiny()
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    h1 = eng.submit(_prompts(1, seed=1)[0], max_new_tokens=8)
+    h2 = eng.submit(_prompts(1, seed=2)[0], max_new_tokens=8)
+    eng.cancel(h2)
+    assert h2.done and h2.finish_reason == "cancelled" and h2.tokens == []
+    assert list(eng.scheduler.queue) == [h1]  # h1 still waits its turn
+    eng.run()
+    assert h1.done and h1.finish_reason == "length"
+    # cancelling a finished request is a harmless no-op
+    eng.cancel(h1)
+    assert h1.finish_reason == "length"
+
+
+def test_deadline_expiry_mid_decode_frees_lane(monkeypatch):
+    """Drive the engine clock by hand: a request whose deadline passes
+    between decode blocks finishes "timeout" at the boundary, the
+    expired block's tokens are discarded, and the lane goes to the next
+    queued request."""
+    model, params = _gpt_tiny()
+    clock = {"t": 100.0}
+    monkeypatch.setattr(smetrics, "now", lambda: clock["t"])
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    h1 = eng.submit(_prompts(1, seed=20)[0], max_new_tokens=30,
+                    deadline_s=5.0)
+    h2 = eng.submit(_prompts(1, seed=21)[0], max_new_tokens=4)
+    eng.step()  # admit + first block, well inside the deadline
+    assert h1.state == "active"
+    emitted = len(h1.tokens)
+    clock["t"] = 120.0  # past the deadline
+    eng.run()
+    assert h1.finish_reason == "timeout" and len(h1.tokens) == emitted
+    assert h2.done and h2.finish_reason == "length"
+    assert h2.slot == h1.slot
+
+
+def test_deadline_expiry_while_waiting_purges_queue(monkeypatch):
+    model, params = _gpt_tiny()
+    clock = {"t": 0.0}
+    monkeypatch.setattr(smetrics, "now", lambda: clock["t"])
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    active = eng.submit(_prompts(1, seed=22)[0], max_new_tokens=12)
+    starved = eng.submit(_prompts(1, seed=23)[0], max_new_tokens=4,
+                         deadline_s=2.0)
+    eng.step()
+    clock["t"] = 10.0
+    eng.run()
+    assert starved.finish_reason == "timeout"
+    assert starved.tokens == [] and starved.slot is None
+    assert active.done and active.finish_reason == "length"
+
+
+def test_stop_string_spanning_block_boundary():
+    """A stop string whose match completes with the first token of a NEW
+    decode block must still end the stream (host-side matching re-decodes
+    the whole generated text, so matches span boundaries)."""
+    model, params = _gpt_tiny()
+    block = 4
+
+    def detok(ids):
+        return "".join(f"<{t}>" for t in ids)
+
+    # deterministic reference: a seeded stochastic stream (diverse tokens,
+    # unlike tiny-model greedy streams which often repeat one id)
+    sp = SamplingParams(temperature=1.3, seed=17)
+    ref_eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=block, bucket=8,
+    ))
+    prompt = _prompts(1, seed=30, lo=6, hi=7)[0]
+    ref = ref_eng.submit(prompt, max_new_tokens=12, params=sp)
+    ref_eng.run()
+    text = detok(ref.tokens)
+    # tokens[0] is prefill's; block 1 appends [1..block] — so index
+    # `block` is a block's last token and `block+1` opens the next block
+    spans = [i for i in (block, 2 * block)
+             if i + 1 < len(ref.tokens)
+             and text.find(detok(ref.tokens[i:i + 2])) ==
+             len(detok(ref.tokens[:i]))]
+    assert spans, "seeded stream never gave a boundary-spanning unique pair"
+    i = spans[0]
+    stop = detok(ref.tokens[i:i + 2])
+
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=block, bucket=8,
+    ), detokenize=detok)
+    h = eng.submit(prompt, max_new_tokens=12, params=SamplingParams(
+        temperature=1.3, seed=17, stop=(stop,)))
+    eng.run()
+    assert h.finish_reason == "stop"
+    assert h.tokens == ref.tokens[:i + 2], (
+        "stream must end at the token completing the cross-boundary match"
+    )
+
+
+def test_stop_token_id_set_acts_as_multi_token_eos():
+    """`stop_token_ids` is a per-request multi-token EOS set: the first
+    emitted member ends the stream (kept, reason "stop") — and different
+    requests can carry different sets in the same batch. Seeded
+    stochastic references give diverse streams (tiny-model greedy streams
+    often repeat one id, which would make the cut index degenerate)."""
+    model, params = _gpt_tiny()
+    prompts = _prompts(2, seed=31, lo=6, hi=10)
+    base = [SamplingParams(temperature=1.25, seed=50 + j) for j in range(2)]
+
+    def run(extra_ids):
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=2, max_len=64, decode_block=4, bucket=8,
+        ))
+        handles = [
+            eng.submit(prompts[j], max_new_tokens=12,
+                       params=SamplingParams(
+                           temperature=base[j].temperature,
+                           seed=base[j].seed,
+                           stop_token_ids=extra_ids[j]))
+            for j in range(2)
+        ]
+        eng.run()
+        return handles
+
+    refs = [h.tokens for h in run(((), ()))]
+    # stop on a token first emitted at index >= 2, per request
+    cut, ids = [], []
+    for r in refs:
+        k = next(i for i in range(2, len(r)) if r[i] not in r[:i])
+        cut.append(k)
+        ids.append((int(r[k]), 4095))  # 4095: never-sampled extra member
+    handles = run(tuple(ids))
+    for j, h in enumerate(handles):
+        assert h.finish_reason == "stop"
+        assert h.tokens == refs[j][:cut[j] + 1]
+        assert h.tokens[-1] in h.params.stop_token_ids
+
+
+def test_stop_reason_at_prefill_first_token():
+    """A stop-set member as the FIRST sampled token finishes the request
+    at admission (prefill-only finish), freeing the lane that instant."""
+    model, params = _gpt_tiny()
+    prompt = _prompts(1, seed=32)[0]
+    first = _ref_stream(model, params, prompt, 1)[0]
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8,
+    ))
+    h = eng.submit(prompt, max_new_tokens=12,
+                   params=SamplingParams(stop_token_ids=(int(first),)))
+    eng.run()
+    assert h.finish_reason == "stop" and h.tokens == [first]
+    assert eng.pool.n_free == 1

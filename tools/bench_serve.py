@@ -14,7 +14,9 @@ Usage: python tools/bench_serve.py [--config llama3_shakespeare]
 BENCH_serve.json is JSON-lines, one entry per workload. The default run
 overwrites it with the Poisson entry; re-run with
 `--shared-prefix --append` to add the prefix-cache workload entry
-(cache-on vs cache-off TTFT over K shared system prompts).
+(cache-on vs cache-off TTFT over K shared system prompts) and with
+`--sampling --append` for the per-request-sampling workload (mixed
+temperature/top-p/top-k/min-p vs all-greedy on the same trace).
 """
 
 from __future__ import annotations
