@@ -8,6 +8,8 @@ star: "every notebook's train() cell becomes a CLI entrypoint"):
                [--checkpoint-dir ckpts] [--jsonl metrics.jsonl]
     cli sample --config gpt_shakespeare --checkpoint-dir ckpts
                [--prompt "ROMEO:"] [--max-new-tokens 200] [--top-k 50]
+    cli serve-bench --config llama3_shakespeare [--trace]
+    cli trace-summary serve_trace.json [--top 10]
 """
 
 from __future__ import annotations
@@ -415,6 +417,11 @@ def cmd_serve_bench(args) -> int:
     n_requests = args.requests
     if n_requests is None:
         n_requests = 48 if args.shared_prefix else 32
+    trace_kwargs = dict(
+        trace=args.trace,
+        trace_out=args.trace_out if args.trace else None,
+        trace_dump=args.trace_dump if args.trace else None,
+    )
     if args.sampling:
         result = run_sampling_bench(
             config=args.config,
@@ -425,6 +432,7 @@ def cmd_serve_bench(args) -> int:
             prompt_lens=tuple(args.prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
+            **trace_kwargs,
         )
     elif args.shared_prefix:
         result = run_prefix_bench(
@@ -439,6 +447,7 @@ def cmd_serve_bench(args) -> int:
             mean_interarrival_s=args.mean_interarrival,
             prefix_page=args.prefix_page,
             seed=args.seed,
+            **trace_kwargs,
         )
     else:
         result = run_serve_bench(
@@ -451,6 +460,7 @@ def cmd_serve_bench(args) -> int:
             mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
             skip_sequential=args.skip_sequential,
+            **trace_kwargs,
         )
     line = json.dumps(result)
     print(line)
@@ -460,6 +470,41 @@ def cmd_serve_bench(args) -> int:
         verb = "appended to" if args.append else "wrote"
         print(f"[serve-bench] {verb} {args.out}", file=sys.stderr)
     return 0
+
+
+def cmd_trace_summary(args) -> int:
+    """Rebuild per-request timelines from a Chrome trace-event JSON the
+    flight recorder exported (`serve-bench --trace`,
+    `engine.trace.export_chrome`, or TrainConfig.trace_path) and print
+    phase breakdowns plus the slowest requests (metrics/trace.py)."""
+    import os
+
+    from solvingpapers_tpu.metrics.trace import (
+        format_summary,
+        format_train_summary,
+        summarize_trace,
+        summarize_train_trace,
+    )
+
+    if not os.path.exists(args.trace):
+        print(f"no trace file at {args.trace}", file=sys.stderr)
+        return 2
+    summary = summarize_trace(args.trace)
+    if summary["n_requests"] or summary["rejected"]:
+        print(format_summary(summary, top=args.top))
+        return 0
+    train = summarize_train_trace(args.trace)
+    if train is not None:
+        print(format_train_summary(train))
+        return 0
+    print(
+        f"{args.trace} holds neither request lifecycle events "
+        "(ServeConfig(trace=True)) nor train spans "
+        "(TrainConfig.trace_path) — was it exported by the flight "
+        "recorder?",
+        file=sys.stderr,
+    )
+    return 1
 
 
 def _restore_for_inference(cfg, model, checkpoint_dir, example_batch, trainer=None):
@@ -647,6 +692,29 @@ def main(argv=None) -> int:
                          help="append to --out instead of overwriting "
                               "(BENCH_serve.json is JSON-lines: one entry "
                               "per workload)")
+    p_serve.add_argument("--trace", action="store_true",
+                         help="run one extra arm with the flight recorder "
+                              "on and record trace_overhead_pct (tracing-on "
+                              "vs tracing-off req/s on the same arrival "
+                              "trace) in the result detail")
+    p_serve.add_argument("--trace-out", default="serve_trace.json",
+                         help="[--trace] write the traced arm's Chrome "
+                              "trace-event JSON here (open in Perfetto or "
+                              "feed `cli trace-summary`)")
+    p_serve.add_argument("--trace-dump", default=None,
+                         help="[--trace] anomaly-dump JSONL path "
+                              "(ServeConfig.trace_dump_path): timeouts, "
+                              "reject bursts, and slow steps append the "
+                              "last ring events + a metrics snapshot")
+
+    p_tsum = sub.add_parser("trace-summary")
+    p_tsum.add_argument("trace",
+                        help="Chrome trace-event JSON exported by the "
+                             "flight recorder (serve-bench --trace-out, "
+                             "engine.trace.export_chrome, "
+                             "TrainConfig.trace_path)")
+    p_tsum.add_argument("--top", type=int, default=5,
+                        help="how many slowest requests to print")
 
     p_eval = sub.add_parser("eval")
     _add_common(p_eval)
@@ -656,7 +724,7 @@ def main(argv=None) -> int:
     p_export.add_argument("--out", required=True)
 
     args = parser.parse_args(argv)
-    if args.cmd != "list":
+    if args.cmd not in ("list", "trace-summary"):
         # before any command code touches jax (see _apply_platform docstring)
         _apply_platform(args)
     return {
@@ -664,6 +732,7 @@ def main(argv=None) -> int:
         "train": cmd_train,
         "sample": cmd_sample,
         "serve-bench": cmd_serve_bench,
+        "trace-summary": cmd_trace_summary,
         "eval": cmd_eval,
         "export": cmd_export,
     }[args.cmd](args)

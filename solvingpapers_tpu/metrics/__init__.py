@@ -5,10 +5,18 @@ from solvingpapers_tpu.metrics.writer import (
     ConsoleWriter,
     JSONLWriter,
     MultiWriter,
+    PrometheusTextWriter,
     Ring,
     TensorBoardWriter,
     WandbWriter,
     percentiles,
+)
+from solvingpapers_tpu.metrics.trace import (
+    AnomalyMonitor,
+    FlightRecorder,
+    TraceEvent,
+    format_summary,
+    summarize_trace,
 )
 from solvingpapers_tpu.metrics.mfu import (
     transformer_flops_per_token,

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import time
 from typing import IO, Iterable, Mapping
@@ -102,6 +103,11 @@ class ConsoleWriter(MetricsWriter):
 
 
 class JSONLWriter(MetricsWriter):
+    """Append-mode JSONL sink; usable as a context manager. `close()`
+    flushes AND fsyncs so a crash immediately after (the post-mortem
+    case anomaly dumps exist for) cannot lose the tail of the log to a
+    kernel page cache that never hit disk."""
+
     def __init__(self, path: str):
         parent = os.path.dirname(path)
         if parent:
@@ -112,8 +118,84 @@ class JSONLWriter(MetricsWriter):
         rec = {"step": step, "time": time.time(), **{k: float(v) for k, v in metrics.items()}}
         self.f.write(json.dumps(rec) + "\n")
 
+    def __enter__(self) -> "JSONLWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
     def close(self) -> None:
+        if self.f.closed:
+            return
+        self.f.flush()
+        os.fsync(self.f.fileno())
         self.f.close()
+
+
+class PrometheusTextWriter(MetricsWriter):
+    """Prometheus node-exporter textfile-collector sink.
+
+    Each `write()` atomically replaces `path` (write to `path + ".tmp"`,
+    fsync, `os.replace`) with the CURRENT metric set in text exposition
+    format — the contract the textfile collector expects (it must never
+    scrape a half-written file, and `os.replace` is atomic on POSIX).
+    Metric names are sanitized to the Prometheus grammar
+    (``[a-zA-Z_:][a-zA-Z0-9_:]*``): ``serve/ttft_s_p99`` becomes
+    ``serve_ttft_s_p99`` and the fractional-percentile key ``p99.9``
+    becomes ``p99_9``. The engine `step` rides along as
+    ``<prefix>last_step`` so dashboards can detect a stalled exporter.
+
+    No wandb/TensorBoard dependency: point node_exporter's
+    ``--collector.textfile.directory`` at the parent directory and the
+    serve/train metrics are scrapeable as gauges.
+    """
+
+    def __init__(self, path: str, prefix: str = ""):
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self.prefix = self.sanitize(prefix) if prefix else ""
+
+    @staticmethod
+    def sanitize(name: str) -> str:
+        name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+        if name and name[0].isdigit():
+            name = "_" + name
+        return name
+
+    @staticmethod
+    def _fmt(v: float) -> str:
+        # the exposition format spells non-finite values +Inf/-Inf/NaN
+        if v != v:
+            return "NaN"
+        if v == float("inf"):
+            return "+Inf"
+        if v == float("-inf"):
+            return "-Inf"
+        return repr(float(v))
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        # dedupe by SANITIZED name (last write wins): two keys that
+        # collapse to one name ("serve/ttft" vs "serve.ttft") would emit
+        # the same series twice, and the textfile collector rejects the
+        # ENTIRE file on a duplicate — one colliding key must not blind
+        # every dashboard. The `last_step` staleness rider yields to a
+        # user metric of the same name for the same reason.
+        gauges: dict[str, str] = {}
+        for k, v in metrics.items():
+            gauges[self.prefix + self.sanitize(k)] = self._fmt(float(v))
+        gauges.setdefault(f"{self.prefix}last_step", str(int(step)))
+        lines = []
+        for name, value in gauges.items():
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(lines) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
 
 
 class TensorBoardWriter(MetricsWriter):
@@ -163,5 +245,14 @@ class MultiWriter(MetricsWriter):
             w.write(step, metrics)
 
     def close(self) -> None:
+        """Close EVERY writer even when one raises (a dead wandb socket
+        must not leave the JSONL tail unflushed); the first error
+        propagates after the sweep completes."""
+        errs = []
         for w in self.writers:
-            w.close()
+            try:
+                w.close()
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                errs.append(e)
+        if errs:
+            raise errs[0]

@@ -25,10 +25,19 @@ all-greedy vs a per-request temperature/top-p/top-k/min-p mix
 (`cli serve-bench --sampling`) — the cost of the fused per-slot sampler's
 sort-based masking relative to the sort-free greedy fast path, i.e. the
 price of SamplingParams when a batch actually uses them.
+
+With `trace=True` every workload runs one EXTRA arm — the same arrival
+trace with the flight recorder on (`metrics/trace.py`) — and records
+`trace_overhead_pct` (tracing-on vs tracing-off req/s) in its detail,
+the budget the tracer's "single branch when off / bounded ring when on"
+design is held to. `trace_out` exports the traced arm's Chrome
+trace-event JSON (load in Perfetto or feed `cli trace-summary`);
+`trace_dump` arms the anomaly JSONL dumper.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import time
 
 import jax
@@ -46,8 +55,6 @@ def build_serve_model(config_name: str):
     """(model, params, extra_variables, vocab_size) for a registered
     decoder config — the serve-side analogue of `cli.cmd_sample`'s setup,
     minus data/tokenizer plumbing (the bench feeds raw token ids)."""
-    import dataclasses
-
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import build_model
 
@@ -143,6 +150,62 @@ def _round_if_present(snap: dict, key: str, out_key: str, digits: int) -> dict:
     return {}
 
 
+def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
+                       trace_out: str | None, trace_dump: str | None,
+                       params_for=None, reps: int = 4) -> dict:
+    """Measure the flight recorder's throughput cost and return the
+    detail fields: `trace_overhead_pct` = (1 - traced/untraced req/s) x
+    100 — the acceptance budget is < 2 on the Poisson workload — plus
+    the traced arm's req/s and event count. Exports the last traced
+    run's Chrome trace to `trace_out`; `trace_dump` arms the anomaly
+    dumper.
+
+    The measurement is PAIRED with ABBA ordering and MEAN makespans:
+    even reps run traced-then-untraced, odd reps flip, and each side
+    averages its runs. Single back-to-back pairs are dominated by
+    scheduler/thermal noise on a shared host (single-run makespans here
+    swing +-10% in both directions while the tracer's true cost — one
+    branch per hook off, one ring append per event on — is well under
+    1%), and taking min-of-reps re-biases under monotonic load drift
+    (one side owns the last slot); ABBA + mean cancels linear drift
+    exactly, and `reps=4` (8 runs) averages the residual noise below
+    the 2% budget the acceptance gate checks."""
+    tcfg = dataclasses.replace(
+        serve_cfg, trace=True, trace_dump_path=trace_dump
+    )
+    mk_on: list[float] = []
+    mk_off: list[float] = []
+    eng = None
+    for rep in range(reps):
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in order:
+            if arm == "on":
+                eng, _, mk = _run_engine_arm(
+                    model, params, extra, requests, tcfg, max_new,
+                    params_for=params_for,
+                )
+                mk_on.append(mk)
+            else:
+                _, _, mk = _run_engine_arm(
+                    model, params, extra, requests, serve_cfg, max_new,
+                    params_for=params_for,
+                )
+                mk_off.append(mk)
+    traced_rps = len(requests) / (sum(mk_on) / len(mk_on))
+    untraced_rps = len(requests) / (sum(mk_off) / len(mk_off))
+    fields = {
+        "trace_overhead_pct": round(
+            (1.0 - traced_rps / untraced_rps) * 100.0, 2
+        ),
+        "traced_requests_per_sec": round(traced_rps, 2),
+        "trace_events": eng.trace.total_recorded,
+    }
+    if trace_out:
+        eng.trace.export_chrome(trace_out)
+        fields["trace_out"] = trace_out
+    return fields
+
+
 def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new,
                     params_for=None):
     """`params_for` (index -> SamplingParams | None) attaches per-request
@@ -206,6 +269,9 @@ def run_serve_bench(
     mean_interarrival_s: float = 0.001,
     seed: int = 0,
     skip_sequential: bool = False,
+    trace: bool = False,
+    trace_out: str | None = None,
+    trace_dump: str | None = None,
 ) -> dict:
     """Run both arms, return the BENCH-shaped result dict."""
     model, params, extra, vocab = build_serve_model(config)
@@ -270,6 +336,11 @@ def run_serve_bench(
             int(snap["serve/tokens_prefilled_saved"])}
            if "serve/tokens_prefilled_saved" in snap else {}),
     }
+    if trace:
+        detail.update(_traced_arm_fields(
+            model, params, extra, requests, serve_cfg, max_new,
+            trace_out, trace_dump,
+        ))
     result = {
         "metric": "serve_requests_per_sec",
         "value": round(rps, 2),
@@ -300,6 +371,9 @@ def run_prefix_bench(
     prefix_page: int = 16,
     prefix_cache_bytes: int = 64 << 20,
     seed: int = 0,
+    trace: bool = False,
+    trace_out: str | None = None,
+    trace_dump: str | None = None,
 ) -> dict:
     """Shared-prefix workload, prefix cache ON vs OFF — same engine, same
     arrival trace; returns the BENCH-shaped dict with the TTFT speedup as
@@ -371,6 +445,14 @@ def run_prefix_bench(
             ),
             "prefix_hbm_bytes": int(snap.get("serve/prefix_hbm_bytes", 0.0)),
         }
+    trace_fields = {}
+    if trace:
+        # the traced arm mirrors the headline (cache-on) arm: splice +
+        # snapshot + lookup events are the ones this workload exercises
+        trace_fields = _traced_arm_fields(
+            model, params, extra, requests, cfg(True), max_new,
+            trace_out, trace_dump,
+        )
     # ratio of the UNROUNDED means: 4-decimal-rounded values would distort
     # (or zero-divide) on hardware where TTFT is tens of microseconds
     speedup = raw_ttft["cache_off"] / raw_ttft["cache_on"]
@@ -393,6 +475,7 @@ def run_prefix_bench(
             "prefix_page": prefix_page,
             **{f"{arm}_{k}": v for arm, d in arms.items()
                for k, v in d.items()},
+            **trace_fields,
         },
     }
 
@@ -421,6 +504,9 @@ def run_sampling_bench(
     prompt_lens=(16, 32, 48, 64),
     mean_interarrival_s: float = 0.001,
     seed: int = 0,
+    trace: bool = False,
+    trace_out: str | None = None,
+    trace_dump: str | None = None,
 ) -> dict:
     """Sampled vs greedy decode on the same Poisson trace.
 
@@ -472,6 +558,13 @@ def run_sampling_bench(
             **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
             **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
         }
+    trace_fields = {}
+    if trace:
+        # traced arm mirrors the headline (sampled-mix) arm
+        trace_fields = _traced_arm_fields(
+            model, params, extra, requests, serve_cfg, max_new,
+            trace_out, trace_dump, params_for=sampling_params_mix,
+        )
     ratio = arms["sampled"]["requests_per_sec"] / arms["greedy"][
         "requests_per_sec"]
     return {
@@ -492,5 +585,6 @@ def run_sampling_bench(
             "sampling_overhead_pct": round((1.0 - ratio) * 100.0, 1),
             **{f"{arm}_{k}": (round(v, 2) if isinstance(v, float) else v)
                for arm, d in arms.items() for k, v in d.items()},
+            **trace_fields,
         },
     }

@@ -58,10 +58,19 @@ the next block boundary (finish reasons eos / length / stop / cancelled /
 timeout, counted in `ServeMetrics`); stop strings are matched host-side
 on the detokenized stream (matches may span block boundaries); stop
 token-id sets extend single-id EOS host-side.
+
+Observability (`metrics/trace.py`, opt-in via `ServeConfig.trace`): a
+flight recorder captures per-request lifecycle spans, per-step batch
+composition, and scheduler/prefix-cache events into a bounded ring;
+export to Perfetto with `engine.trace.export_chrome(path)`, rebuild
+timelines with `cli trace-summary`, and arm post-mortem anomaly dumps
+with `trace_dump_path` — see the ServeConfig docstring and the README's
+Observability section.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 
@@ -103,6 +112,28 @@ class ServeConfig:
     number of compiled prefill programs stays bounded — use a multiple of
     128 for `use_flash` models (the Pallas q-block constraint).
 
+    Flight recorder (`metrics/trace.py`, opt-in via `trace`): the engine
+    records per-request lifecycle spans (queue / prefill / decode, one
+    track per KV slot, one flow per request), per-step composition
+    (prefills vs decode slots, control-array transfers, device vs host
+    time via `block_until_ready` fencing — the fence only exists when
+    tracing is on), and scheduler/prefix-cache events into a bounded
+    ring (`trace_capacity` events). Export with
+    `engine.trace.export_chrome(path)` and open in Perfetto, or rebuild
+    timelines with `cli trace-summary`. `trace_dump_path` arms the
+    anomaly dumper: timeout/cancelled finishes, `trace_reject_burst`
+    consecutive rejections, or a step exceeding `trace_slow_step_factor`
+    x the rolling median step time append the last `trace_dump_events`
+    events + a `ServeMetrics.snapshot()` to that JSONL file. With
+    `trace` off every hook site is one `is None` branch (< 2% req/s on
+    the Poisson bench — BENCH_serve.json `trace_overhead_pct`).
+
+    Profiler (`profile_dir`): opens a `jax.profiler.trace` window around
+    engine steps [`profile_steps[0]`, `profile_steps[1]`) with
+    `TraceAnnotation` scopes around the prefill/decode/splice programs,
+    so engine phases are visible inside the XLA trace (view in
+    TensorBoard / Perfetto).
+
     Prefix cache (`serve/prefix_cache.py`): with `prefix_cache` on, each
     admitted request splices its longest cached page-aligned prompt
     prefix into the lane and prefills only the uncovered suffix (start
@@ -142,6 +173,16 @@ class ServeConfig:
     prefix_page: int = 16
     prefix_cache_bytes: int = 64 << 20
     prefix_sched: bool = False
+    # flight recorder (metrics/trace.py); see the class docstring above
+    trace: bool = False
+    trace_capacity: int = 65536
+    trace_dump_path: str | None = None  # anomaly JSONL; requires trace=True
+    trace_dump_events: int = 256
+    trace_slow_step_factor: float = 10.0
+    trace_reject_burst: int = 8
+    # jax.profiler window over engine steps [start, stop)
+    profile_dir: str | None = None
+    profile_steps: tuple[int, int] = (10, 15)
 
 
 _UNSET = object()
@@ -324,9 +365,46 @@ class ServeEngine:
                 "length, which needs prefix_cache=True — without the radix "
                 "tree the knob would silently degrade to plain FIFO"
             )
+        self.metrics = ServeMetrics(window=metrics_window)
+        # flight recorder + anomaly monitor (both None when tracing is
+        # off: every hot-path hook below is a single `is not None` check).
+        # The recorder shares the latency metrics' patchable clock so
+        # trace-summary phase sums equal measured TTFT + decode wall.
+        self.trace = None
+        self._mon = None
+        if cfg.trace:
+            from solvingpapers_tpu.metrics.trace import (
+                AnomalyMonitor,
+                FlightRecorder,
+            )
+
+            self.trace = FlightRecorder(
+                capacity=cfg.trace_capacity, clock=smetrics.now
+            )
+            if cfg.trace_dump_path:
+                self._mon = AnomalyMonitor(
+                    self.trace, cfg.trace_dump_path,
+                    snapshot_fn=self.metrics.snapshot,
+                    last_n=cfg.trace_dump_events,
+                    slow_step_factor=cfg.trace_slow_step_factor,
+                    reject_burst=cfg.trace_reject_burst,
+                )
+        elif cfg.trace_dump_path:
+            raise ValueError(
+                "trace_dump_path dumps the flight recorder's last events "
+                "on anomalies, which needs trace=True — without the ring "
+                "a dump would hold nothing"
+            )
+        # TraceAnnotation scopes label the prefill/decode/splice programs
+        # inside XLA profiles AND the flight recorder's own timeline
+        self._annotate = cfg.trace or cfg.profile_dir is not None
+        self._step_idx = 0
+        self._profiling = False
+        self._profile_done = cfg.profile_dir is None
         self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
         self.prefix_cache = (
-            PrefixCache(page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes)
+            PrefixCache(page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes,
+                        trace=self.trace)
             if cfg.prefix_cache else None
         )
         self.scheduler = FIFOScheduler(
@@ -336,8 +414,8 @@ class ServeEngine:
             max_wait_steps=cfg.max_wait_steps,
             prefer_cached=cfg.prefix_sched,
             prefix_lookup=self._match_len if self.prefix_cache else None,
+            trace=self.trace,
         )
-        self.metrics = ServeMetrics(window=metrics_window)
         self._slot_req: list[Request | None] = [None] * cfg.n_slots
         # host-side numpy mirrors of per-slot decode state: shipped to the
         # device as ONE packed array per jitted call — eager .at[].set
@@ -441,8 +519,19 @@ class ServeEngine:
             req.deadline = req.submit_time + deadline_s
         if not self.scheduler.submit(req):
             self.metrics.record_reject()
-        elif req.deadline is not None:
-            self._waiting_deadlines += 1
+            if self.trace is not None:
+                self.trace.instant("reject", "request", "queue", req=req.id,
+                                   ts=req.submit_time, prompt_len=prompt.size)
+                if self._mon is not None:
+                    self._mon.observe_reject()
+        else:
+            if req.deadline is not None:
+                self._waiting_deadlines += 1
+            if self.trace is not None:
+                self.trace.instant("submit", "request", "queue", req=req.id,
+                                   ts=req.submit_time, prompt_len=prompt.size)
+                if self._mon is not None:
+                    self._mon.observe_accept()
         return req
 
     def cancel(self, req: Request) -> None:
@@ -469,6 +558,10 @@ class ServeEngine:
 
         Returns the requests that FINISHED this iteration.
         """
+        if not self._profile_done:
+            self._profile_tick()
+        tr = self.trace
+        t_step = smetrics.now() if tr is not None else 0.0
         finished: list[Request] = []
         now = smetrics.now()
         if self._waiting_deadlines > 0:
@@ -479,16 +572,85 @@ class ServeEngine:
                 self._waiting_deadlines -= 1
                 self._finish_unadmitted(req, "timeout", now)
                 finished.append(req)
+        n_admitted = 0
         for req in self.scheduler.pick(self.pool.n_free, self.pool.n_active):
             if req.deadline is not None:
                 self._waiting_deadlines -= 1  # left the queue via pick
+            n_admitted += 1
             if self._admit(req):
                 finished.append(req)  # prefill-only finish (eos/budget 1)
-        if self.pool.n_active > 0:
+        decode_slots = self.pool.n_active
+        if decode_slots > 0:
             finished.extend(self._decode_block())
         self.scheduler.tick()
         self.metrics.record_step(self.pool.occupancy)
+        # only steps that did work are traced/monitored: an external
+        # serving loop may poll step() while idle, and feeding those
+        # ~microsecond no-ops into the ring (spam) and the anomaly
+        # monitor's rolling median would make the FIRST real step look
+        # like a slow-step anomaly and dump on every step after it
+        if tr is not None and (n_admitted or decode_slots or finished):
+            now = smetrics.now()
+            dur = now - t_step
+            tr.complete(
+                "step", "engine", "engine", ts=t_step, dur=dur,
+                prefills=n_admitted, decode_slots=decode_slots,
+                # host->device control transfers: 3 per prefill (prompt +
+                # int ctl + float samp), 2 per decode call (packed state +
+                # samp block) — the dispatch cost the packed mirrors bound
+                transfers=3 * n_admitted + (2 if decode_slots else 0),
+                device_s=round(self._dev_s, 6),
+            )
+            tr.counter("queue_depth", "engine", "engine", ts=now,
+                       depth=len(self.scheduler))
+            tr.counter("active_slots", "engine", "engine", ts=now,
+                       active=self.pool.n_active)
+            self._dev_s = 0.0
+            # the monitor's rolling median sees only steps that ran a
+            # program: purge-only steps (deadline expiries) are traced
+            # above but, like idle polls, complete in ~microseconds and
+            # would collapse the median until every real step looks slow
+            if self._mon is not None and (n_admitted or decode_slots):
+                self._mon.observe_step(dur)
+        self._step_idx += 1
         return finished
+
+    # accumulated device time (block_until_ready-fenced program calls)
+    # within the current step; only maintained while tracing
+    _dev_s = 0.0
+
+    def _profile_tick(self) -> None:
+        """Open/close the jax.profiler window around engine steps
+        [profile_steps[0], profile_steps[1]) — same stop-before-start
+        ordering as the train loop so a window never opens empty."""
+        cfg = self.config
+        if self._profiling and self._step_idx >= cfg.profile_steps[1]:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._profile_done = True
+        if (not self._profiling and not self._profile_done
+                and self._step_idx >= cfg.profile_steps[0]):
+            jax.profiler.start_trace(cfg.profile_dir)
+            self._profiling = True
+
+    def _scope(self, name: str):
+        """TraceAnnotation around a jitted-program call when tracing or
+        profiling is on (labels the program inside XLA traces), a shared
+        nullcontext otherwise — ONE call site per program, so operand
+        changes cannot silently diverge an annotated copy."""
+        if self._annotate:
+            return jax.profiler.TraceAnnotation(name)
+        return self._null_scope
+
+    _null_scope = contextlib.nullcontext()
+
+    def stop_profile(self) -> None:
+        """Close a still-open profiler window (external step() drivers
+        that stop before `profile_steps[1]`); run() calls this on drain."""
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            self._profile_done = True
 
     def run(self, max_steps: int | None = None) -> None:
         """Drive step() until queue and slots drain (or `max_steps`)."""
@@ -498,6 +660,7 @@ class ServeEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 return
+        self.stop_profile()
 
     # ------------------------------------------------------------ private
 
@@ -527,6 +690,7 @@ class ServeEngine:
         """
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
+        tr = self.trace
         now = smetrics.now()
         req.state = ACTIVE
         req.slot = slot
@@ -546,11 +710,22 @@ class ServeEngine:
                 # future async/threaded admission path must keep, kept live
                 # here so the refcount machinery stays exercised.
                 self.prefix_cache.pin(match)
+                t_sp = smetrics.now() if tr is not None else 0.0
                 offset = 0
                 for node in match.nodes:
                     self.pool.splice_prefix(slot, node.segment, offset)
                     offset += node.length
                 self.prefix_cache.unpin(match)
+                if tr is not None:
+                    # fence: the splice programs run async; without the
+                    # wait the span would record dispatch, not the copy
+                    jax.block_until_ready(self.pool.caches)
+                    t_sp1 = smetrics.now()
+                    self._dev_s += t_sp1 - t_sp
+                    tr.complete("splice", "prefix", f"slot{slot}", ts=t_sp,
+                                dur=t_sp1 - t_sp, req=req.id,
+                                matched=matched,
+                                pages=matched // self.prefix_cache.page)
 
         suffix = length - matched
         padded = self._bucketed(suffix, start=matched)
@@ -571,12 +746,20 @@ class ServeEngine:
             [slot, suffix, self._rng_step, top_k, seed, need_lp], np.int32
         )
         self._rng_step += 1
-        self.pool.caches, first, logprob = _prefill_program(
-            self.model, padded, chunk, matched, self.config.sample_cap,
-            self.variables, self.pool.caches, jnp.asarray(prompt_padded),
-            jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
-        )
-        first = int(first)
+        t_pf = smetrics.now() if tr is not None else 0.0
+        with self._scope("serve/prefill"):
+            self.pool.caches, first, logprob = _prefill_program(
+                self.model, padded, chunk, matched, self.config.sample_cap,
+                self.variables, self.pool.caches, jnp.asarray(prompt_padded),
+                jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
+            )
+        first = int(first)  # blocks on the program — t_pf1 is device-true
+        if tr is not None:
+            t_pf1 = smetrics.now()
+            self._dev_s += t_pf1 - t_pf
+            tr.complete("prefill_program", "engine", f"slot{slot}", ts=t_pf,
+                        dur=t_pf1 - t_pf, req=req.id, padded=padded,
+                        suffix=suffix, chunk=chunk or 0)
         if self.prefix_cache is not None:
             # snapshot while the lane's [0, length) span is pristine (an
             # active lane's decode writes land at positions >= length, and
@@ -600,6 +783,17 @@ class ServeEngine:
         if req.params.logprobs:
             req.logprobs.append(float(logprob))
         self.metrics.record_first_token(req, now, prefilled=suffix)
+        if tr is not None:
+            # lifecycle spans stamped from the request's OWN timestamps:
+            # queue + prefill partition TTFT exactly (submit -> admit ->
+            # first token), which is what lets trace-summary's phase sums
+            # reproduce the measured latencies instead of approximating
+            # them from instrumentation spans
+            tr.complete("queue", "request", "queue", ts=req.submit_time,
+                        dur=req.admit_time - req.submit_time, req=req.id)
+            tr.complete("prefill", "request", f"slot{slot}",
+                        ts=req.admit_time, dur=now - req.admit_time,
+                        req=req.id, prefilled=suffix, matched=matched)
         self._last_emit[slot] = now
         self.pool.positions[slot] = length
         self._toks[slot] = first
@@ -674,11 +868,22 @@ class ServeEngine:
         state[6] = self._seed
         state[8] = self._need_lp
         self._rng_step += 1
-        self.pool.caches, (out, lps) = _decode_program(
-            self.model, block, self.config.sample_cap, self.variables,
-            self.pool.caches, jnp.asarray(state),
-            jnp.asarray(self._samp_f), self._rng,
-        )
+        tr = self.trace
+        t_dec = smetrics.now() if tr is not None else 0.0
+        with self._scope("serve/decode_block"):
+            self.pool.caches, (out, lps) = _decode_program(
+                self.model, block, self.config.sample_cap, self.variables,
+                self.pool.caches, jnp.asarray(state),
+                jnp.asarray(self._samp_f), self._rng,
+            )
+        t_dev = 0.0
+        if tr is not None:
+            # fence so the span is device wall time, not dispatch time;
+            # the np.asarray below would block anyway, so the fence costs
+            # nothing extra — it just moves the wait to a measured point
+            jax.block_until_ready(out)
+            t_dev = smetrics.now()
+            self._dev_s += t_dev - t_dec
         out = np.asarray(out)  # (block, n_slots); overshoot truncated below
         lps = np.asarray(lps)
         now = smetrics.now()
@@ -686,6 +891,12 @@ class ServeEngine:
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
+            if tr is not None:
+                # one fused program advances every lane together: each
+                # active slot's block span shares the program's wall time
+                tr.complete("decode_block", "engine", f"slot{slot}",
+                            ts=t_dec, dur=t_dev - t_dec, req=req.id,
+                            block=block)
             if req.cancelled:
                 # lifecycle kill at the block boundary: this block's
                 # output is discarded, the lane frees for the next pick
@@ -739,6 +950,19 @@ class ServeEngine:
         req.finish_reason = reason
         req.finish_time = now
         self.metrics.record_finish(req, now)
+        if self.trace is not None:
+            # lifecycle decode phase: first token -> finish (0 for
+            # prefill-only finishes) — with queue + prefill above, the
+            # three spans partition finish_time - submit_time exactly
+            self.trace.complete(
+                "decode", "request", f"slot{req.slot}",
+                ts=req.first_token_time, dur=now - req.first_token_time,
+                req=req.id, tokens=len(req.tokens),
+            )
+            self.trace.instant("finish", "request", f"slot{req.slot}",
+                               req=req.id, ts=now, reason=reason)
+            if self._mon is not None:
+                self._mon.observe_finish(reason)
         slot = req.slot
         self._slot_req[slot] = None
         # park the idle lane at position 0 with greedy sampling rows: the
@@ -761,3 +985,12 @@ class ServeEngine:
         req.finish_reason = reason
         req.finish_time = now
         self.metrics.record_finish(req, now)
+        if self.trace is not None:
+            # its whole life was queue time; no prefill/decode phases
+            self.trace.complete("queue", "request", "queue",
+                                ts=req.submit_time,
+                                dur=now - req.submit_time, req=req.id)
+            self.trace.instant("finish", "request", "queue", req=req.id,
+                               ts=now, reason=reason)
+            if self._mon is not None:
+                self._mon.observe_finish(reason)

@@ -102,13 +102,17 @@ class PrefixMatch:
 class PrefixCache:
     """Radix tree + LRU byte-budget eviction + refcount pinning."""
 
-    def __init__(self, page: int = 16, max_bytes: int = 64 << 20):
+    def __init__(self, page: int = 16, max_bytes: int = 64 << 20,
+                 trace=None):
         if page < 1:
             raise ValueError(f"page must be >= 1, got {page}")
         if max_bytes < 0:
             raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
         self.page = page
         self.max_bytes = max_bytes
+        # optional metrics.trace.FlightRecorder (the engine's); hooks are
+        # single `is not None` branches when tracing is off
+        self.trace = trace
         self.root = _Node(np.zeros(0, np.int32), None, None)
         self.evictions = 0
         self.bytes_held = 0
@@ -153,7 +157,7 @@ class PrefixCache:
             break
         return i
 
-    def match(self, tokens) -> PrefixMatch:
+    def match(self, tokens, _trace: bool = True) -> PrefixMatch:
         """Longest page-aligned cached prefix of `tokens`.
 
         Touches the matched path's LRU stamps and splits a partially
@@ -181,6 +185,14 @@ class PrefixCache:
         stamp = self._tick()
         for nd in path:
             nd.stamp = stamp
+        if self.trace is not None and _trace:
+            # _trace=False on insert()'s internal re-match, so the trace's
+            # lookup stream counts only real admission-time lookups
+            self.trace.instant(
+                "prefix_lookup", "prefix", "prefix",
+                matched=i, pages=i // self.page,
+                hit=int(i > 0), prompt_len=int(tokens.size),
+            )
         return PrefixMatch(nodes=path, length=i)
 
     # ---------------------------------------------------------- mutation
@@ -240,7 +252,7 @@ class PrefixCache:
             )
         if tokens.size == 0:
             return 0
-        m = self.match(tokens)
+        m = self.match(tokens, _trace=False)
         rem = tokens[m.length:]
         if rem.size == 0:
             return 0
@@ -255,6 +267,12 @@ class PrefixCache:
         node.stamp = self._tick()
         parent.children[self._key(rem)] = node
         self.bytes_held += node.nbytes
+        if self.trace is not None:
+            self.trace.instant(
+                "prefix_snapshot", "prefix", "prefix",
+                new_tokens=int(rem.size), pages=int(rem.size) // self.page,
+                nbytes=node.nbytes, held=self.bytes_held,
+            )
         self._evict_to_budget()
         return int(rem.size)
 
@@ -273,3 +291,9 @@ class PrefixCache:
             del victim.parent.children[self._key(victim.tokens)]
             self.bytes_held -= victim.nbytes
             self.evictions += 1
+            if self.trace is not None:
+                self.trace.instant(
+                    "prefix_evict", "prefix", "prefix",
+                    tokens=victim.length, freed=victim.nbytes,
+                    held=self.bytes_held,
+                )

@@ -118,6 +118,7 @@ class FIFOScheduler:
         max_wait_steps: int = 64,
         prefer_cached: bool = False,
         prefix_lookup=None,
+        trace=None,
     ):
         self.max_waiting = max_waiting
         self.decode_priority = decode_priority
@@ -126,6 +127,9 @@ class FIFOScheduler:
         self.prefer_cached = prefer_cached
         # prompt (np.ndarray) -> cached-prefix match length; read-only
         self.prefix_lookup = prefix_lookup
+        # optional metrics.trace.FlightRecorder (the engine's); every
+        # hook below is one `is not None` branch when tracing is off
+        self.trace = trace
         self.queue: deque[Request] = deque()
 
     def __len__(self) -> int:
@@ -150,12 +154,18 @@ class FIFOScheduler:
         if not self.queue or n_free == 0:
             return []
         budget = n_free
-        if (
-            self.decode_priority
-            and n_active > 0
-            and self.queue[0].waited_steps <= self.max_wait_steps
-        ):
-            budget = self.max_prefills_per_step
+        if self.decode_priority and n_active > 0:
+            head = self.queue[0]
+            if head.waited_steps <= self.max_wait_steps:
+                budget = self.max_prefills_per_step
+            elif self.trace is not None:
+                # anti-starvation override fired: the head waited past the
+                # budget, so prefill takes every free slot despite active
+                # decodes — the event that explains ITL spikes in a trace
+                self.trace.instant(
+                    "wait_budget_override", "sched", "queue", req=head.id,
+                    waited_steps=head.waited_steps, queued=len(self.queue),
+                )
         k = min(budget, n_free, len(self.queue))
         if not (self.prefer_cached and self.prefix_lookup is not None):
             return [self.queue.popleft() for _ in range(k)]

@@ -14,6 +14,7 @@ Features (capability superset of deepseekv3's `train()`):
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, Iterator
@@ -89,6 +90,15 @@ class TrainConfig:
     debug_nans: bool = False  # jax_debug_nans: fail fast at the faulting op
     profile_dir: str | None = None  # jax.profiler trace output (TensorBoard)
     profile_steps: tuple[int, int] = (10, 15)  # [start, stop) steps to trace
+    # flight recorder (metrics/trace.py): record data-wait / step / eval /
+    # checkpoint / callback spans on a "train" track and export a Chrome
+    # trace-event JSON here when fit() ends (also on exceptions — the
+    # post-mortem case). Adds a goodput metric (traced step time / wall:
+    # the fraction of the run actually training vs waiting on data, eval,
+    # and checkpoints). Observability mode: each dispatch is fenced with
+    # block_until_ready so step spans are true durations — do not leave it
+    # on for production throughput runs.
+    trace_path: str | None = None
     # context parallelism: shard the sequence dim of (B, S) token batches
     # over the mesh 'context' axis and run the whole loss inside shard_map
     # (the model must be built with context_parallel=True so its attention
@@ -808,11 +818,38 @@ class Trainer:
         # fit() already gates writes by log_every; the writer must not
         # re-filter or eval/final-step writes would be dropped
         writer = writer or ConsoleWriter()
+        # flight recorder (TrainConfig.trace_path): spans for everything
+        # the loop blocks on, exported in the finally below. step spans
+        # fence each dispatch (see the config docstring), so goodput =
+        # traced-step-time / wall is an honest utilization number.
+        recorder = None
+        step_span_total = 0.0
+        t_fit0 = 0.0
+        if cfg.trace_path:
+            from solvingpapers_tpu.metrics.trace import FlightRecorder
+
+            recorder = FlightRecorder()
+            t_fit0 = recorder.clock()
+
+        def _next(it):
+            if recorder is None:
+                return next(it)
+            with recorder.span("data_wait", "train", "train"):
+                return next(it)
+
+        def _span(name, **kw):
+            """Recorder span, or a no-op context when tracing is off —
+            one `with` per instrumented section instead of a duplicated
+            traced/untraced call at every site."""
+            if recorder is None:
+                return contextlib.nullcontext()
+            return recorder.span(name, "train", "train", **kw)
+
         if state is None:
-            first = next(batch_iter)
+            first = _next(batch_iter)
             state = self.init_state(first)
         else:
-            first = next(batch_iter) if self._batch_shardings is None else None
+            first = _next(batch_iter) if self._batch_shardings is None else None
             if first is not None:
                 self._set_batch_shardings(first)
         if self._train_step is None:
@@ -900,7 +937,7 @@ class Trainer:
                     profiling = True
                 if kk == 1:
                     batch = first if (first is not None and step == start_step) \
-                        else next(batch_iter)
+                        else _next(batch_iter)
                     if first is not None and step == start_step:
                         first = None
                     exclude_compile = (
@@ -913,7 +950,23 @@ class Trainer:
                         # out of the step timing, like eval/checkpoint
                         jax.device_get(metrics["train_loss"])
                         t_tail = time.perf_counter()
+                    t_span = recorder.clock() if recorder is not None else 0.0
                     state, metrics = self._train_step(state, batch)
+                    if recorder is not None:
+                        jax.block_until_ready(metrics)
+                        d_span = recorder.clock() - t_span
+                        compiled = step == start_step
+                        recorder.complete("step", "train", "train",
+                                          ts=t_span, dur=d_span, steps=1,
+                                          compiled=int(compiled))
+                        if not compiled:
+                            # goodput's numerator counts TRAINING time;
+                            # folding the first step's jit compile in
+                            # would report ~1.0 on a run that spent most
+                            # of its wall compiling (the wall stays in
+                            # the denominator, so compile-dominated runs
+                            # honestly read as low goodput)
+                            step_span_total += d_span
                     if exclude_compile:
                         jax.device_get(metrics["train_loss"])
                         t_prev += time.perf_counter() - t_tail
@@ -928,7 +981,7 @@ class Trainer:
                         window.append(first)
                         first = None
                     while len(window) < kk:
-                        window.append(next(batch_iter))
+                        window.append(_next(batch_iter))
                     # device arrays (e.g. lm_batch_iterator's on-device
                     # crops) stack with jnp — np.stack would force K
                     # synchronous D2H pulls per window, catastrophic on
@@ -939,7 +992,17 @@ class Trainer:
                                      else np.stack(xs)),
                         *window,
                     )
+                    t_span = recorder.clock() if recorder is not None else 0.0
                     state, metrics = self._train_step_scan(state, batch)
+                    if recorder is not None:
+                        jax.block_until_ready(metrics)
+                        d_span = recorder.clock() - t_span
+                        compiled = step == start_step
+                        recorder.complete("step", "train", "train",
+                                          ts=t_span, dur=d_span, steps=kk,
+                                          compiled=int(compiled))
+                        if not compiled:  # see the kk == 1 branch
+                            step_span_total += d_span
                 if step == start_step:
                     # fence the first step so compile time never pollutes
                     # step_time/tokens_per_sec/MFU metrics; the timed window
@@ -966,7 +1029,8 @@ class Trainer:
                     jax.device_get(metrics["train_loss"])
                 if run_eval:
                     t_eval = time.perf_counter()
-                    val = self.evaluate(state, eval_iter_fn())
+                    with _span("eval", step=end):
+                        val = self.evaluate(state, eval_iter_fn())
                     writer.write(end, {k: float(v) for k, v in val.items()})
                     t_prev += time.perf_counter() - t_eval  # keep eval out of step timing
 
@@ -974,7 +1038,8 @@ class Trainer:
                     t_cb = time.perf_counter()
                     for every, fn in callbacks:
                         if every > 0 and end % every == 0:
-                            fn(state, end)
+                            with _span("callback", step=end):
+                                fn(state, end)
                     t_prev += time.perf_counter() - t_cb
 
                 if end % max(cfg.log_every, 1) == 0 or end == cfg.steps:
@@ -1011,7 +1076,8 @@ class Trainer:
                     # already async) out of step timing, like eval/callbacks
                     jax.device_get(metrics["train_loss"])
                     t_save = time.perf_counter()
-                    ckpt.maybe_save(end, _pure_state(state))
+                    with _span("checkpoint", step=end):
+                        ckpt.maybe_save(end, _pure_state(state))
                     t_prev += time.perf_counter() - t_save
                 step = end
 
@@ -1032,6 +1098,19 @@ class Trainer:
 
                 for sig, h in old_handlers.items():
                     signal.signal(sig, h)
+            if recorder is not None:
+                # goodput = fenced step time / fit wall: the fraction of
+                # the run spent training vs data waits / eval / ckpt /
+                # host bookkeeping. Export lives in the finally so a
+                # crashed run still leaves its trace for the post-mortem.
+                wall = recorder.clock() - t_fit0
+                goodput = step_span_total / wall if wall > 0 else 0.0
+                recorder.instant(
+                    "goodput", "train", "train", goodput=round(goodput, 4),
+                    step_s=round(step_span_total, 4), wall_s=round(wall, 4),
+                )
+                recorder.export_chrome(cfg.trace_path)
+                writer.write(step, {"goodput": goodput})
         return state
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:

@@ -131,3 +131,42 @@ def test_parity_regression_check():
         "gpt_shakespeare": {"steps": 125, "val_loss": 3.0},
     }}
     assert mod.check_regressions(history, other) == []
+
+
+# ----------------------------------------------------- writer robustness
+
+
+def test_jsonl_writer_context_manager_flushes_and_fsyncs(tmp_path):
+    import json
+
+    from solvingpapers_tpu.metrics import JSONLWriter
+
+    path = str(tmp_path / "m.jsonl")
+    with JSONLWriter(path) as w:
+        w.write(1, {"loss": 2.0})
+        w.write(2, {"loss": 1.5})
+    recs = [json.loads(line) for line in open(path)]
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[1]["loss"] == 1.5
+    # double close (context exit then explicit) must be a no-op
+    w.close()
+
+
+def test_multiwriter_close_survives_raising_writer(tmp_path):
+    from solvingpapers_tpu.metrics import JSONLWriter, MetricsWriter, MultiWriter
+
+    class Boom(MetricsWriter):
+        def write(self, step, metrics):
+            pass
+
+        def close(self):
+            raise RuntimeError("socket died")
+
+    tail = JSONLWriter(str(tmp_path / "tail.jsonl"))
+    multi = MultiWriter(Boom(), tail)
+    multi.write(1, {"x": 1.0})
+    # the raising writer must not stop the sweep: the JSONL still closes
+    # (flush + fsync) and the first error still propagates
+    with pytest.raises(RuntimeError, match="socket died"):
+        multi.close()
+    assert tail.f.closed

@@ -1,0 +1,179 @@
+"""ServeMetrics.snapshot() key-surface tests + the Prometheus textfile
+sink that scrapes it.
+
+The snapshot's flat key set is now API three consumers depend on: the
+MetricsWriter sinks, the flight recorder's anomaly dumps
+(metrics/trace.py embeds a snapshot per dump), and the
+PrometheusTextWriter (sanitized names must stay stable or dashboards
+break). These tests lock the presence/absence rules: finish-reason keys
+appear per observed reason, prefix gauges appear iff lookups occurred,
+and rate keys are absent (not NaN/inf) at zero elapsed time.
+"""
+
+import os
+import types
+
+import pytest
+
+from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+from solvingpapers_tpu.serve.metrics import ServeMetrics
+
+pytestmark = pytest.mark.fast
+
+
+def _req(submit=0.0, prompt_len=4, reason=None):
+    return types.SimpleNamespace(
+        submit_time=submit, prompt=list(range(prompt_len)),
+        finish_reason=reason,
+    )
+
+
+def _base_keys():
+    return {
+        "serve/tokens_out", "serve/tokens_prefilled",
+        "serve/requests_finished", "serve/requests_rejected", "serve/steps",
+    }
+
+
+def test_snapshot_empty_has_only_counters():
+    snap = ServeMetrics().snapshot()
+    assert set(snap) == _base_keys()
+    assert all(v == 0.0 for v in snap.values())
+
+
+def test_rate_keys_absent_at_zero_elapsed():
+    """One instant of activity: elapsed == 0, so tokens/requests-per-sec
+    must be ABSENT — a 0-division inf/NaN would poison every sink."""
+    m = ServeMetrics()
+    m.record_first_token(_req(), now=1.0, prefilled=4)
+    snap = m.snapshot()
+    assert "serve/tokens_per_sec" not in snap
+    assert "serve/requests_per_sec" not in snap
+    # latency rings observed -> their summaries ARE present
+    assert snap["serve/ttft_s_mean"] == pytest.approx(1.0)
+    # a second observation later opens the window and the rates appear
+    m.record_tokens(_req(), n=2, span_s=0.5, now=2.0)
+    snap = m.snapshot()
+    assert snap["serve/tokens_per_sec"] == pytest.approx(3 / 1.0)
+    assert snap["serve/requests_per_sec"] == 0.0
+
+
+def test_finish_reason_keys_per_observed_reason():
+    m = ServeMetrics()
+    for reason in ("eos", "eos", "timeout", None):
+        m.record_finish(_req(reason=reason), now=1.0)
+    snap = m.snapshot()
+    assert snap["serve/finish_eos"] == 2.0
+    assert snap["serve/finish_timeout"] == 1.0
+    assert snap["serve/finish_unknown"] == 1.0
+    assert "serve/finish_cancelled" not in snap  # never observed
+    assert snap["serve/requests_finished"] == 4.0
+
+
+def test_prefix_gauges_present_iff_lookups_occurred():
+    m = ServeMetrics()
+    assert not any(k.startswith("serve/prefix") for k in m.snapshot())
+    # a MISS still counts as a lookup -> the whole gauge family appears
+    m.record_prefix_lookup(0)
+    snap = m.snapshot()
+    assert snap["serve/prefix_lookups"] == 1.0
+    assert snap["serve/prefix_hits"] == 0.0
+    assert snap["serve/prefix_hit_rate"] == 0.0
+    m.record_prefix_lookup(32)
+    m.record_prefix_state(bytes_held=1024, evictions=2)
+    snap = m.snapshot()
+    assert snap["serve/prefix_hit_rate"] == 0.5
+    assert snap["serve/prefix_cached_tokens"] == 32.0
+    assert snap["serve/tokens_prefilled_saved"] == 32.0
+    assert snap["serve/prefix_evictions"] == 2.0
+    assert snap["serve/prefix_hbm_bytes"] == 1024.0
+
+
+def test_latency_summaries_present_iff_observed():
+    m = ServeMetrics()
+    m.record_admit(_req(submit=0.0), now=0.25)
+    snap = m.snapshot()
+    assert snap["serve/queue_wait_s_mean"] == pytest.approx(0.25)
+    assert snap["serve/queue_wait_s_p99"] == pytest.approx(0.25)
+    assert "serve/itl_s_mean" not in snap  # no tokens streamed yet
+    assert "serve/ttft_s_mean" not in snap
+
+
+# ------------------------------------------------------- prometheus sink
+
+
+def test_prometheus_sanitizes_the_snapshot_name_table(tmp_path):
+    """Every snapshot key must sanitize to a valid Prometheus metric name
+    ([a-zA-Z_:][a-zA-Z0-9_:]*) — including the fractional-percentile
+    shape p99.9 — and the sink must expose the full serve table."""
+    m = ServeMetrics()
+    m.record_admit(_req(), now=0.5)
+    m.record_first_token(_req(), now=1.0, prefilled=4)
+    m.record_tokens(_req(), n=4, span_s=0.4, now=2.0)
+    m.record_finish(_req(reason="eos"), now=2.0)
+    m.record_prefix_lookup(16)
+    snap = m.snapshot()
+    path = str(tmp_path / "serve.prom")
+    w = PrometheusTextWriter(path)
+    w.write(7, snap)
+    text = open(path).read()
+    import re
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    seen = set()
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert line.split()[1] == "TYPE"
+            continue
+        name, value = line.split(" ", 1)
+        assert name_re.match(name), name
+        float(value)  # parseable
+        seen.add(name)
+    assert seen == {PrometheusTextWriter.sanitize(k) for k in snap} | {
+        "last_step"
+    }
+    assert "serve_ttft_s_p99 " in text
+    assert "serve_finish_eos 1.0" in text
+    assert "last_step 7" in text
+    # the fractional-percentile name shape stays legal
+    assert PrometheusTextWriter.sanitize("serve/itl_s_p99.9") == \
+        "serve_itl_s_p99_9"
+    assert PrometheusTextWriter.sanitize("9lives") == "_9lives"
+
+
+def test_prometheus_write_is_atomic_replace(tmp_path):
+    path = str(tmp_path / "m.prom")
+    w = PrometheusTextWriter(path, prefix="train_")
+    w.write(1, {"loss": 1.5})
+    w.write(2, {"loss": 1.25})  # replaces, never appends
+    text = open(path).read()
+    value_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("train_loss ")]
+    assert value_lines == ["train_loss 1.25"]  # replaced, not appended
+    assert "train_last_step 2" in text
+    assert not os.path.exists(path + ".tmp")  # tmp file consumed by rename
+
+
+def test_prometheus_dedupes_colliding_names(tmp_path):
+    """Two keys that sanitize to one name, or a user metric named
+    last_step, must not produce duplicate series — node_exporter's
+    textfile collector rejects the WHOLE file on a duplicate."""
+    path = str(tmp_path / "m.prom")
+    w = PrometheusTextWriter(path)
+    w.write(9, {"serve/ttft": 1.0, "serve.ttft": 2.0, "last_step": 5.0})
+    lines = open(path).read().splitlines()
+    names = [ln.split(" ", 1)[0] for ln in lines if not ln.startswith("#")]
+    assert len(names) == len(set(names)), f"duplicate series: {names}"
+    # last key wins the collision; the staleness rider yields to the
+    # user's own last_step metric
+    assert "serve_ttft 2.0" in lines
+    assert "last_step 5.0" in lines
+
+
+def test_prometheus_nonfinite_values(tmp_path):
+    path = str(tmp_path / "m.prom")
+    PrometheusTextWriter(path).write(
+        0, {"a": float("inf"), "b": float("-inf"), "c": float("nan")}
+    )
+    text = open(path).read()
+    assert "a +Inf" in text and "b -Inf" in text and "c NaN" in text

@@ -1,0 +1,430 @@
+"""Flight-recorder tracing tests (metrics/trace.py + engine wiring).
+
+The contract: tracing is invisible when off (engine holds None, streams
+token-exact either way), and when on the exported Chrome trace's
+request-lifecycle spans PARTITION each request's wall time — queue +
+prefill + decode == finish_time - submit_time — because the engine stamps
+them from the same Request timestamps the latency metrics use. That
+identity is what makes `cli trace-summary` a trustworthy post-mortem.
+"""
+
+import json
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.metrics.trace import (
+    AnomalyMonitor,
+    FlightRecorder,
+    events_to_chrome,
+    format_summary,
+    load_chrome,
+    summarize_trace,
+)
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+
+pytestmark = pytest.mark.fast
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    model = GPT(GPT_TINY)
+    rng = jax.random.key(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(n, seed=0, lo=4, hi=20):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, GPT_TINY.vocab_size,
+                     size=int(rng.integers(lo, hi))).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- recorder
+
+
+def test_ring_is_bounded_and_keeps_newest():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.instant(f"e{i}", "t", "engine")
+    assert len(rec) == 4
+    assert rec.total_recorded == 10
+    assert [e.name for e in rec.events()] == ["e6", "e7", "e8", "e9"]
+    assert [e.name for e in rec.last(2)] == ["e8", "e9"]
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+def test_span_records_duration_and_survives_exceptions():
+    t = [0.0]
+
+    def clock():
+        t[0] += 1.0
+        return t[0]
+
+    rec = FlightRecorder(clock=clock)
+    with rec.span("ok", "t", "train"):
+        pass
+    with pytest.raises(RuntimeError):
+        with rec.span("boom", "t", "train"):
+            raise RuntimeError("x")
+    evs = rec.events()
+    assert [e.name for e in evs] == ["ok", "boom"]
+    assert all(e.ph == "X" and e.dur == 1.0 for e in evs)
+
+
+def test_recorder_is_thread_safe():
+    rec = FlightRecorder(capacity=10_000)
+
+    def work(k):
+        for i in range(500):
+            rec.instant(f"t{k}", "t", "engine", i=i)
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert rec.total_recorded == 2000
+    assert len(rec) == 2000
+
+
+def test_chrome_export_structure(tmp_path):
+    rec = FlightRecorder()
+    rec.complete("queue", "request", "queue", ts=1.0, dur=0.5, req=7)
+    rec.complete("prefill", "request", "slot1", ts=1.5, dur=0.25, req=7)
+    rec.instant("finish", "request", "slot1", ts=2.0, req=7, reason="eos")
+    rec.counter("queue_depth", "engine", "engine", ts=1.0, depth=3)
+    path = rec.export_chrome(str(tmp_path / "t.json"))
+    obj = json.load(open(path))
+    evs = obj["traceEvents"]
+    # thread-name metadata for every track, in display-sort order
+    names = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert names == {"engine", "queue", "slot1"}
+    # timestamps are relative microseconds
+    q = next(e for e in evs if e["ph"] == "X" and e["name"] == "queue")
+    assert q["ts"] == 0.0 and q["dur"] == 0.5e6
+    assert q["args"]["req"] == 7
+    # one flow per request: start + finish (2 spans + 1 instant -> s, t, f)
+    flows = [e for e in evs if e.get("cat") == "flow" and e.get("id") == 7]
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]
+    # counters carry their values
+    c = next(e for e in evs if e["ph"] == "C")
+    assert c["args"] == {"depth": 3}
+    assert load_chrome(path) == evs
+
+
+# ------------------------------------------------------------- anomalies
+
+
+def _mon(tmp_path, rec, **kw):
+    return AnomalyMonitor(rec, str(tmp_path / "anom.jsonl"),
+                          snapshot_fn=lambda: {"serve/steps": 1.0}, **kw)
+
+
+def _dumps(tmp_path):
+    p = tmp_path / "anom.jsonl"
+    if not p.exists():
+        return []
+    return [json.loads(line) for line in p.read_text().splitlines()]
+
+
+def test_anomaly_slow_step_uses_rolling_median(tmp_path):
+    rec = FlightRecorder()
+    rec.instant("ctx", "engine", "engine")
+    mon = _mon(tmp_path, rec, min_steps=4, slow_step_factor=5.0)
+    for _ in range(8):
+        mon.observe_step(0.01)
+    mon.observe_step(0.02)  # 2x: under the factor, no dump
+    assert mon.dumps == 0
+    mon.observe_step(0.2)  # 20x the median
+    assert mon.dumps == 1
+    (rec_d,) = _dumps(tmp_path)
+    assert rec_d["kind"] == "slow_step"
+    assert rec_d["detail"]["median_s"] == pytest.approx(0.01)
+    assert rec_d["metrics"] == {"serve/steps": 1.0}
+    assert [e["name"] for e in rec_d["events"]] == ["ctx"]
+
+
+def test_anomaly_reject_burst_fires_once_per_burst(tmp_path):
+    rec = FlightRecorder()
+    mon = _mon(tmp_path, rec, reject_burst=3)
+    for _ in range(5):  # one burst, even past the threshold
+        mon.observe_reject()
+    assert mon.dumps == 1
+    mon.observe_accept()  # reset
+    for _ in range(3):
+        mon.observe_reject()
+    assert mon.dumps == 2
+
+
+def test_anomaly_finish_reasons_and_dump_cap(tmp_path):
+    rec = FlightRecorder()
+    mon = _mon(tmp_path, rec, max_dumps=3)
+    mon.observe_finish("eos")
+    mon.observe_finish("length")
+    assert mon.dumps == 0
+    mon.observe_finish("timeout")
+    mon.observe_finish("cancelled")
+    assert mon.dumps == 2
+    for _ in range(10):
+        mon.observe_finish("timeout")
+    assert mon.dumps == 3  # bounded
+    assert len(_dumps(tmp_path)) == 3
+
+
+# ------------------------------------------------------ engine integration
+
+
+def test_engine_trace_off_is_absent(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(n_slots=2, max_len=64))
+    assert eng.trace is None and eng._mon is None
+    with pytest.raises(ValueError, match="needs trace=True"):
+        ServeEngine(model, params, ServeConfig(
+            n_slots=2, max_len=64, trace_dump_path="x.jsonl",
+        ))
+
+
+def test_traced_phases_partition_request_wall_time(gpt_tiny, tmp_path):
+    """Acceptance: phase durations from the exported trace sum to within
+    5% of each request's measured TTFT + decode wall time (they are exact
+    up to export rounding — same clock readings)."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    prompts = _prompts(6, seed=3)
+    handles = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    eng.run()
+    assert all(h.done for h in handles)
+    path = eng.trace.export_chrome(str(tmp_path / "serve.json"))
+    summary = summarize_trace(path)
+    assert summary["n_requests"] == len(handles)
+    assert summary["finish_reasons"] == {"length": len(handles)}
+    by_id = {r["req"]: r for r in summary["requests"]}
+    for h in handles:
+        r = by_id[h.id]
+        wall = h.finish_time - h.submit_time
+        ttft = h.first_token_time - h.submit_time
+        assert r["total_s"] == pytest.approx(wall, rel=0.05, abs=1e-5)
+        assert (r["phases"]["queue"] + r["phases"]["prefill"]
+                == pytest.approx(ttft, rel=0.05, abs=1e-5))
+        assert r["slot"] == f"slot{h.slot}"
+        assert r["tokens"] == len(h.tokens)
+    # instrumentation exists alongside the lifecycle spans
+    names = {e.name for e in eng.trace.events()}
+    assert {"submit", "step", "prefill_program", "decode_block",
+            "finish"} <= names
+    # the step spans carry batch composition
+    step_ev = next(e for e in eng.trace.events() if e.name == "step")
+    assert {"prefills", "decode_slots", "transfers",
+            "device_s"} <= set(step_ev.args)
+    out = format_summary(summary, top=3)
+    assert "slowest 3 requests" in out and "queue_s" in out
+
+
+def test_traced_streams_match_untraced(gpt_tiny):
+    """Tracing must be observationally invisible: same tokens either way."""
+    model, params = gpt_tiny
+    prompts = _prompts(4, seed=5)
+
+    def run(trace):
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=2, max_len=64, decode_block=4, bucket=8, trace=trace,
+        ))
+        hs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+        return [h.tokens for h in hs]
+
+    assert run(True) == run(False)
+
+
+def test_engine_anomaly_dump_on_queue_timeout(gpt_tiny, tmp_path):
+    model, params = gpt_tiny
+    dump = str(tmp_path / "anom.jsonl")
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, trace=True,
+        trace_dump_path=dump,
+    ))
+    blocker = eng.submit(_prompts(1, seed=6)[0], max_new_tokens=16)
+    doomed = eng.submit(_prompts(1, seed=7)[0], max_new_tokens=16,
+                        deadline_s=1e-6)
+    eng.run()
+    assert blocker.finish_reason == "length"
+    assert doomed.finish_reason == "timeout"
+    recs = [json.loads(line) for line in open(dump)]
+    kinds = [r["kind"] for r in recs]
+    assert "finish_timeout" in kinds
+    rec = recs[kinds.index("finish_timeout")]
+    assert rec["metrics"]["serve/finish_timeout"] == 1.0
+    assert any(e.get("name") == "finish" for e in rec["events"])
+
+
+def test_prefix_cache_and_scheduler_events(gpt_tiny, tmp_path):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=2, bucket=8, trace=True,
+        prefix_cache=True, prefix_page=4,
+    ))
+    stem = _prompts(1, seed=8, lo=16, hi=17)[0]
+    tails = _prompts(3, seed=9, lo=4, hi=5)
+    handles = [eng.submit(np.concatenate([stem, t]), max_new_tokens=4)
+               for t in tails]
+    eng.run()
+    assert all(h.done for h in handles)
+    names = [e.name for e in eng.trace.events()]
+    assert "prefix_lookup" in names
+    assert "prefix_snapshot" in names
+    # at least one hit-splice after the first request seeded the stem
+    splices = [e for e in eng.trace.events() if e.name == "splice"]
+    assert splices and all(e.args["matched"] > 0 for e in splices)
+    lookups = [e for e in eng.trace.events() if e.name == "prefix_lookup"]
+    assert len(lookups) == len(handles)
+    assert sum(e.args["hit"] for e in lookups) >= 1
+
+
+def test_idle_steps_are_not_traced_or_monitored(gpt_tiny, tmp_path):
+    """An external loop polling step() while idle must not spam the ring
+    or feed ~microsecond no-ops into the anomaly monitor's rolling
+    median (which would flag the first REAL step as a slow-step
+    anomaly and dump on every step after it)."""
+    model, params = gpt_tiny
+    dump = str(tmp_path / "anom.jsonl")
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8, trace=True,
+        trace_dump_path=dump,
+    ))
+    for _ in range(32):  # idle polling, past the monitor's min_steps
+        eng.step()
+    assert not any(e.name == "step" for e in eng.trace.events())
+    h = eng.submit(_prompts(1, seed=13)[0], max_new_tokens=8)
+    eng.run()
+    assert h.done
+    assert eng._mon.dumps == 0, "real step flagged as anomaly after idling"
+    steps = [e for e in eng.trace.events() if e.name == "step"]
+    assert steps, "working steps must still be traced"
+
+
+def test_summarize_tallies_rejects_separately(gpt_tiny):
+    """Rejected submissions never held a lane: they must not appear as
+    zero-phase request rows (indistinguishable from a served request the
+    ring lost) but as a separate tally."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, max_waiting=2,
+        trace=True,
+    ))
+    prompts = _prompts(3, seed=14)
+    handles = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    assert handles[2].state == "rejected"
+    eng.run()
+    summary = summarize_trace(eng.trace.to_chrome())
+    assert summary["n_requests"] == 2
+    assert summary["rejected"] == 1
+    assert handles[2].id not in {r["req"] for r in summary["requests"]}
+    assert "rejected submissions: 1" in format_summary(summary)
+
+
+def test_summarize_handles_unadmitted_requests(gpt_tiny, tmp_path):
+    """A request cancelled while waiting has only a queue phase; its
+    total is still finish - submit."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    h0 = eng.submit(_prompts(1, seed=10)[0], max_new_tokens=8)
+    h1 = eng.submit(_prompts(1, seed=11)[0], max_new_tokens=8)
+    eng.cancel(h1)
+    eng.run()
+    assert h1.finish_reason == "cancelled"
+    summary = summarize_trace(eng.trace.to_chrome())
+    r1 = next(r for r in summary["requests"] if r["req"] == h1.id)
+    assert set(r1["phases"]) == {"queue"}
+    wall = h1.finish_time - h1.submit_time
+    assert r1["total_s"] == pytest.approx(wall, rel=0.05, abs=1e-5)
+    assert r1["finish_reason"] == "cancelled"
+    assert h0.done
+
+
+def test_events_to_chrome_empty():
+    assert events_to_chrome([]) == {"traceEvents": [],
+                                    "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------------- cli
+
+
+def test_cli_trace_summary_roundtrip(gpt_tiny, tmp_path, capsys):
+    from solvingpapers_tpu.cli import main as cli_main
+
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    for p in _prompts(3, seed=12):
+        eng.submit(p, max_new_tokens=6)
+    eng.run()
+    path = eng.trace.export_chrome(str(tmp_path / "t.json"))
+    assert cli_main(["trace-summary", path, "--top", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "requests: 3" in out and "slowest 2 requests" in out
+    # missing file and traceless JSON fail loudly
+    assert cli_main(["trace-summary", str(tmp_path / "nope.json")]) == 2
+    empty = tmp_path / "empty.json"
+    empty.write_text('{"traceEvents": []}')
+    assert cli_main(["trace-summary", str(empty)]) == 1
+
+
+# ----------------------------------------------------------------- train
+
+
+def test_train_trace_spans_and_goodput(tmp_path, capsys):
+    from solvingpapers_tpu.train import Trainer
+    from solvingpapers_tpu.train.engine import TrainConfig
+
+    cfg = GPTConfig(vocab_size=32, block_size=16, dim=16, n_layers=1,
+                    n_heads=2, dropout=0.0)
+    model = GPT(cfg)
+
+    def batches():
+        rng = np.random.default_rng(0)
+        while True:
+            # batch divisible by the conftest's 8-virtual-device data mesh
+            x = rng.integers(0, 32, size=(8, 16)).astype(np.int32)
+            yield {"x": jnp.asarray(x), "y": jnp.asarray(x)}
+
+    path = str(tmp_path / "train.json")
+    tc = TrainConfig(steps=4, batch_size=8, log_every=2, eval_every=2,
+                     eval_batches=1, trace_path=path)
+    Trainer(model, tc).fit(batches(), eval_iter_fn=lambda: batches())
+    evs = load_chrome(path)
+    names = [e["name"] for e in evs if e.get("ph") in ("X", "i")]
+    assert names.count("step") == 4
+    assert "data_wait" in names and "eval" in names
+    (gp,) = [e for e in evs if e.get("name") == "goodput"]
+    assert 0 < gp["args"]["goodput"] <= 1
+    assert gp["args"]["step_s"] <= gp["args"]["wall_s"]
+    # the first (compile) step is tagged and excluded from goodput's
+    # numerator — compile-dominated runs must read as LOW goodput
+    steps = [e for e in evs if e.get("name") == "step"]
+    assert [e["args"]["compiled"] for e in steps] == [1, 0, 0, 0]
+    counted = sum(e["dur"] / 1e6 for e in steps if not e["args"]["compiled"])
+    assert gp["args"]["step_s"] == pytest.approx(counted, rel=0.01)
+    # trace-summary understands train traces too (its --help promises it)
+    from solvingpapers_tpu.cli import main as cli_main
+
+    assert cli_main(["trace-summary", path]) == 0
+    out = capsys.readouterr().out
+    assert "train trace" in out and "goodput" in out and "data_wait" in out

@@ -17,6 +17,11 @@ overwrites it with the Poisson entry; re-run with
 (cache-on vs cache-off TTFT over K shared system prompts) and with
 `--sampling --append` for the per-request-sampling workload (mixed
 temperature/top-p/top-k/min-p vs all-greedy on the same trace).
+
+Add `--trace` to any workload to run one extra flight-recorded arm: the
+entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
+same arrival trace — the tracer's < 2% budget), and `--trace-out` gets
+the Chrome trace-event JSON for Perfetto / `cli trace-summary`.
 """
 
 from __future__ import annotations
