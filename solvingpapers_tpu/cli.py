@@ -421,6 +421,9 @@ def cmd_serve_bench(args) -> int:
         trace=args.trace,
         trace_out=args.trace_out if args.trace else None,
         trace_dump=args.trace_dump if args.trace else None,
+        obs=args.obs,
+        status_port=args.status_port,
+        status_hold_s=args.status_hold_s,
     )
     if args.sampling:
         result = run_sampling_bench(
@@ -489,13 +492,46 @@ def cmd_trace_summary(args) -> int:
     if not os.path.exists(args.trace):
         print(f"no trace file at {args.trace}", file=sys.stderr)
         return 2
-    summary = summarize_trace(args.trace)
+    try:
+        summary = summarize_trace(args.trace)
+    except json.JSONDecodeError as e:
+        # truncated exports (a killed run mid-write) and non-JSON files
+        # are operator input errors, not tracebacks: say what and where
+        print(
+            f"{args.trace} is not valid JSON (truncated export?): "
+            f"{e.msg} at line {e.lineno} column {e.colno}",
+            file=sys.stderr,
+        )
+        return 2
+    except (ValueError, TypeError, AttributeError, KeyError) as e:
+        print(
+            f"{args.trace} does not parse as a Chrome trace-event JSON "
+            f"({type(e).__name__}: {e}) — expected the flight recorder's "
+            "export format",
+            file=sys.stderr,
+        )
+        return 2
+    except OSError as e:
+        print(f"cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
     if summary["n_requests"] or summary["rejected"]:
         print(format_summary(summary, top=args.top))
         return 0
+    # request-less traces: a train trace keeps its per-phase summary even
+    # when the observatory also recorded compile events — the roofline
+    # table rides along instead of displacing it
+    from solvingpapers_tpu.metrics.trace import format_roofline
+
     train = summarize_train_trace(args.trace)
+    roofline = format_roofline(summary.get("programs") or {})
     if train is not None:
         print(format_train_summary(train))
+        if roofline:
+            print()
+            print(roofline)
+        return 0
+    if roofline:
+        print(roofline)
         return 0
     print(
         f"{args.trace} holds neither request lifecycle events "
@@ -706,6 +742,23 @@ def main(argv=None) -> int:
                               "(ServeConfig.trace_dump_path): timeouts, "
                               "reject bursts, and slow steps append the "
                               "last ring events + a metrics snapshot")
+    p_serve.add_argument("--obs", action="store_true",
+                         help="run one extra paired arm with the compile "
+                              "& memory observatory on "
+                              "(ServeConfig.xla_obs) and record "
+                              "obs_overhead_pct (enabled-vs-disabled "
+                              "req/s, < 2%% budget); compile_time_s and "
+                              "peak_hbm_bytes are recorded per entry "
+                              "regardless, from the warm-phase probe")
+    p_serve.add_argument("--status-port", type=int, default=None,
+                         help="serve /healthz /metrics /statusz from the "
+                              "observatory probe engine for the duration "
+                              "of the bench (0 = ephemeral port, printed "
+                              "to stderr)")
+    p_serve.add_argument("--status-hold-s", type=float, default=0.0,
+                         help="[--status-port] keep the status endpoint "
+                              "up this many seconds after the arms "
+                              "finish (CI curl window)")
 
     p_tsum = sub.add_parser("trace-summary")
     p_tsum.add_argument("trace",

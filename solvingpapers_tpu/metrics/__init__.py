@@ -24,3 +24,10 @@ from solvingpapers_tpu.metrics.mfu import (
     mfu,
     active_param_count,
 )
+from solvingpapers_tpu.metrics.xla_obs import (
+    CompileRegistry,
+    HBMLedger,
+    device_capacity_bytes,
+    pytree_bytes,
+)
+from solvingpapers_tpu.metrics.http import StatusServer

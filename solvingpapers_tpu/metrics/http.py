@@ -1,0 +1,128 @@
+"""Live status endpoint: /healthz, /metrics, /statusz over stdlib HTTP.
+
+A running engine (serve or train) is otherwise a black box unless a
+tracer was attached before launch; this module gives it the vLLM-style
+first-line inspection surface with zero dependencies:
+
+    /healthz   200 "ok" while the server thread is alive (the probe a
+               load balancer or CI smoke polls)
+    /metrics   the current metric snapshot in Prometheus text exposition
+               format — the exact same rendering `PrometheusTextWriter`
+               writes to textfiles (`PrometheusTextWriter.render`), so
+               names and dedupe rules cannot drift between the pull and
+               push paths
+    /statusz   one JSON document: engine snapshot, slot occupancy,
+               compile registry, memory ledger — whatever the owner's
+               `statusz_fn` assembles
+
+`StatusServer` is a `ThreadingHTTPServer` on a daemon thread bound to
+127.0.0.1 by default (inspection surface, not an API — front it with a
+real proxy to expose it). Providers are zero-arg callables resolved per
+request, so responses always reflect live state; a provider that raises
+returns a 500 with the error text instead of killing the serving loop.
+Opt-in via `ServeConfig.status_port` / `TrainConfig.status_port`
+(port 0 binds an ephemeral port, published as `server.port`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+
+
+class StatusServer:
+    """Serve /healthz, /metrics, /statusz from live provider callables.
+
+    `statusz_fn() -> dict` builds the JSON status document;
+    `metrics_fn() -> (step, {name: value})` feeds the Prometheus text
+    rendering. Both run on the request thread — keep them snapshot-cheap
+    (the engines' providers read host-side mirrors, never the device).
+    """
+
+    def __init__(
+        self,
+        statusz_fn: Callable[[], dict],
+        metrics_fn: Callable[[], tuple[int, dict]],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        prefix: str = "",
+    ):
+        self.statusz_fn = statusz_fn
+        self.metrics_fn = metrics_fn
+        self.prefix = prefix
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: A003 — silence
+                pass  # per-request stderr spam would drown engine logs
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send(200, "ok\n", "text/plain")
+                    elif path == "/metrics":
+                        step, metrics = server.metrics_fn()
+                        self._send(
+                            200,
+                            PrometheusTextWriter.render(
+                                step, metrics, prefix=server.prefix
+                            ),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/statusz":
+                        self._send(
+                            200,
+                            json.dumps(server.statusz_fn(), default=str)
+                            + "\n",
+                            "application/json",
+                        )
+                    else:
+                        self._send(
+                            404,
+                            "not found — try /healthz, /metrics, "
+                            "/statusz\n",
+                            "text/plain",
+                        )
+                except BrokenPipeError:  # client went away mid-write
+                    pass
+                except Exception as e:  # noqa: BLE001 — a bad provider
+                    # must answer 500, not kill the handler thread
+                    try:
+                        self._send(500, f"{type(e).__name__}: {e}\n",
+                                   "text/plain")
+                    except BrokenPipeError:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="statusz", daemon=True
+        )
+        self._thread.start()
+
+    def url(self, path: str = "/statusz") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        """Stop serving and release the port (idempotent)."""
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        self._httpd = None

@@ -8,6 +8,7 @@ shared + non-expert) parameter count, not the total.
 
 from __future__ import annotations
 
+import math
 import warnings
 
 import jax
@@ -27,22 +28,30 @@ _warned_kinds: set[str] = set()
 
 
 def chip_peak_flops(device=None) -> float:
+    """bf16 peak FLOP/s for `device`, or NaN when the chip is unknown.
+
+    NaN is a deliberate sentinel: CPU hosts and unrecognized backends
+    have no table entry, and the old conservative-default behavior
+    (assume v5e) silently mis-scaled every downstream MFU number —
+    garbage that looked plausible. NaN instead propagates visibly
+    through `mfu()` and lets callers gate (`math.isfinite`) the gauge
+    out entirely, which every consumer in this repo now does."""
     device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "").lower()
+    kind = str(getattr(device, "device_kind", "") or "").lower()
     for key, val in _PEAK_TFLOPS.items():
         if key in kind:
             return val
-    # unknown device: a silent wrong peak would silently mis-scale every
-    # MFU number, so say which kind fell through and what was assumed
+    # unknown device: warn once per kind so the absent-MFU mystery is
+    # self-explaining, then return the sentinel
     if kind not in _warned_kinds:
         _warned_kinds.add(kind)
         warnings.warn(
-            f"chip_peak_flops: unrecognized device_kind {kind!r}; assuming "
-            "v5e peak (197 TFLOP/s bf16) — MFU numbers will be mis-scaled "
-            "if this is a different chip",
+            f"chip_peak_flops: unrecognized device_kind {kind!r}; "
+            "returning NaN — MFU gauges will be omitted rather than "
+            "mis-scaled (extend metrics.mfu._PEAK_TFLOPS for new chips)",
             stacklevel=2,
         )
-    return 197e12  # conservative default: v5e
+    return float("nan")
 
 
 def transformer_flops_per_token(
@@ -55,8 +64,14 @@ def transformer_flops_per_token(
 
 
 def mfu(tokens_per_sec: float, flops_per_token: float, n_chips: int = 1, device=None) -> float:
+    """Model FLOP utilization, or NaN when it cannot be computed
+    honestly (unknown chip peak, non-finite inputs, zero peak) — NaN
+    never raises and never masquerades as a real utilization."""
     peak = chip_peak_flops(device) * n_chips
-    return tokens_per_sec * flops_per_token / peak
+    achieved = tokens_per_sec * flops_per_token
+    if not (math.isfinite(peak) and peak > 0 and math.isfinite(achieved)):
+        return float("nan")
+    return achieved / peak
 
 
 def active_param_count(params, top_experts: int | None = None, n_experts: int | None = None) -> int:

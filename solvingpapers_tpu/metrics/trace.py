@@ -326,6 +326,15 @@ class AnomalyMonitor:
         if reason in ("timeout", "cancelled"):
             self.dump(f"finish_{reason}")
 
+    def observe_recompile(self, program: str, new_signatures: int,
+                          window_s: float) -> None:
+        """A recompile storm (metrics/xla_obs.py CompileRegistry: same
+        program, >= storm_k NEW signatures inside the window) — dump the
+        ring so the post-mortem shows WHICH requests carried the
+        un-bucketed shapes that forced the compiles."""
+        self.dump("recompile_storm", program=program,
+                  new_signatures=new_signatures, window_s=window_s)
+
     def dump(self, kind: str, **detail) -> None:
         if self.dumps >= self.max_dumps:
             return
@@ -507,7 +516,85 @@ def summarize_trace(trace) -> dict:
         "rejected": rejected,
         "finish_reasons": finish_reasons,
         "phase_totals_s": phase_totals,
+        "programs": _program_roofline(events),
     }
+
+
+def _program_roofline(events: list[dict]) -> dict:
+    """Join the compile registry's `compile` instants (cat "xla",
+    carrying cost_analysis flops/bytes per program — recorded when the
+    engine runs with BOTH `trace` and `xla_obs` on) against the measured
+    per-program spans sharing the program's name, yielding the offline
+    per-program roofline: achieved FLOP/s, arithmetic intensity, and —
+    when the recording host knew its chip peak — MFU. Empty dict when
+    the trace holds no compile events (plain PR-4 traces summarize
+    unchanged)."""
+    compiles: dict[str, dict] = {}
+    for e in events:
+        if e.get("cat") != "xla" or e.get("name") != "compile":
+            continue
+        args = e.get("args") or {}
+        prog = args.get("program")
+        if not prog:
+            continue
+        d = compiles.setdefault(prog, {
+            "compilations": 0, "compile_time_s": 0.0, "flops_per_call": 0.0,
+            "bytes_per_call": 0.0, "peak_flops": None,
+        })
+        d["compilations"] += 1
+        # cached=1 events carry the ORIGINAL executable's compile time
+        # (served from the process-global cache — this run compiled
+        # nothing), so only cold compiles count toward the wall total,
+        # matching the live registry's compile/time_s
+        if not args.get("cached"):
+            d["compile_time_s"] += args.get("compile_s", 0.0)
+        # signatures differ in cost; keep the largest as the per-call
+        # bound (the engine's steady-state program for that name)
+        d["flops_per_call"] = max(d["flops_per_call"],
+                                  args.get("flops", 0.0))
+        d["bytes_per_call"] = max(d["bytes_per_call"],
+                                  args.get("bytes", 0.0))
+        if args.get("peak_flops"):
+            d["peak_flops"] = args["peak_flops"]
+    if not compiles:
+        return {}
+    # one fused decode program advances every lane together, and the
+    # engine stamps one span PER ACTIVE SLOT sharing the program's wall
+    # time (same ts, same dur) — dedupe by (name, ts) so a program call
+    # counts once, matching the live registry's calls/run seconds
+    seen: set = set()
+    for e in events:
+        if e.get("ph") != "X" or e.get("name") not in compiles:
+            continue
+        key = (e["name"], e.get("ts"))
+        if key in seen:
+            continue
+        seen.add(key)
+        d = compiles[e["name"]]
+        d["calls"] = d.get("calls", 0) + 1
+        d["total_s"] = d.get("total_s", 0.0) + e.get("dur", 0.0) / 1e6
+    out = {}
+    for prog, d in compiles.items():
+        calls, total_s = d.get("calls", 0), d.get("total_s", 0.0)
+        row = {
+            "compilations": d["compilations"],
+            "compile_time_s": round(d["compile_time_s"], 6),
+            "calls": calls,
+            "total_s": round(total_s, 6),
+            "flops_per_call": d["flops_per_call"],
+            "bytes_per_call": d["bytes_per_call"],
+        }
+        if calls and total_s > 0 and d["flops_per_call"] > 0:
+            achieved = d["flops_per_call"] * calls / total_s
+            row["achieved_flops_per_s"] = achieved
+            if d["bytes_per_call"] > 0:
+                row["intensity_flops_per_byte"] = (
+                    d["flops_per_call"] / d["bytes_per_call"]
+                )
+            if d["peak_flops"]:
+                row["mfu"] = achieved / d["peak_flops"]
+        out[prog] = row
+    return out
 
 
 def format_summary(summary: dict, top: int = 5) -> str:
@@ -542,5 +629,38 @@ def format_summary(summary: dict, top: int = 5) -> str:
             f"{ph.get('queue', 0.0):>9.4f} {ph.get('prefill', 0.0):>9.4f} "
             f"{ph.get('decode', 0.0):>9.4f} {str(r['slot'] or '-'):>6}  "
             f"{r['finish_reason'] or '-'}"
+        )
+    roofline = format_roofline(summary.get("programs") or {})
+    if roofline:
+        lines.append("")
+        lines.append(roofline)
+    return "\n".join(lines)
+
+
+def format_roofline(programs: dict) -> str:
+    """Human-readable per-program roofline table (the `programs` section
+    of `summarize_trace`), or "" when the trace held no compile events.
+    Programs with no same-named measured span (splice/extract/train
+    programs — their spans aggregate multiple calls under other names)
+    show compile info with '-' for the measured columns."""
+    if not programs:
+        return ""
+    lines = ["per-program roofline (compile registry x measured spans):"]
+    lines.append(
+        f"  {'program':<18} {'calls':>6} {'total_s':>9} "
+        f"{'compile_s':>10} {'GFLOP/s':>9} {'flops/B':>8} {'mfu':>7}"
+    )
+    for prog, d in sorted(programs.items(),
+                          key=lambda kv: -kv[1].get("total_s", 0.0)):
+        gflops = d.get("achieved_flops_per_s")
+        inten = d.get("intensity_flops_per_byte")
+        mfu_v = d.get("mfu")
+        lines.append(
+            f"  {prog:<18} {d.get('calls', 0):>6} "
+            f"{d.get('total_s', 0.0):>9.4f} "
+            f"{d['compile_time_s']:>10.4f} "
+            f"{(f'{gflops / 1e9:.2f}' if gflops else '-'):>9} "
+            f"{(f'{inten:.2f}' if inten else '-'):>8} "
+            f"{(f'{mfu_v:.4f}' if mfu_v is not None else '-'):>7}"
         )
     return "\n".join(lines)

@@ -175,24 +175,34 @@ class PrometheusTextWriter(MetricsWriter):
             return "-Inf"
         return repr(float(v))
 
-    def write(self, step: int, metrics: Mapping[str, float]) -> None:
-        # dedupe by SANITIZED name (last write wins): two keys that
-        # collapse to one name ("serve/ttft" vs "serve.ttft") would emit
-        # the same series twice, and the textfile collector rejects the
-        # ENTIRE file on a duplicate — one colliding key must not blind
-        # every dashboard. The `last_step` staleness rider yields to a
-        # user metric of the same name for the same reason.
+    @classmethod
+    def render(cls, step: int, metrics: Mapping[str, float],
+               prefix: str = "") -> str:
+        """The exposition-format text for one metric set — shared by the
+        textfile `write()` path and the live `/metrics` HTTP endpoint
+        (metrics/http.py), so names and dedupe rules cannot drift.
+
+        Dedupes by SANITIZED name (last write wins): two keys that
+        collapse to one name ("serve/ttft" vs "serve.ttft") would emit
+        the same series twice, and the textfile collector rejects the
+        ENTIRE file on a duplicate — one colliding key must not blind
+        every dashboard. The `last_step` staleness rider yields to a
+        user metric of the same name for the same reason.
+        """
         gauges: dict[str, str] = {}
         for k, v in metrics.items():
-            gauges[self.prefix + self.sanitize(k)] = self._fmt(float(v))
-        gauges.setdefault(f"{self.prefix}last_step", str(int(step)))
+            gauges[prefix + cls.sanitize(k)] = cls._fmt(float(v))
+        gauges.setdefault(f"{prefix}last_step", str(int(step)))
         lines = []
         for name, value in gauges.items():
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {value}")
+        return "\n".join(lines) + "\n"
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write(self.render(step, metrics, prefix=self.prefix))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)

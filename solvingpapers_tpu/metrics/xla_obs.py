@@ -1,0 +1,539 @@
+"""Compile & memory observatory: XLA compile registry, HBM ledger,
+per-program roofline.
+
+The flight recorder (metrics/trace.py) answers "where did the wall clock
+go"; this module answers the device/compiler-side questions production
+serving stacks triage capacity and latency regressions with:
+
+* `CompileRegistry` — every jitted program the engines run is routed
+  through an ahead-of-time signature cache: a call whose abstract
+  signature (static args + dynamic shapes/dtypes) was never seen lowers
+  and compiles explicitly (`jit(f).lower(...).compile()`), so the
+  registry records the TRUE compile wall time plus the executable's
+  `cost_analysis()` flops / bytes-accessed and `memory_analysis()` temp
+  bytes — and subsequent calls dispatch the cached executable directly.
+  A **recompile storm** (same program, >= `storm_k` new signatures
+  inside `storm_window_s`) is the classic silent latency killer (a shape
+  that never buckets, a stray weak_type flip); the registry counts it,
+  warns once per program, and — when the engine's `AnomalyMonitor` is
+  armed — dumps the flight-recorder ring through
+  `AnomalyMonitor.observe_recompile`.
+
+  Compiled executables are shared process-wide (`_AOT_CACHE`, the moral
+  equivalent of jax's own jit cache) so a warmed benchmark arm or a
+  second engine over the same model does not pay compilation twice;
+  per-registry stats (calls, run seconds, signature misses) stay local
+  so each engine reports its own view.
+
+* `HBMLedger` — named live-byte pools (`params`, `kv_pool`,
+  `prefix_cache`, `opt_state`, ...) registered as zero-arg providers and
+  read lazily, plus the registry's max per-program temp bytes, give a
+  projected decode-step peak; against the device capacity
+  (`memory_stats()["bytes_limit"]` where the backend reports it, or an
+  explicit override) the ledger computes headroom and warns BEFORE the
+  projected peak exceeds capacity — the admission-control signal, not
+  the OOM post-mortem.
+
+* roofline — joining cost_analysis flops/bytes with the registry's
+  measured per-program run seconds yields achieved FLOP/s, arithmetic
+  intensity (flops / byte), and per-program MFU against
+  `metrics.mfu.chip_peak_flops` (NaN-safe: unknown backends simply omit
+  the MFU gauge). The same join is available offline from an exported
+  trace via `metrics.trace.summarize_trace` (the registry emits one
+  `compile` event per compilation when a recorder is attached).
+
+Everything is opt-in (`ServeConfig.xla_obs` / `TrainConfig.xla_obs`);
+with it off the engines never import this module and every hook site is
+a single `is not None` branch. With it on, program calls are fenced
+(`block_until_ready`) so run seconds are device-true — the same
+observability-mode contract as flight-recorder tracing, held to the
+same paired-bench overhead budget (`obs_overhead_pct` in
+BENCH_serve.json).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Callable
+
+import jax
+
+from solvingpapers_tpu.metrics.mfu import chip_peak_flops
+from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+
+
+def pytree_bytes(tree) -> int:
+    """Total bytes of every array leaf in a pytree (device or host)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = getattr(leaf, "size", None)
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if size is not None and itemsize is not None:
+            total += int(size) * int(itemsize)
+    return total
+
+
+def device_capacity_bytes(device=None) -> int | None:
+    """Device memory capacity, or None where the backend does not report
+    it (CPU: `memory_stats()` is None — the ledger then omits headroom
+    gauges instead of inventing a number)."""
+    device = device or jax.devices()[0]
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        stats = stats_fn()
+    except Exception:  # backend quirk: absent beats a crashed gauge read
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+# process-global executable cache: (id(jitted), statics, dynamic avals)
+# -> _Executable. `jitted` is kept alive by the entry itself (strong ref)
+# so an id() can never be recycled onto a different function while its
+# executables are cached.
+_AOT_CACHE: dict[tuple, "_Executable"] = {}
+_AOT_LOCK = threading.Lock()
+
+
+def clear_aot_cache() -> None:
+    """Drop every cached executable (tests that must observe true
+    compiles call this first; production code never needs to)."""
+    with _AOT_LOCK:
+        _AOT_CACHE.clear()
+
+
+class _Executable:
+    """One compiled program variant + its compile-time analyses."""
+
+    __slots__ = ("compiled", "jitted", "compile_s", "flops",
+                 "bytes_accessed", "temp_bytes", "arg_bytes", "out_bytes")
+
+    def __init__(self, compiled, jitted, compile_s: float):
+        self.compiled = compiled
+        self.jitted = jitted  # strong ref: pins id(jitted) while cached
+        self.compile_s = compile_s
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
+        self.temp_bytes = 0
+        self.arg_bytes = 0
+        self.out_bytes = 0
+        try:
+            ca = compiled.cost_analysis()
+            d = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+            self.flops = float(d.get("flops", 0.0))
+            self.bytes_accessed = float(d.get("bytes accessed", 0.0))
+        except Exception:
+            pass  # not every backend implements cost_analysis
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                self.temp_bytes = int(ma.temp_size_in_bytes)
+                self.arg_bytes = int(ma.argument_size_in_bytes)
+                self.out_bytes = int(ma.output_size_in_bytes)
+        except Exception:
+            pass  # memory_analysis is backend-dependent
+
+
+class _SigStats:
+    """Per-registry stats for one (program, signature) variant."""
+
+    __slots__ = ("exe", "calls", "run_s", "cached")
+
+    def __init__(self, exe: _Executable, cached: bool):
+        self.exe = exe
+        self.calls = 0
+        self.run_s = 0.0
+        self.cached = cached  # served from the process-global cache
+
+
+class _ProgramStats:
+    """Per-registry stats for one named program across its signatures."""
+
+    __slots__ = ("name", "signatures", "compile_s", "compiles", "cached",
+                 "miss_stamps", "storms", "storm_warned", "in_storm")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.signatures: dict[Any, _SigStats] = {}
+        self.compile_s = 0.0  # true XLA compiles this registry triggered
+        self.compiles = 0  # signature misses (new program variants seen)
+        self.cached = 0  # misses served by the process-global cache
+        self.miss_stamps: deque[float] = deque(maxlen=64)
+        self.storms = 0  # storm EPISODES (below-k -> at-k transitions)
+        self.storm_warned = False
+        self.in_storm = False
+
+    @property
+    def calls(self) -> int:
+        return sum(s.calls for s in self.signatures.values())
+
+    @property
+    def run_s(self) -> float:
+        return sum(s.run_s for s in self.signatures.values())
+
+    def weighted_flops(self) -> float:
+        return sum(s.exe.flops * s.calls for s in self.signatures.values())
+
+    def weighted_bytes(self) -> float:
+        return sum(
+            s.exe.bytes_accessed * s.calls for s in self.signatures.values()
+        )
+
+
+class CompileRegistry:
+    """Signature-keyed AOT dispatch + compile/roofline accounting.
+
+    `call(program, key, jitted, args, static_argnums)` is the single
+    entry point: `key` is a CHEAP hashable the call site derives from
+    what actually varies (e.g. the prefill bucket's `(padded, chunk,
+    start)`) so the hot path never hashes a parameter pytree; the full
+    abstract signature is only computed on a registry-level miss, to key
+    the process-global executable cache safely across engines whose
+    cheap keys collide (two engines over different models share the same
+    module-level jitted function).
+
+    `time_programs=True` (default) fences every dispatch so per-program
+    run seconds — the roofline denominator — are device wall time, not
+    dispatch time. Observability mode, same contract as tracing.
+    """
+
+    def __init__(
+        self,
+        trace=None,
+        monitor=None,
+        storm_k: int = 8,
+        storm_window_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+        time_programs: bool = True,
+    ):
+        if storm_k < 2:
+            raise ValueError(f"storm_k must be >= 2, got {storm_k}")
+        if storm_window_s <= 0:
+            raise ValueError(
+                f"storm_window_s must be > 0, got {storm_window_s}"
+            )
+        self.trace = trace  # metrics.trace.FlightRecorder | None
+        self.monitor = monitor  # metrics.trace.AnomalyMonitor | None
+        self.storm_k = storm_k
+        self.storm_window_s = storm_window_s
+        self.clock = clock
+        self.time_programs = time_programs
+        self._programs: dict[str, _ProgramStats] = {}
+        self._lock = threading.Lock()
+        # chip peak for per-program MFU; NaN on backends without a table
+        # entry (metrics/mfu.py) — MFU gauges are omitted, never garbage
+        self.peak_flops = chip_peak_flops()
+
+    # ------------------------------------------------------------ dispatch
+
+    def call(self, program: str, key, jitted, args: tuple,
+             static_argnums: tuple = ()):
+        """Run `jitted(*args)` through the registry: compile-on-new-
+        signature (recorded), then dispatch the cached executable with
+        the static args stripped (the AOT calling convention)."""
+        st = self._programs.get(program)
+        if st is None:
+            with self._lock:
+                st = self._programs.setdefault(program,
+                                               _ProgramStats(program))
+        sig = st.signatures.get(key)
+        if sig is None:
+            sig = self._admit(program, st, key, jitted, args, static_argnums)
+        if static_argnums:
+            dyn = tuple(a for i, a in enumerate(args)
+                        if i not in static_argnums)
+        else:
+            dyn = args
+        t0 = self.clock()
+        out = sig.exe.compiled(*dyn)
+        if self.time_programs:
+            out = jax.block_until_ready(out)
+            sig.run_s += self.clock() - t0
+        sig.calls += 1
+        return out
+
+    def _admit(self, program: str, st: _ProgramStats, key, jitted,
+               args: tuple, static_argnums: tuple) -> _SigStats:
+        """Registry-level signature miss: resolve (or build) the
+        executable, record the compilation, check for a storm."""
+        statics = tuple(args[i] for i in static_argnums)
+        avals = tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for i, a in enumerate(args) if i not in static_argnums
+            for leaf in jax.tree_util.tree_leaves(a)
+        )
+        global_key = (id(jitted), statics, avals)
+        with _AOT_LOCK:
+            exe = _AOT_CACHE.get(global_key)
+        cached = exe is not None
+        if exe is None:
+            lowered = jitted.lower(*args)
+            t0 = self.clock()
+            compiled = lowered.compile()
+            exe = _Executable(compiled, jitted, self.clock() - t0)
+            with _AOT_LOCK:
+                exe = _AOT_CACHE.setdefault(global_key, exe)
+        sig = _SigStats(exe, cached)
+        with self._lock:
+            st.signatures[key] = sig
+            st.compiles += 1
+            if cached:
+                st.cached += 1
+            else:
+                st.compile_s += exe.compile_s
+            now = self.clock()
+            st.miss_stamps.append(now)
+            while st.miss_stamps and now - st.miss_stamps[0] > \
+                    self.storm_window_s:
+                st.miss_stamps.popleft()
+            over = len(st.miss_stamps) >= self.storm_k
+            # fire once per EPISODE (the below-k -> at-k transition): a
+            # sustained storm stays over the threshold for every further
+            # miss, and re-dumping per miss would both spam an fsync'd
+            # multi-KB record onto the compile path and exhaust the
+            # AnomalyMonitor's shared max_dumps budget, silencing later
+            # timeout/reject anomalies in the same run
+            storm = over and not st.in_storm
+            st.in_storm = over
+            if storm:
+                st.storms += 1
+        if self.trace is not None:
+            ev = dict(
+                program=program, signature=str(key),
+                compile_s=round(exe.compile_s, 6), flops=exe.flops,
+                bytes=exe.bytes_accessed, temp_bytes=exe.temp_bytes,
+                cached=int(cached),
+            )
+            if math.isfinite(self.peak_flops):
+                ev["peak_flops"] = self.peak_flops
+            self.trace.instant("compile", "xla", "xla", **ev)
+        if storm:
+            if not st.storm_warned:
+                st.storm_warned = True
+                warnings.warn(
+                    f"recompile storm: program {program!r} saw "
+                    f"{len(st.miss_stamps)} new signatures within "
+                    f"{self.storm_window_s:g}s — shape bucketing is not "
+                    "holding, every miss pays a fresh XLA compile",
+                    stacklevel=3,
+                )
+            if self.monitor is not None:
+                self.monitor.observe_recompile(
+                    program, new_signatures=len(st.miss_stamps),
+                    window_s=self.storm_window_s,
+                )
+        return sig
+
+    # ------------------------------------------------------------- reading
+
+    def max_temp_bytes(self) -> int:
+        """Largest per-program XLA temp allocation seen — the scratch the
+        ledger adds on top of live pools for the projected peak."""
+        with self._lock:
+            return max(
+                (s.exe.temp_bytes
+                 for st in self._programs.values()
+                 for s in st.signatures.values()),
+                default=0,
+            )
+
+    @property
+    def total_compile_s(self) -> float:
+        with self._lock:
+            return sum(st.compile_s for st in self._programs.values())
+
+    def gauges(self) -> dict[str, float]:
+        """Flat `compile/*` + `roofline/*` metric keys (ServeMetrics
+        gauge-provider / train log-row shape). The whole read holds the
+        registry lock: gauge requests arrive from the status server's
+        threads while the engine thread may be inserting a new signature
+        (`_admit`), and iterating the signatures dict during that insert
+        would raise mid-scrape."""
+        with self._lock:
+            progs = list(self._programs.values())
+            out = {
+                "compile/programs": float(len(progs)),
+                "compile/compilations": float(
+                    sum(p.compiles for p in progs)
+                ),
+                "compile/cached": float(sum(p.cached for p in progs)),
+                "compile/recompiles": float(
+                    sum(max(p.compiles - 1, 0) for p in progs)
+                ),
+                "compile/storms": float(sum(p.storms for p in progs)),
+                "compile/time_s": float(sum(p.compile_s for p in progs)),
+            }
+            for p in progs:
+                run_s = p.run_s
+                if run_s <= 0.0 or not p.calls:
+                    continue
+                name = PrometheusTextWriter.sanitize(p.name)
+                flops = p.weighted_flops()
+                nbytes = p.weighted_bytes()
+                achieved = flops / run_s
+                out[f"roofline/{name}_flops_per_s"] = achieved
+                if nbytes > 0:
+                    out[f"roofline/{name}_intensity"] = flops / nbytes
+                if math.isfinite(self.peak_flops) and self.peak_flops > 0 \
+                        and flops > 0:
+                    out[f"roofline/{name}_mfu"] = achieved / self.peak_flops
+        return out
+
+    def snapshot(self) -> dict:
+        """Structured view for /statusz: per-program signature counts,
+        compile seconds, calls, run seconds, and the roofline join.
+        Built entirely under the lock — see `gauges`."""
+        with self._lock:
+            progs = {
+                name: {
+                    "signatures": len(st.signatures),
+                    "compilations": st.compiles,
+                    "cached": st.cached,
+                    "compile_time_s": round(st.compile_s, 6),
+                    "calls": st.calls,
+                    "run_time_s": round(st.run_s, 6),
+                    "storms": st.storms,
+                    "flops_per_call": max(
+                        (s.exe.flops for s in st.signatures.values()),
+                        default=0.0,
+                    ),
+                    "bytes_per_call": max(
+                        (s.exe.bytes_accessed
+                         for s in st.signatures.values()),
+                        default=0.0,
+                    ),
+                    "temp_bytes": max(
+                        (s.exe.temp_bytes for s in st.signatures.values()),
+                        default=0,
+                    ),
+                    "_flops": st.weighted_flops(),
+                    "_bytes": st.weighted_bytes(),
+                }
+                for name, st in self._programs.items()
+            }
+        for d in progs.values():
+            flops, nbytes = d.pop("_flops"), d.pop("_bytes")
+            if d["run_time_s"] > 0 and d["calls"]:
+                d["achieved_flops_per_s"] = flops / d["run_time_s"]
+                if nbytes > 0:
+                    d["intensity_flops_per_byte"] = flops / nbytes
+                if math.isfinite(self.peak_flops) and flops > 0:
+                    d["mfu"] = d["achieved_flops_per_s"] / self.peak_flops
+        return {
+            "programs": progs,
+            "total_compile_time_s": round(
+                sum(d["compile_time_s"] for d in progs.values()), 6
+            ),
+            "storms": sum(d["storms"] for d in progs.values()),
+        }
+
+
+class HBMLedger:
+    """Named live-byte pools + projected-peak headroom accounting.
+
+    `register(name, provider)` attaches a zero-arg callable returning
+    the pool's CURRENT device bytes (providers read live engine state,
+    so gauges are always fresh and the ledger never caches stale
+    sizes); `temp_fn` (typically `CompileRegistry.max_temp_bytes`) adds
+    the largest per-program scratch on top for the projected peak.
+    `check()` warns once when the projection exceeds the device
+    capacity — call it where memory can grow (the engine does so per
+    admission), not per token.
+    """
+
+    def __init__(self, capacity_bytes: int | None = None, device=None):
+        self.pools: dict[str, Callable[[], int]] = {}
+        self.temp_fn: Callable[[], int] | None = None
+        self.capacity_bytes = (
+            capacity_bytes if capacity_bytes is not None
+            else device_capacity_bytes(device)
+        )
+        self._warned = False
+
+    def register(self, name: str, provider: Callable[[], int] | int) -> None:
+        if not callable(provider):
+            value = int(provider)
+            provider = lambda: value  # noqa: E731 — constant pool size
+        if name in self.pools:
+            raise ValueError(f"pool {name!r} already registered")
+        self.pools[name] = provider
+
+    def pool_bytes(self) -> dict[str, int]:
+        return {name: int(fn()) for name, fn in self.pools.items()}
+
+    def live_bytes(self) -> int:
+        return sum(self.pool_bytes().values())
+
+    def temp_bytes(self) -> int:
+        return int(self.temp_fn()) if self.temp_fn is not None else 0
+
+    def projected_peak_bytes(self) -> int:
+        """Live pools + the largest per-program XLA scratch: the
+        estimate of the next decode step's high-water mark."""
+        return self.live_bytes() + self.temp_bytes()
+
+    def headroom_bytes(self) -> int | None:
+        if self.capacity_bytes is None:
+            return None
+        return self.capacity_bytes - self.projected_peak_bytes()
+
+    def check(self) -> bool:
+        """True (and a one-shot warning) when the projected peak exceeds
+        capacity — the moment admission control should stop admitting."""
+        if self.capacity_bytes is None:
+            return False
+        peak = self.projected_peak_bytes()
+        if peak <= self.capacity_bytes:
+            return False
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"projected HBM peak {peak} bytes exceeds device capacity "
+                f"{self.capacity_bytes} bytes (pools {self.pool_bytes()}, "
+                f"program temp {self.temp_bytes()}) — the next step may "
+                "OOM; shed load or shrink the pools",
+                stacklevel=2,
+            )
+        return True
+
+    def gauges(self) -> dict[str, float]:
+        """Flat `mem/*` metric keys."""
+        pools = self.pool_bytes()
+        out = {f"mem/{PrometheusTextWriter.sanitize(k)}_bytes": float(v)
+               for k, v in pools.items()}
+        temp = self.temp_bytes()
+        live = sum(pools.values())
+        out["mem/live_bytes"] = float(live)
+        out["mem/program_temp_bytes"] = float(temp)
+        out["mem/projected_peak_bytes"] = float(live + temp)
+        if self.capacity_bytes is not None:
+            out["mem/capacity_bytes"] = float(self.capacity_bytes)
+            out["mem/headroom_bytes"] = float(
+                self.capacity_bytes - live - temp
+            )
+        return out
+
+    def snapshot(self) -> dict:
+        """Structured view for /statusz."""
+        pools = self.pool_bytes()
+        temp = self.temp_bytes()
+        live = sum(pools.values())
+        return {
+            "pools": pools,
+            "live_bytes": live,
+            "program_temp_bytes": temp,
+            "projected_peak_bytes": live + temp,
+            "capacity_bytes": self.capacity_bytes,
+            "headroom_bytes": (
+                None if self.capacity_bytes is None
+                else self.capacity_bytes - live - temp
+            ),
+        }

@@ -33,6 +33,18 @@ the budget the tracer's "single branch when off / bounded ring when on"
 design is held to. `trace_out` exports the traced arm's Chrome
 trace-event JSON (load in Perfetto or feed `cli trace-summary`);
 `trace_dump` arms the anomaly JSONL dumper.
+
+Every workload also runs a compile-&-memory-observatory PROBE first
+(`metrics/xla_obs.py`, on the warm trace, BEFORE the plain warmup — so
+the recorded XLA compiles are cold): each BENCH_serve.json entry gains
+`compile_time_s`, `compile_programs`, `compile_compilations` and
+`peak_hbm_bytes`, making compile-time and memory regressions visible in
+the bench trajectory, not just req/s. `obs=True` adds a paired
+observatory-on-vs-off arm (`obs_overhead_pct`, same ABBA/mean
+methodology and < 2% budget as the tracer), and `status_port` keeps the
+probe engine's /healthz /metrics /statusz endpoint live for the rest of
+the bench (the CI smoke curls it; `status_hold_s` keeps it up after the
+arms finish).
 """
 
 from __future__ import annotations
@@ -150,6 +162,39 @@ def _round_if_present(snap: dict, key: str, out_key: str, digits: int) -> dict:
     return {}
 
 
+def _paired_makespans(model, params, extra, requests, on_cfg, off_cfg,
+                      max_new, params_for=None, reps: int = 4):
+    """ABBA-paired makespans for an instrumented-vs-plain engine config.
+
+    The measurement discipline every overhead number in BENCH_serve.json
+    shares: even reps run on-then-off, odd reps flip, and each side
+    averages its runs. Single back-to-back pairs are dominated by
+    scheduler/thermal noise on a shared host (single-run makespans here
+    swing +-10% in both directions while the instrumentation's true cost
+    is well under 1%), and taking min-of-reps re-biases under monotonic
+    load drift (one side owns the last slot); ABBA + mean cancels linear
+    drift exactly, and `reps=4` (8 runs) averages the residual noise
+    below the 2% acceptance budget. Returns (mk_on, mk_off, last on-arm
+    engine)."""
+    mk_on: list[float] = []
+    mk_off: list[float] = []
+    eng = None
+    for rep in range(reps):
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in order:
+            e, _, mk = _run_engine_arm(
+                model, params, extra, requests,
+                on_cfg if arm == "on" else off_cfg, max_new,
+                params_for=params_for,
+            )
+            if arm == "on":
+                eng = e
+                mk_on.append(mk)
+            else:
+                mk_off.append(mk)
+    return mk_on, mk_off, eng
+
+
 def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
                        trace_out: str | None, trace_dump: str | None,
                        params_for=None, reps: int = 4) -> dict:
@@ -158,39 +203,14 @@ def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
     100 — the acceptance budget is < 2 on the Poisson workload — plus
     the traced arm's req/s and event count. Exports the last traced
     run's Chrome trace to `trace_out`; `trace_dump` arms the anomaly
-    dumper.
-
-    The measurement is PAIRED with ABBA ordering and MEAN makespans:
-    even reps run traced-then-untraced, odd reps flip, and each side
-    averages its runs. Single back-to-back pairs are dominated by
-    scheduler/thermal noise on a shared host (single-run makespans here
-    swing +-10% in both directions while the tracer's true cost — one
-    branch per hook off, one ring append per event on — is well under
-    1%), and taking min-of-reps re-biases under monotonic load drift
-    (one side owns the last slot); ABBA + mean cancels linear drift
-    exactly, and `reps=4` (8 runs) averages the residual noise below
-    the 2% budget the acceptance gate checks."""
+    dumper. Methodology: `_paired_makespans`."""
     tcfg = dataclasses.replace(
         serve_cfg, trace=True, trace_dump_path=trace_dump
     )
-    mk_on: list[float] = []
-    mk_off: list[float] = []
-    eng = None
-    for rep in range(reps):
-        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
-        for arm in order:
-            if arm == "on":
-                eng, _, mk = _run_engine_arm(
-                    model, params, extra, requests, tcfg, max_new,
-                    params_for=params_for,
-                )
-                mk_on.append(mk)
-            else:
-                _, _, mk = _run_engine_arm(
-                    model, params, extra, requests, serve_cfg, max_new,
-                    params_for=params_for,
-                )
-                mk_off.append(mk)
+    mk_on, mk_off, eng = _paired_makespans(
+        model, params, extra, requests, tcfg, serve_cfg, max_new,
+        params_for=params_for, reps=reps,
+    )
     traced_rps = len(requests) / (sum(mk_on) / len(mk_on))
     untraced_rps = len(requests) / (sum(mk_off) / len(mk_off))
     fields = {
@@ -204,6 +224,67 @@ def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
         eng.trace.export_chrome(trace_out)
         fields["trace_out"] = trace_out
     return fields
+
+
+def _obs_arm_fields(model, params, extra, requests, serve_cfg, max_new,
+                    params_for=None, reps: int = 4) -> dict:
+    """Compile-&-memory-observatory on vs off, same ABBA/mean pairing as
+    the tracer — `obs_overhead_pct` is the budget the registry's fenced
+    AOT dispatch is held to (< 2, matching the flight recorder's)."""
+    ocfg = dataclasses.replace(serve_cfg, xla_obs=True)
+    mk_on, mk_off, _ = _paired_makespans(
+        model, params, extra, requests, ocfg, serve_cfg, max_new,
+        params_for=params_for, reps=reps,
+    )
+    on_rps = len(requests) / (sum(mk_on) / len(mk_on))
+    off_rps = len(requests) / (sum(mk_off) / len(mk_off))
+    return {
+        "obs_overhead_pct": round((1.0 - on_rps / off_rps) * 100.0, 2),
+        "obs_requests_per_sec": round(on_rps, 2),
+    }
+
+
+def _obs_probe(model, params, extra, warm_requests, serve_cfg, max_new,
+               status_port: int | None = None, params_for=None):
+    """Run the warm trace through an observatory-enabled engine FIRST
+    (before the plain warmup populates jax's jit cache) so the recorded
+    `compile_time_s` is true cold-compile wall time, and read the
+    HBM-ledger projected peak off the live engine. Returns (detail
+    fields, engine). With `status_port` set the engine is returned OPEN
+    so its /healthz /metrics /statusz endpoint stays up for the rest of
+    the bench (the CI smoke curls it while the timed arms run; the
+    caller closes it on exit); WITHOUT one the engine is dropped here
+    (returns None) so its slot pool and prefix segments free before the
+    timed arms allocate theirs — the probe must not double the device
+    memory it exists to measure."""
+    import sys
+
+    ocfg = dataclasses.replace(serve_cfg, xla_obs=True)
+    if status_port is not None:
+        ocfg = dataclasses.replace(ocfg, status_port=status_port)
+    eng, _, _ = _run_engine_arm(
+        model, params, extra, warm_requests, ocfg, max_new,
+        params_for=params_for,
+    )
+    snap = eng.registry.snapshot()
+    fields = {
+        # compile + memory trajectory gauges: regressions here (a new
+        # shape that stops bucketing, a cache that balloons) show up in
+        # BENCH_serve.json even when req/s alone still looks fine
+        "compile_time_s": round(eng.registry.total_compile_s, 4),
+        "compile_programs": len(snap["programs"]),
+        "compile_compilations": sum(
+            d["compilations"] for d in snap["programs"].values()
+        ),
+        "peak_hbm_bytes": int(eng.ledger.projected_peak_bytes()),
+    }
+    if eng.status is not None:
+        fields["status_port"] = eng.status.port
+        print(f"[serve-bench] status endpoint live at "
+              f"http://127.0.0.1:{eng.status.port} "
+              "(/healthz /metrics /statusz)", file=sys.stderr)
+        return fields, eng
+    return fields, None
 
 
 def _run_engine_arm(model, params, extra, requests, serve_cfg, max_new,
@@ -272,6 +353,9 @@ def run_serve_bench(
     trace: bool = False,
     trace_out: str | None = None,
     trace_dump: str | None = None,
+    obs: bool = False,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
 ) -> dict:
     """Run both arms, return the BENCH-shaped result dict."""
     model, params, extra, vocab = build_serve_model(config)
@@ -302,60 +386,82 @@ def run_serve_bench(
     for _, p in requests:
         by_len.setdefault(len(p), p)
     warm = [(0.0, p) for p in by_len.values()]
-    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
-    if not skip_sequential:
-        _run_sequential_arm(model, params, extra, warm, max_new)
-
-    eng, handles, makespan = _run_engine_arm(
-        model, params, extra, requests, serve_cfg, max_new
+    # observatory probe first (cold AOT compiles => honest compile_time_s
+    # and per-entry peak-HBM gauges); its engine keeps the live status
+    # endpoint up for the rest of the bench when --status-port is set
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, serve_cfg, max_new,
+        status_port=status_port,
     )
-    snap = eng.metrics.snapshot()
-    rps = n_requests / makespan
-    detail = {
-        "config": config,
-        "n_requests": n_requests,
-        "n_slots": n_slots,
-        "max_new_tokens": max_new,
-        "decode_block": decode_block,
-        "prompt_lens": list(prompt_lens),
-        "mean_interarrival_s": mean_interarrival_s,
-        "engine_requests_per_sec": round(rps, 2),
-        "engine_tokens_per_sec": round(snap.get("serve/tokens_per_sec", 0.0), 1),
-        # absent beats NaN (json.dumps would emit a non-RFC-8259 'NaN'
-        # token): e.g. max_new=1 finishes every request at prefill and the
-        # ITL ring never gets an observation
-        **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
-        **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
-        **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
-        "slot_occupancy": round(snap.get("serve/slot_occupancy", 0.0), 3),
-        # present only when the engine's prefix cache actually ran lookups
-        # (snapshot omits serve/prefix_* otherwise) — an unconditional 0.0
-        # would be indistinguishable from "cache on, nothing shared"
-        **_round_if_present(snap, "serve/prefix_hit_rate", "prefix_hit_rate", 3),
-        **({"tokens_prefilled_saved":
-            int(snap["serve/tokens_prefilled_saved"])}
-           if "serve/tokens_prefilled_saved" in snap else {}),
-    }
-    if trace:
-        detail.update(_traced_arm_fields(
-            model, params, extra, requests, serve_cfg, max_new,
-            trace_out, trace_dump,
-        ))
-    result = {
-        "metric": "serve_requests_per_sec",
-        "value": round(rps, 2),
-        "unit": "req/s",
-        "detail": detail,
-    }
-    if not skip_sequential:
-        seq_makespan, seq_ttft = _run_sequential_arm(
-            model, params, extra, requests, max_new
+    try:
+        _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
+        if not skip_sequential:
+            _run_sequential_arm(model, params, extra, warm, max_new)
+
+        eng, handles, makespan = _run_engine_arm(
+            model, params, extra, requests, serve_cfg, max_new
         )
-        seq_rps = n_requests / seq_makespan
-        detail["sequential_requests_per_sec"] = round(seq_rps, 2)
-        detail["sequential_mean_ttft_s"] = round(seq_ttft, 4)
-        result["vs_baseline"] = round(rps / seq_rps, 2)
-    return result
+        snap = eng.metrics.snapshot()
+        rps = n_requests / makespan
+        detail = {
+            "config": config,
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "engine_requests_per_sec": round(rps, 2),
+            "engine_tokens_per_sec": round(
+                snap.get("serve/tokens_per_sec", 0.0), 1
+            ),
+            # absent beats NaN (json.dumps would emit a non-RFC-8259 'NaN'
+            # token): e.g. max_new=1 finishes every request at prefill and
+            # the ITL ring never gets an observation
+            **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
+            **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
+            **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
+            "slot_occupancy": round(snap.get("serve/slot_occupancy", 0.0), 3),
+            # present only when the engine's prefix cache actually ran
+            # lookups (snapshot omits serve/prefix_* otherwise) — an
+            # unconditional 0.0 would be indistinguishable from "cache
+            # on, nothing shared"
+            **_round_if_present(snap, "serve/prefix_hit_rate",
+                                "prefix_hit_rate", 3),
+            **({"tokens_prefilled_saved":
+                int(snap["serve/tokens_prefilled_saved"])}
+               if "serve/tokens_prefilled_saved" in snap else {}),
+            **probe_fields,
+        }
+        if obs:
+            detail.update(_obs_arm_fields(
+                model, params, extra, requests, serve_cfg, max_new,
+            ))
+        if trace:
+            detail.update(_traced_arm_fields(
+                model, params, extra, requests, serve_cfg, max_new,
+                trace_out, trace_dump,
+            ))
+        result = {
+            "metric": "serve_requests_per_sec",
+            "value": round(rps, 2),
+            "unit": "req/s",
+            "detail": detail,
+        }
+        if not skip_sequential:
+            seq_makespan, seq_ttft = _run_sequential_arm(
+                model, params, extra, requests, max_new
+            )
+            seq_rps = n_requests / seq_makespan
+            detail["sequential_requests_per_sec"] = round(seq_rps, 2)
+            detail["sequential_mean_ttft_s"] = round(seq_ttft, 4)
+            result["vs_baseline"] = round(rps / seq_rps, 2)
+        if probe_eng is not None and status_hold_s > 0:
+            time.sleep(status_hold_s)
+        return result
+    finally:
+        if probe_eng is not None:
+            probe_eng.close()
 
 
 def run_prefix_bench(
@@ -374,6 +480,9 @@ def run_prefix_bench(
     trace: bool = False,
     trace_out: str | None = None,
     trace_dump: str | None = None,
+    obs: bool = False,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
 ) -> dict:
     """Shared-prefix workload, prefix cache ON vs OFF — same engine, same
     arrival trace; returns the BENCH-shaped dict with the TTFT speedup as
@@ -415,44 +524,73 @@ def run_prefix_bench(
             prefix_cache_bytes=prefix_cache_bytes,
         )
 
+    # observatory probe on the cache-on config (the headline arm): cold
+    # AOT compile times + the ledger's peak including the radix tree
+    probe_warm = shared_prefix_requests(
+        2 * n_prefixes, vocab, n_prefixes=n_prefixes,
+        prefix_len=prefix_len, suffix_len=suffix_len,
+        mean_interarrival_s=0.0, seed=seed + 1,
+    )
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, probe_warm, cfg(True), max_new,
+        status_port=status_port,
+    )
     arms = {}
     raw_ttft = {}
-    for cache_on in (True, False):
-        # warm: a 2-requests-per-stem mini-trace compiles every shape both
-        # arms hit (miss-path full prefill AND hit-path suffix prefill —
-        # the jit cache is process-global, the prefix tree is per-engine
-        # so the TIMED engine still starts cold)
-        warm = shared_prefix_requests(
-            2 * n_prefixes, vocab, n_prefixes=n_prefixes,
-            prefix_len=prefix_len, suffix_len=suffix_len,
-            mean_interarrival_s=0.0, seed=seed + 1,
-        )
-        _run_engine_arm(model, params, extra, warm, cfg(cache_on), max_new)
-        eng, _, makespan = _run_engine_arm(
-            model, params, extra, requests, cfg(cache_on), max_new
-        )
-        snap = eng.metrics.snapshot()
-        arm = "cache_on" if cache_on else "cache_off"
-        raw_ttft[arm] = snap["serve/ttft_s_mean"]  # unrounded, for the ratio
-        arms[arm] = {
-            "requests_per_sec": round(n_requests / makespan, 2),
-            "mean_ttft_s": round(raw_ttft[arm], 4),
-            **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
-            "prefix_hit_rate": round(snap.get("serve/prefix_hit_rate", 0.0), 3),
-            "prefix_evictions": int(snap.get("serve/prefix_evictions", 0.0)),
-            "tokens_prefilled_saved": int(
-                snap.get("serve/tokens_prefilled_saved", 0.0)
-            ),
-            "prefix_hbm_bytes": int(snap.get("serve/prefix_hbm_bytes", 0.0)),
-        }
-    trace_fields = {}
-    if trace:
-        # the traced arm mirrors the headline (cache-on) arm: splice +
-        # snapshot + lookup events are the ones this workload exercises
-        trace_fields = _traced_arm_fields(
-            model, params, extra, requests, cfg(True), max_new,
-            trace_out, trace_dump,
-        )
+    try:
+        for cache_on in (True, False):
+            # warm: a 2-requests-per-stem mini-trace compiles every shape
+            # both arms hit (miss-path full prefill AND hit-path suffix
+            # prefill — the jit cache is process-global, the prefix tree
+            # is per-engine so the TIMED engine still starts cold)
+            warm = shared_prefix_requests(
+                2 * n_prefixes, vocab, n_prefixes=n_prefixes,
+                prefix_len=prefix_len, suffix_len=suffix_len,
+                mean_interarrival_s=0.0, seed=seed + 1,
+            )
+            _run_engine_arm(model, params, extra, warm, cfg(cache_on),
+                            max_new)
+            eng, _, makespan = _run_engine_arm(
+                model, params, extra, requests, cfg(cache_on), max_new
+            )
+            snap = eng.metrics.snapshot()
+            arm = "cache_on" if cache_on else "cache_off"
+            raw_ttft[arm] = snap["serve/ttft_s_mean"]  # unrounded ratio
+            arms[arm] = {
+                "requests_per_sec": round(n_requests / makespan, 2),
+                "mean_ttft_s": round(raw_ttft[arm], 4),
+                **_round_if_present(snap, "serve/ttft_s_p95",
+                                    "ttft_p95_s", 4),
+                "prefix_hit_rate": round(
+                    snap.get("serve/prefix_hit_rate", 0.0), 3
+                ),
+                "prefix_evictions": int(
+                    snap.get("serve/prefix_evictions", 0.0)
+                ),
+                "tokens_prefilled_saved": int(
+                    snap.get("serve/tokens_prefilled_saved", 0.0)
+                ),
+                "prefix_hbm_bytes": int(
+                    snap.get("serve/prefix_hbm_bytes", 0.0)
+                ),
+            }
+        trace_fields = {}
+        if obs:
+            trace_fields.update(_obs_arm_fields(
+                model, params, extra, requests, cfg(True), max_new,
+            ))
+        if trace:
+            # the traced arm mirrors the headline (cache-on) arm: splice +
+            # snapshot + lookup events are the ones this workload exercises
+            trace_fields.update(_traced_arm_fields(
+                model, params, extra, requests, cfg(True), max_new,
+                trace_out, trace_dump,
+            ))
+        if probe_eng is not None and status_hold_s > 0:
+            time.sleep(status_hold_s)
+    finally:
+        if probe_eng is not None:
+            probe_eng.close()
     # ratio of the UNROUNDED means: 4-decimal-rounded values would distort
     # (or zero-divide) on hardware where TTFT is tens of microseconds
     speedup = raw_ttft["cache_off"] / raw_ttft["cache_on"]
@@ -475,6 +613,7 @@ def run_prefix_bench(
             "prefix_page": prefix_page,
             **{f"{arm}_{k}": v for arm, d in arms.items()
                for k, v in d.items()},
+            **probe_fields,
             **trace_fields,
         },
     }
@@ -507,6 +646,9 @@ def run_sampling_bench(
     trace: bool = False,
     trace_out: str | None = None,
     trace_dump: str | None = None,
+    obs: bool = False,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
 ) -> dict:
     """Sampled vs greedy decode on the same Poisson trace.
 
@@ -540,31 +682,49 @@ def run_sampling_bench(
     for _, p in requests:
         by_len.setdefault(len(p), p)
     warm = [(0.0, p) for p in by_len.values()]
-    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
-    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new,
-                    params_for=sampling_params_mix)
+    # probe mirrors the headline (sampled-mix) arm
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, serve_cfg, max_new,
+        status_port=status_port, params_for=sampling_params_mix,
+    )
+    try:
+        _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
+        _run_engine_arm(model, params, extra, warm, serve_cfg, max_new,
+                        params_for=sampling_params_mix)
 
-    arms = {}
-    for name, params_for in (("greedy", None),
-                             ("sampled", sampling_params_mix)):
-        eng, _, makespan = _run_engine_arm(
-            model, params, extra, requests, serve_cfg, max_new,
-            params_for=params_for,
-        )
-        snap = eng.metrics.snapshot()
-        arms[name] = {
-            "requests_per_sec": n_requests / makespan,
-            "tokens_per_sec": snap.get("serve/tokens_per_sec", 0.0),
-            **_round_if_present(snap, "serve/ttft_s_mean", "mean_ttft_s", 4),
-            **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
-        }
-    trace_fields = {}
-    if trace:
-        # traced arm mirrors the headline (sampled-mix) arm
-        trace_fields = _traced_arm_fields(
-            model, params, extra, requests, serve_cfg, max_new,
-            trace_out, trace_dump, params_for=sampling_params_mix,
-        )
+        arms = {}
+        for name, params_for in (("greedy", None),
+                                 ("sampled", sampling_params_mix)):
+            eng, _, makespan = _run_engine_arm(
+                model, params, extra, requests, serve_cfg, max_new,
+                params_for=params_for,
+            )
+            snap = eng.metrics.snapshot()
+            arms[name] = {
+                "requests_per_sec": n_requests / makespan,
+                "tokens_per_sec": snap.get("serve/tokens_per_sec", 0.0),
+                **_round_if_present(snap, "serve/ttft_s_mean",
+                                    "mean_ttft_s", 4),
+                **_round_if_present(snap, "serve/itl_s_p95",
+                                    "itl_p95_s", 5),
+            }
+        trace_fields = {}
+        if obs:
+            trace_fields.update(_obs_arm_fields(
+                model, params, extra, requests, serve_cfg, max_new,
+                params_for=sampling_params_mix,
+            ))
+        if trace:
+            # traced arm mirrors the headline (sampled-mix) arm
+            trace_fields.update(_traced_arm_fields(
+                model, params, extra, requests, serve_cfg, max_new,
+                trace_out, trace_dump, params_for=sampling_params_mix,
+            ))
+        if probe_eng is not None and status_hold_s > 0:
+            time.sleep(status_hold_s)
+    finally:
+        if probe_eng is not None:
+            probe_eng.close()
     ratio = arms["sampled"]["requests_per_sec"] / arms["greedy"][
         "requests_per_sec"]
     return {
@@ -585,6 +745,7 @@ def run_sampling_bench(
             "sampling_overhead_pct": round((1.0 - ratio) * 100.0, 1),
             **{f"{arm}_{k}": (round(v, 2) if isinstance(v, float) else v)
                for arm, d in arms.items() for k, v in d.items()},
+            **probe_fields,
             **trace_fields,
         },
     }

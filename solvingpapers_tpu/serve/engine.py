@@ -66,6 +66,14 @@ export to Perfetto with `engine.trace.export_chrome(path)`, rebuild
 timelines with `cli trace-summary`, and arm post-mortem anomaly dumps
 with `trace_dump_path` — see the ServeConfig docstring and the README's
 Observability section.
+
+Compile & memory observatory (`metrics/xla_obs.py`, opt-in via
+`ServeConfig.xla_obs`): every jitted program routes through a compile
+registry that records each XLA compilation (signature, wall time,
+cost_analysis flops/bytes) and flags recompile storms, while an HBM
+ledger accounts per-pool live bytes and projected peak vs device
+capacity; `ServeConfig.status_port` serves the live /healthz /metrics
+/statusz endpoint (`metrics/http.py`).
 """
 
 from __future__ import annotations
@@ -183,6 +191,33 @@ class ServeConfig:
     # jax.profiler window over engine steps [start, stop)
     profile_dir: str | None = None
     profile_steps: tuple[int, int] = (10, 15)
+    # compile & memory observatory (metrics/xla_obs.py, opt-in): every
+    # jitted program routes through a CompileRegistry (records each XLA
+    # compilation's signature, wall time, cost_analysis flops/bytes and
+    # memory_analysis temp bytes; flags recompile storms — same program,
+    # >= obs_storm_k NEW signatures inside obs_storm_window_s — through
+    # the AnomalyMonitor when trace_dump_path is armed) and an HBMLedger
+    # tracks per-pool live bytes (params / kv_pool / prefix_cache) plus
+    # projected decode-step peak vs device capacity, warning before the
+    # projection exceeds it. Gauges ride ServeMetrics.snapshot() as
+    # compile/* + mem/* + roofline/* keys. Observability mode: program
+    # calls are fenced for device-true run seconds (same contract and
+    # paired-bench budget as `trace` — BENCH_serve.json
+    # `obs_overhead_pct`); off = None registry, one branch per call site.
+    xla_obs: bool = False
+    obs_storm_k: int = 8
+    obs_storm_window_s: float = 60.0
+    # device capacity override for the headroom estimate (bytes); None =
+    # ask the backend (memory_stats()["bytes_limit"]; CPU reports none,
+    # so headroom gauges are simply absent there)
+    obs_capacity_bytes: int | None = None
+    # live status endpoint (metrics/http.py, opt-in): /healthz, /metrics
+    # (Prometheus text of the current snapshot), /statusz (engine + slot
+    # occupancy + compile registry + memory ledger JSON) on a daemon
+    # thread bound to status_host. Port 0 = ephemeral (published as
+    # engine.status.port); None = no server. Close with engine.close().
+    status_port: int | None = None
+    status_host: str = "127.0.0.1"
 
 
 _UNSET = object()
@@ -407,6 +442,37 @@ class ServeEngine:
                         trace=self.trace)
             if cfg.prefix_cache else None
         )
+        # compile & memory observatory (metrics/xla_obs.py): both None
+        # when off, so every program call site is one `is not None`
+        # branch — the same discipline as the flight recorder above
+        self.registry = None
+        self.ledger = None
+        if cfg.xla_obs:
+            from solvingpapers_tpu.metrics.xla_obs import (
+                CompileRegistry,
+                HBMLedger,
+                pytree_bytes,
+            )
+
+            self.registry = CompileRegistry(
+                trace=self.trace, monitor=self._mon,
+                storm_k=cfg.obs_storm_k,
+                storm_window_s=cfg.obs_storm_window_s,
+                clock=smetrics.now,
+            )
+            self.pool.registry = self.registry
+            self.ledger = HBMLedger(capacity_bytes=cfg.obs_capacity_bytes)
+            # params are fixed for the engine's lifetime: account once
+            self.ledger.register("params", pytree_bytes(self.variables))
+            self.ledger.register("kv_pool", lambda: self.pool.nbytes)
+            if self.prefix_cache is not None:
+                self.ledger.register(
+                    "prefix_cache", lambda: self.prefix_cache.bytes_held
+                )
+            self.ledger.temp_fn = self.registry.max_temp_bytes
+            self.metrics.add_gauge_provider(self.registry.gauges)
+            self.metrics.add_gauge_provider(self.ledger.gauges)
+        self.status = None
         self.scheduler = FIFOScheduler(
             max_waiting=cfg.max_waiting,
             decode_priority=cfg.decode_priority,
@@ -440,6 +506,19 @@ class ServeEngine:
         # deadline-free traffic pays nothing on the dispatch-bound host
         # loop (updated at submit / admit / cancel / purge)
         self._waiting_deadlines = 0
+        # live status endpoint LAST: its handler threads read scheduler /
+        # slot state, so serving must not start until every piece of
+        # engine state above exists (a probe hitting the construction
+        # window would 500). Useful with or without the observatory —
+        # /statusz simply omits the compile/mem sections when it's off.
+        if cfg.status_port is not None:
+            from solvingpapers_tpu.metrics.http import StatusServer
+
+            self.status = StatusServer(
+                self.statusz,
+                lambda: (self._step_idx, self.metrics.snapshot()),
+                host=cfg.status_host, port=cfg.status_port,
+            )
 
     # ------------------------------------------------------------- submit
 
@@ -652,6 +731,46 @@ class ServeEngine:
             self._profiling = False
             self._profile_done = True
 
+    def statusz(self) -> dict:
+        """The /statusz document: live engine state assembled from
+        host-side mirrors only (safe to call from the status server's
+        request threads while the engine steps)."""
+        d = {
+            "engine": {
+                "n_slots": self.config.n_slots,
+                "n_free": self.pool.n_free,
+                "occupancy": self.pool.occupancy,
+                "queue_depth": len(self.scheduler),
+                "step": self._step_idx,
+                "max_len": self.config.max_len,
+                "decode_block": self.config.decode_block,
+            },
+            "slots": [
+                {
+                    "slot": i,
+                    "req": None if r is None else r.id,
+                    "position": int(self.pool.positions[i]),
+                }
+                for i, r in enumerate(self._slot_req)
+            ],
+            "metrics": self.metrics.snapshot(),
+        }
+        if self.prefix_cache is not None:
+            d["prefix_cache"] = self.prefix_cache.stats()
+        if self.registry is not None:
+            d["compile"] = self.registry.snapshot()
+        if self.ledger is not None:
+            d["mem"] = self.ledger.snapshot()
+        return d
+
+    def close(self) -> None:
+        """Release external resources (status endpoint, profiler
+        window). Idempotent; the engine itself stays usable."""
+        self.stop_profile()
+        if self.status is not None:
+            self.status.close()
+            self.status = None
+
     def run(self, max_steps: int | None = None) -> None:
         """Drive step() until queue and slots drain (or `max_steps`)."""
         steps = 0
@@ -747,12 +866,22 @@ class ServeEngine:
         )
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
+        pf_args = (
+            self.model, padded, chunk, matched, self.config.sample_cap,
+            self.variables, self.pool.caches, jnp.asarray(prompt_padded),
+            jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
+        )
         with self._scope("serve/prefill"):
-            self.pool.caches, first, logprob = _prefill_program(
-                self.model, padded, chunk, matched, self.config.sample_cap,
-                self.variables, self.pool.caches, jnp.asarray(prompt_padded),
-                jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
-            )
+            if self.registry is not None:
+                # signature = the static shape triple; everything else
+                # (params, caches, control arrays) is fixed per engine
+                self.pool.caches, first, logprob = self.registry.call(
+                    "prefill_program", (padded, chunk, matched),
+                    _prefill_program, pf_args,
+                    static_argnums=(0, 1, 2, 3, 4),
+                )
+            else:
+                self.pool.caches, first, logprob = _prefill_program(*pf_args)
         first = int(first)  # blocks on the program — t_pf1 is device-true
         if tr is not None:
             t_pf1 = smetrics.now()
@@ -777,6 +906,11 @@ class ServeEngine:
             self.metrics.record_prefix_state(
                 self.prefix_cache.bytes_held, self.prefix_cache.evictions
             )
+        if self.ledger is not None:
+            # live bytes only grow at admission (prefix snapshots) and
+            # program temp only at new compiles (just above) — one
+            # projected-peak check per admitted request, never per token
+            self.ledger.check()
         now = smetrics.now()
         req.first_token_time = now
         req.tokens.append(first)
@@ -870,12 +1004,23 @@ class ServeEngine:
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
+        dec_args = (
+            self.model, block, self.config.sample_cap, self.variables,
+            self.pool.caches, jnp.asarray(state),
+            jnp.asarray(self._samp_f), self._rng,
+        )
         with self._scope("serve/decode_block"):
-            self.pool.caches, (out, lps) = _decode_program(
-                self.model, block, self.config.sample_cap, self.variables,
-                self.pool.caches, jnp.asarray(state),
-                jnp.asarray(self._samp_f), self._rng,
-            )
+            if self.registry is not None:
+                # one decode shape per engine — a second signature here
+                # IS the anomaly the registry exists to catch. Named
+                # after the trace span ("decode_block") so the offline
+                # roofline join in summarize_trace matches.
+                self.pool.caches, (out, lps) = self.registry.call(
+                    "decode_block", (block,), _decode_program, dec_args,
+                    static_argnums=(0, 1, 2),
+                )
+            else:
+                self.pool.caches, (out, lps) = _decode_program(*dec_args)
         t_dev = 0.0
         if tr is not None:
             # fence so the span is device wall time, not dispatch time;

@@ -127,6 +127,19 @@ class KVSlotPool:
         # LIFO free list, seeded so acquire() hands out slot 0 first —
         # recently-freed lanes are reused while their buffers are warm
         self._free = list(range(n_slots - 1, -1, -1))
+        # optional metrics.xla_obs.CompileRegistry (set by the engine
+        # when the observatory is on): splice/extract program calls are
+        # routed through it so their compilations and run seconds are
+        # accounted like the engine's own programs; None = direct jit
+        self.registry = None
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes the pooled cache pytree holds (all lanes) — the
+        HBM ledger's kv_pool gauge."""
+        from solvingpapers_tpu.metrics.xla_obs import pytree_bytes
+
+        return pytree_bytes(self.caches)
 
     @property
     def n_free(self) -> int:
@@ -174,7 +187,15 @@ class KVSlotPool:
                 f"lane capacity {self.max_len}"
             )
         ctl = jnp.asarray([slot, offset], jnp.int32)
-        self.caches = _splice_program(self.caches, segment, ctl)
+        if self.registry is not None:
+            # segment layout is fixed per model (one pool, one model), so
+            # the static time length is the whole varying signature
+            self.caches = self.registry.call(
+                "splice_program", (length,), _splice_program,
+                (self.caches, segment, ctl),
+            )
+        else:
+            self.caches = _splice_program(self.caches, segment, ctl)
 
     def extract_prefix(self, slot: int, offset: int, length: int):
         """Snapshot lane `slot`'s KV span [offset, offset+length) as an
@@ -187,4 +208,9 @@ class KVSlotPool:
                 f"lane capacity {self.max_len}"
             )
         ctl = jnp.asarray([slot, offset], jnp.int32)
+        if self.registry is not None:
+            return self.registry.call(
+                "extract_program", (length,), _extract_program,
+                (self.caches, ctl, length), static_argnums=(2,),
+            )
         return _extract_program(self.caches, ctl, length)

@@ -28,6 +28,18 @@ prefix cache is on):
                                    avoided, the bench's headline saving
     serve/prefix_evictions         LRU leaf evictions so far
     serve/prefix_hbm_bytes         device bytes the radix tree holds now
+
+Compile & memory observatory gauges (metrics/xla_obs.py; present iff
+`ServeConfig.xla_obs` is on, via `add_gauge_provider`):
+
+    compile/*                      programs / compilations / cached /
+                                   recompiles / storms / time_s
+    mem/*                          per-pool live bytes (params, kv_pool,
+                                   prefix_cache), program temp, projected
+                                   peak, capacity + headroom where the
+                                   backend reports a limit
+    roofline/<program>_*           achieved FLOP/s, arithmetic intensity,
+                                   MFU (only on chips with a known peak)
 """
 
 from __future__ import annotations
@@ -58,6 +70,18 @@ class ServeMetrics:
         self.prefix_bytes_held = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
+        # zero-arg dict providers merged into every snapshot — how the
+        # compile & memory observatory (metrics/xla_obs.py) publishes its
+        # compile/* + mem/* + roofline/* gauges through the same sinks
+        # without ServeMetrics knowing the observatory exists. Registered
+        # only when the engine enables it, so the key surface stays
+        # "present iff the observatory is on".
+        self._gauge_providers: list = []
+
+    def add_gauge_provider(self, provider) -> None:
+        """Attach a zero-arg callable returning {metric_name: float};
+        its keys ride every `snapshot()` (last writer wins on clashes)."""
+        self._gauge_providers.append(provider)
 
     def _touch(self, now: float) -> None:
         if self._t_first is None:
@@ -160,6 +184,8 @@ class ServeMetrics:
                 out[f"serve/{name}_mean"] = ring.mean()
                 for k, v in ring.percentiles().items():
                     out[f"serve/{name}_{k}"] = v
+        for provider in self._gauge_providers:
+            out.update(provider())
         return out
 
     def emit(self, writer: MetricsWriter, step: int | None = None) -> None:

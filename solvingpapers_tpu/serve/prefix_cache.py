@@ -124,6 +124,17 @@ class PrefixCache:
     def n_nodes(self) -> int:
         return sum(1 for _ in self._walk()) - 1  # exclude root
 
+    def stats(self) -> dict:
+        """Host-cheap snapshot for /statusz and the HBM ledger: tree
+        shape + byte accounting, no device reads."""
+        return {
+            "nodes": self.n_nodes,
+            "bytes_held": self.bytes_held,
+            "max_bytes": self.max_bytes,
+            "evictions": self.evictions,
+            "page": self.page,
+        }
+
     def _walk(self):
         stack = [self.root]
         while stack:

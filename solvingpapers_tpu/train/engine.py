@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import math
 import time
 from typing import Any, Callable, Iterator
 
@@ -99,6 +100,19 @@ class TrainConfig:
     # block_until_ready so step spans are true durations — do not leave it
     # on for production throughput runs.
     trace_path: str | None = None
+    # compile & memory observatory (metrics/xla_obs.py, opt-in): the
+    # train/eval steps route through a CompileRegistry (every XLA
+    # compilation recorded — signature, wall time, cost_analysis
+    # flops/bytes — with recompile-storm flagging) and an HBMLedger
+    # tracks params/opt_state live bytes + projected peak vs device
+    # capacity; compile/* + mem/* + roofline/* gauges ride each logged
+    # metrics row. Observability mode (steps are fenced like trace_path)
+    # — leave off for production throughput runs.
+    xla_obs: bool = False
+    # live /healthz /metrics /statusz endpoint during fit()
+    # (metrics/http.py); port 0 = ephemeral, None = off
+    status_port: int | None = None
+    status_host: str = "127.0.0.1"
     # context parallelism: shard the sequence dim of (B, S) token batches
     # over the mesh 'context' axis and run the whole loss inside shard_map
     # (the model must be built with context_parallel=True so its attention
@@ -174,6 +188,23 @@ class Trainer:
         self._eval_step = None
         self._state_shardings = None
         self._batch_shardings = None
+        # compile & memory observatory (TrainConfig.xla_obs); built in
+        # fit() so the ledger can track the live TrainState
+        self._registry = None
+        self._ledger = None
+        self._status = None
+
+    def _dispatch(self, name: str, jitted, state, batch):
+        """Run a jitted step, through the compile registry when the
+        observatory is on (signature = the batch's leaf shapes; the
+        state's shapes are fixed after init) — one branch when off."""
+        if self._registry is None:
+            return jitted(state, batch)
+        key = tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(batch)
+        )
+        return self._registry.call(name, key, jitted, (state, batch))
 
     # ------------------------------------------------------------------ init
 
@@ -855,6 +886,55 @@ class Trainer:
         if self._train_step is None:
             self._build_steps()
 
+        if cfg.xla_obs and self._registry is None:
+            from solvingpapers_tpu.metrics.xla_obs import (
+                CompileRegistry,
+                HBMLedger,
+                pytree_bytes,
+            )
+
+            self._registry = CompileRegistry(trace=recorder)
+            self._ledger = HBMLedger()
+            # the lambdas close over the loop variable `state`, so the
+            # gauges follow the live TrainState across step rebinding
+            self._ledger.register(
+                "params", lambda: pytree_bytes(state.params)
+            )
+            self._ledger.register(
+                "opt_state", lambda: pytree_bytes(state.opt_state)
+            )
+            self._ledger.temp_fn = self._registry.max_temp_bytes
+        # live status endpoint for the duration of fit(); last_row is
+        # mutated at every log write so /metrics and /statusz always
+        # serve the newest row without re-deriving device values
+        last_row = {"step": int(jax.device_get(state.step)), "metrics": {}}
+        if cfg.status_port is not None:
+            from solvingpapers_tpu.metrics.http import StatusServer
+
+            def _statusz() -> dict:
+                d = {
+                    "train": {"step": last_row["step"],
+                              "steps_total": cfg.steps},
+                    "metrics": last_row["metrics"],
+                }
+                if self._registry is not None:
+                    d["compile"] = self._registry.snapshot()
+                if self._ledger is not None:
+                    d["mem"] = self._ledger.snapshot()
+                return d
+
+            def _metrics_fn() -> tuple[int, dict]:
+                m = dict(last_row["metrics"])
+                if self._registry is not None:
+                    m.update(self._registry.gauges())
+                    m.update(self._ledger.gauges())
+                return last_row["step"], m
+
+            self._status = StatusServer(
+                _statusz, _metrics_fn,
+                host=cfg.status_host, port=cfg.status_port,
+            )
+
         ckpt = None
         start_step = int(jax.device_get(state.step))
         if cfg.checkpoint_dir and cfg.ckpt_every > 0:
@@ -951,7 +1031,9 @@ class Trainer:
                         jax.device_get(metrics["train_loss"])
                         t_tail = time.perf_counter()
                     t_span = recorder.clock() if recorder is not None else 0.0
-                    state, metrics = self._train_step(state, batch)
+                    state, metrics = self._dispatch(
+                        "train_step", self._train_step, state, batch
+                    )
                     if recorder is not None:
                         jax.block_until_ready(metrics)
                         d_span = recorder.clock() - t_span
@@ -993,7 +1075,9 @@ class Trainer:
                         *window,
                     )
                     t_span = recorder.clock() if recorder is not None else 0.0
-                    state, metrics = self._train_step_scan(state, batch)
+                    state, metrics = self._dispatch(
+                        "train_step_scan", self._train_step_scan, state, batch
+                    )
                     if recorder is not None:
                         jax.block_until_ready(metrics)
                         d_span = recorder.clock() - t_span
@@ -1064,11 +1148,23 @@ class Trainer:
                                 from solvingpapers_tpu.metrics.mfu import chip_peak_flops
 
                                 n_chips = self.mesh.devices.size
-                                metrics["mfu"] = (
-                                    metrics["tokens_per_sec"] * cfg.flops_per_token
-                                    / (chip_peak_flops() * n_chips)
-                                )
-                    writer.write(end, {k: float(v) for k, v in metrics.items()})
+                                peak = chip_peak_flops() * n_chips
+                                # NaN-safe: unknown chips have no peak
+                                # table entry — omit the gauge rather
+                                # than log a mis-scaled utilization
+                                if math.isfinite(peak):
+                                    metrics["mfu"] = (
+                                        metrics["tokens_per_sec"]
+                                        * cfg.flops_per_token / peak
+                                    )
+                    row = {k: float(v) for k, v in metrics.items()}
+                    if self._registry is not None:
+                        row.update(self._registry.gauges())
+                        row.update(self._ledger.gauges())
+                        self._ledger.check()
+                    last_row["step"] = end
+                    last_row["metrics"] = row
+                    writer.write(end, row)
 
                 if ckpt is not None and ckpt.save_every > 0 \
                         and end % ckpt.save_every == 0:
@@ -1087,6 +1183,9 @@ class Trainer:
                 final_step = int(jax.device_get(state.step))
                 ckpt.maybe_save(final_step, _pure_state(state), force=True)
         finally:
+            if self._status is not None:
+                self._status.close()
+                self._status = None
             if profiling:
                 jax.profiler.stop_trace()
             if nan_debug_prev is not None:
@@ -1128,7 +1227,9 @@ class Trainer:
         for i, batch in enumerate(eval_iter):
             if i >= self.config.eval_batches:
                 break
-            m = jax.device_get(self._eval_step(state, batch))
+            m = jax.device_get(
+                self._dispatch("eval_step", self._eval_step, state, batch)
+            )
             for k, v in m.items():
                 acc[k] = acc.get(k, 0.0) + float(v)
             n += 1

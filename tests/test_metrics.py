@@ -35,12 +35,16 @@ def test_chip_peak_flops_known_kinds(kind, peak):
 
 
 def test_chip_peak_flops_unknown_kind_warns_once():
+    # unknown chips return the NaN sentinel (a silently-assumed v5e peak
+    # mis-scaled every MFU number); consumers gate on math.isfinite
+    import math
+
     mfu_mod._warned_kinds.clear()
     with pytest.warns(UserWarning, match="unrecognized device_kind"):
-        assert mfu_mod.chip_peak_flops(_FakeDevice("TPU v9x")) == 197e12
+        assert math.isnan(mfu_mod.chip_peak_flops(_FakeDevice("TPU v9x")))
     with warnings.catch_warnings():
         warnings.simplefilter("error")  # second call must not warn again
-        assert mfu_mod.chip_peak_flops(_FakeDevice("TPU v9x")) == 197e12
+        assert math.isnan(mfu_mod.chip_peak_flops(_FakeDevice("TPU v9x")))
 
 
 def test_transformer_flops_per_token():

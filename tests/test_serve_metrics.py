@@ -99,6 +99,70 @@ def test_latency_summaries_present_iff_observed():
     assert "serve/ttft_s_mean" not in snap
 
 
+# ------------------------------------- observatory gauges (mem/compile)
+
+
+def test_observatory_gauges_absent_without_provider():
+    """mem/* and compile/* keys exist IFF the compile & memory
+    observatory registered its gauge providers — a bare ServeMetrics
+    must never grow them."""
+    m = ServeMetrics()
+    m.record_first_token(_req(), now=1.0, prefilled=4)
+    snap = m.snapshot()
+    assert not any(k.startswith(("mem/", "compile/", "roofline/"))
+                   for k in snap)
+
+
+def test_gauge_providers_ride_every_snapshot():
+    m = ServeMetrics()
+    calls = {"n": 0}
+
+    def provider():
+        calls["n"] += 1
+        return {"compile/compilations": 3.0, "mem/kv_pool_bytes": 4096.0,
+                "roofline/decode_block_flops_per_s": 1e9}
+
+    m.add_gauge_provider(provider)
+    snap = m.snapshot()
+    assert snap["compile/compilations"] == 3.0
+    assert snap["mem/kv_pool_bytes"] == 4096.0
+    assert snap["roofline/decode_block_flops_per_s"] == 1e9
+    # resolved per snapshot (live gauges), and base keys survive the merge
+    m.snapshot()
+    assert calls["n"] == 2
+    assert _base_keys() <= set(snap)
+
+
+def test_real_observatory_gauge_key_surface():
+    """The actual CompileRegistry/HBMLedger providers emit the documented
+    key families, every value a float, every name Prometheus-sanitizable
+    (the same contract the snapshot's serve/* keys honor)."""
+    from solvingpapers_tpu.metrics.xla_obs import CompileRegistry, HBMLedger
+
+    m = ServeMetrics()
+    reg = CompileRegistry()
+    ledger = HBMLedger(capacity_bytes=1 << 30)
+    ledger.register("kv_pool", 4096)
+    ledger.temp_fn = reg.max_temp_bytes
+    m.add_gauge_provider(reg.gauges)
+    m.add_gauge_provider(ledger.gauges)
+    snap = m.snapshot()
+    for key in ("compile/programs", "compile/compilations",
+                "compile/recompiles", "compile/storms", "compile/time_s",
+                "mem/kv_pool_bytes", "mem/live_bytes",
+                "mem/program_temp_bytes", "mem/projected_peak_bytes",
+                "mem/capacity_bytes", "mem/headroom_bytes"):
+        assert key in snap, key
+        assert isinstance(snap[key], float), key
+    assert snap["mem/headroom_bytes"] == float((1 << 30) - 4096)
+    # the whole surface must survive the Prometheus sink's name grammar
+    import re
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    for k in snap:
+        assert name_re.match(PrometheusTextWriter.sanitize(k)), k
+
+
 # ------------------------------------------------------- prometheus sink
 
 

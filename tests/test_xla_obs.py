@@ -1,0 +1,413 @@
+"""Compile & memory observatory tests (metrics/xla_obs.py, metrics/http.py).
+
+The contracts under test:
+  * the compile registry records every XLA compilation the engine runs
+    (program name, signature, compile wall time, cost_analysis flops)
+    and the AOT dispatch path is TOKEN-EXACT vs the plain jit path;
+  * an induced recompile storm (shape-bucket misses: one new prefill
+    signature per request) is counted by the registry AND dumped through
+    the existing AnomalyMonitor;
+  * HBM-ledger totals for the KV slot pool and the prefix cache match
+    the analytically computed lane/node byte sizes;
+  * the /healthz /metrics /statusz endpoint serves live engine state,
+    with /metrics in parseable Prometheus text exposition format;
+  * chip_peak_flops / mfu are NaN-safe on CPU and unknown backends;
+  * `cli trace-summary` exits non-zero with a message (no traceback) on
+    missing / truncated / malformed trace JSON;
+  * summarize_trace joins compile events with measured program spans
+    into a per-program roofline section.
+"""
+
+import json
+import math
+import re
+import types
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import importlib
+
+# `metrics.mfu` the ATTRIBUTE is the mfu() function (the package re-
+# exports it); import the submodule by path to reach the module object
+mfu_mod = importlib.import_module("solvingpapers_tpu.metrics.mfu")
+from solvingpapers_tpu.metrics.trace import format_summary, summarize_trace
+from solvingpapers_tpu.metrics.xla_obs import (
+    CompileRegistry,
+    HBMLedger,
+    clear_aot_cache,
+    pytree_bytes,
+)
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+
+pytestmark = pytest.mark.fast
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    model = GPT(GPT_TINY)
+    rng = jax.random.key(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(n, seed=0, lo=4, hi=24):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, GPT_TINY.vocab_size,
+                     size=int(rng.integers(lo, hi))).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------- compile registry
+
+
+def test_registry_records_engine_compilations(gpt_tiny):
+    model, params = gpt_tiny
+    clear_aot_cache()  # observe true compiles, not another test's cache
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8, xla_obs=True,
+    ))
+    handles = [eng.submit(p, max_new_tokens=6) for p in _prompts(3, seed=1)]
+    eng.run()
+    assert all(h.done for h in handles)
+    snap = eng.registry.snapshot()
+    assert "prefill_program" in snap["programs"]
+    assert "decode_block" in snap["programs"]
+    pf = snap["programs"]["prefill_program"]
+    assert pf["compilations"] >= 1
+    assert pf["compile_time_s"] > 0  # true cold compile wall time
+    assert pf["calls"] == 3  # one prefill per admitted request
+    assert pf["flops_per_call"] > 0  # cost_analysis wired through
+    assert pf["run_time_s"] > 0  # fenced dispatch accumulates
+    dec = snap["programs"]["decode_block"]
+    assert dec["signatures"] == 1  # one decode shape per engine
+    # gauges ride ServeMetrics.snapshot()
+    m = eng.metrics.snapshot()
+    assert m["compile/programs"] >= 2.0
+    assert m["compile/compilations"] >= 2.0
+    assert m["compile/time_s"] > 0
+    assert "roofline/prefill_program_flops_per_s" in m
+    assert "roofline/prefill_program_intensity" in m
+    # CPU has no chip-peak table entry -> MFU gauges must be ABSENT, not
+    # garbage (the NaN-sentinel contract)
+    if not math.isfinite(eng.registry.peak_flops):
+        assert not any(k.endswith("_mfu") for k in m)
+
+
+def test_observatory_streams_token_exact(gpt_tiny):
+    """The AOT dispatch path must be invisible in the tokens."""
+    model, params = gpt_tiny
+    prompts = _prompts(4, seed=2)
+    streams = {}
+    for obs in (False, True):
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=2, max_len=64, decode_block=4, bucket=8, xla_obs=obs,
+            prefix_cache=True, prefix_page=4,
+        ))
+        handles = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+        streams[obs] = [h.tokens for h in handles]
+    assert streams[False] == streams[True]
+
+
+def test_recompile_storm_flagged_through_anomaly_monitor(gpt_tiny, tmp_path):
+    """Induce shape-bucket misses (bucket=4, strictly growing prompt
+    lengths -> a NEW prefill signature per admission) and assert the
+    registry counts the storm and the AnomalyMonitor dumps it."""
+    model, params = gpt_tiny
+    dump = str(tmp_path / "anomalies.jsonl")
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=2, bucket=4,
+        xla_obs=True, obs_storm_k=3, obs_storm_window_s=600.0,
+        trace=True, trace_dump_path=dump,
+    ))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the storm warns by design
+        for length in (3, 7, 11, 15):  # pads to 4, 8, 12, 16: all misses
+            eng.submit(np.arange(length, dtype=np.int32) % 64,
+                       max_new_tokens=2)
+            eng.run()
+    snap = eng.registry.snapshot()
+    assert snap["programs"]["prefill_program"]["signatures"] == 4
+    assert snap["storms"] >= 1
+    assert eng.metrics.snapshot()["compile/storms"] >= 1.0
+    records = [json.loads(ln) for ln in open(dump)]
+    storm = [r for r in records if r["kind"] == "recompile_storm"]
+    assert storm, f"no recompile_storm dump in {[r['kind'] for r in records]}"
+    assert storm[0]["detail"]["program"] == "prefill_program"
+    assert storm[0]["detail"]["new_signatures"] >= 3
+    assert storm[0]["events"], "dump must carry the flight-recorder ring"
+
+
+def test_storm_warns_once_per_program(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=2, bucket=4,
+        xla_obs=True, obs_storm_k=2, obs_storm_window_s=600.0,
+    ))
+    with pytest.warns(UserWarning, match="recompile storm"):
+        for length in (3, 7, 11):
+            eng.submit(np.arange(length, dtype=np.int32) % 64,
+                       max_new_tokens=2)
+            eng.run()
+
+
+# ------------------------------------------------------------ HBM ledger
+
+
+def test_ledger_totals_match_analytic_bytes(gpt_tiny):
+    """kv_pool and prefix_cache ledger pools must equal the analytically
+    computed lane/node byte sizes from the model config."""
+    model, params = gpt_tiny
+    n_slots, max_len, page = 2, 64, 4
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=n_slots, max_len=max_len, decode_block=4, bucket=8,
+        xla_obs=True, prefix_cache=True, prefix_page=page,
+    ))
+    # analytic lane bytes: per layer, K and V of shape
+    # (slot, max_len, n_heads, head_dim) in fp32
+    cfg = GPT_TINY
+    head_dim = cfg.dim // cfg.n_heads
+    per_token = cfg.n_layers * 2 * cfg.n_heads * head_dim * 4
+    kv_expected = n_slots * max_len * per_token
+    pools = eng.ledger.pool_bytes()
+    assert pools["kv_pool"] == kv_expected
+    assert pools["params"] == pytree_bytes({"params": params})
+    assert pools["prefix_cache"] == 0  # nothing cached yet
+
+    # one request -> its page-aligned prompt prefix is snapshotted into
+    # the radix tree: node bytes = aligned tokens x per-token lane bytes
+    prompt = np.arange(11, dtype=np.int32) % 64
+    eng.submit(prompt, max_new_tokens=2)
+    eng.run()
+    aligned = (prompt.size - 1) // page * page  # final token never cached
+    assert eng.prefix_cache.bytes_held == aligned * per_token
+    assert eng.ledger.pool_bytes()["prefix_cache"] == aligned * per_token
+    m = eng.metrics.snapshot()
+    assert m["mem/kv_pool_bytes"] == float(kv_expected)
+    assert m["mem/live_bytes"] == float(sum(eng.ledger.pool_bytes().values()))
+    assert m["mem/projected_peak_bytes"] >= m["mem/live_bytes"]
+
+
+def test_ledger_headroom_warns_before_capacity_exceeded():
+    ledger = HBMLedger(capacity_bytes=1000)
+    ledger.register("pool_a", 600)
+    ledger.temp_fn = lambda: 300
+    assert ledger.check() is False  # 900 <= 1000: quiet
+    ledger.register("pool_b", lambda: 200)  # projection now 1100
+    with pytest.warns(UserWarning, match="projected HBM peak"):
+        assert ledger.check() is True
+    assert ledger.check() is True  # still over, but warns only once
+    g = ledger.gauges()
+    assert g["mem/headroom_bytes"] == pytest.approx(-100.0)
+    assert g["mem/capacity_bytes"] == 1000.0
+    snap = ledger.snapshot()
+    assert snap["pools"] == {"pool_a": 600, "pool_b": 200}
+    assert snap["projected_peak_bytes"] == 1100
+
+
+def test_ledger_without_capacity_omits_headroom():
+    ledger = HBMLedger(capacity_bytes=None)
+    if ledger.capacity_bytes is not None:
+        pytest.skip("backend reports a memory limit")
+    ledger.register("p", 128)
+    g = ledger.gauges()
+    assert "mem/capacity_bytes" not in g
+    assert "mem/headroom_bytes" not in g
+    assert ledger.check() is False  # no capacity -> never a false alarm
+
+
+def test_ledger_rejects_duplicate_pool():
+    ledger = HBMLedger(capacity_bytes=None)
+    ledger.register("p", 1)
+    with pytest.raises(ValueError, match="already registered"):
+        ledger.register("p", 2)
+
+
+# ------------------------------------------------------- status endpoint
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def test_status_endpoint_serves_live_engine(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8,
+        xla_obs=True, status_port=0,
+    ))
+    try:
+        handles = [eng.submit(p, max_new_tokens=4)
+                   for p in _prompts(2, seed=3)]
+        eng.run()
+        assert all(h.done for h in handles)
+        base = f"http://127.0.0.1:{eng.status.port}"
+        code, body = _get(base + "/healthz")
+        assert code == 200 and body.strip() == "ok"
+
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        names = set()
+        for line in body.splitlines():
+            if line.startswith("#"):
+                assert line.split()[1] == "TYPE"
+                continue
+            name, value = line.split(" ", 1)
+            assert name_re.match(name), name
+            float(value)  # parseable exposition value
+            names.add(name)
+        assert len(names) == len([ln for ln in body.splitlines()
+                                  if not ln.startswith("#")])  # no dupes
+        assert "serve_requests_finished" in names
+        assert "compile_compilations" in names
+        assert "mem_kv_pool_bytes" in names
+
+        code, body = _get(base + "/statusz")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["engine"]["n_slots"] == 2
+        assert len(doc["slots"]) == 2
+        assert all(s["req"] is None for s in doc["slots"])  # drained
+        assert "prefill_program" in doc["compile"]["programs"]
+        assert doc["mem"]["pools"]["kv_pool"] > 0
+        assert doc["metrics"]["serve/requests_finished"] == 2.0
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+    except urllib.error.HTTPError as e:  # surface the 500 body on failure
+        raise AssertionError(f"{e.url}: {e.read().decode()}") from e
+    finally:
+        eng.close()
+    assert eng.status is None
+    eng.close()  # idempotent
+
+
+# -------------------------------------------------------- mfu NaN-safety
+
+
+def test_chip_peak_flops_known_and_unknown_kinds():
+    v5e = types.SimpleNamespace(device_kind="TPU v5e")
+    assert mfu_mod.chip_peak_flops(v5e) == 197e12
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        cpu = types.SimpleNamespace(device_kind="cpu")
+        assert math.isnan(mfu_mod.chip_peak_flops(cpu))
+        weird = types.SimpleNamespace(device_kind=None)
+        assert math.isnan(mfu_mod.chip_peak_flops(weird))
+
+
+def test_mfu_nan_safe_never_raises():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        cpu = types.SimpleNamespace(device_kind="cpu")
+        assert math.isnan(mfu_mod.mfu(1e4, 1e9, device=cpu))
+        v5e = types.SimpleNamespace(device_kind="TPU v5e")
+        val = mfu_mod.mfu(1e4, 1e9, device=v5e)
+        assert val == pytest.approx(1e4 * 1e9 / 197e12)
+        assert math.isnan(mfu_mod.mfu(float("nan"), 1e9, device=v5e))
+
+
+def test_unknown_kind_warns_once():
+    mfu_mod._warned_kinds.discard("never seen kind")
+    dev = types.SimpleNamespace(device_kind="never seen kind")
+    with pytest.warns(UserWarning, match="unrecognized device_kind"):
+        mfu_mod.chip_peak_flops(dev)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a second warning would raise
+        assert math.isnan(mfu_mod.chip_peak_flops(dev))
+
+
+# ---------------------------------------------- trace-summary robustness
+
+
+def test_trace_summary_missing_file_exits_nonzero(capsys):
+    from solvingpapers_tpu.cli import main
+
+    rc = main(["trace-summary", "/nonexistent/trace.json"])
+    assert rc == 2
+    assert "no trace file" in capsys.readouterr().err
+
+
+def test_trace_summary_truncated_json_exits_nonzero(tmp_path, capsys):
+    from solvingpapers_tpu.cli import main
+
+    p = tmp_path / "truncated.json"
+    p.write_text('{"traceEvents": [{"ph": "X", "name": "st')  # cut mid-write
+    rc = main(["trace-summary", str(p)])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "not valid JSON" in err and "Traceback" not in err
+
+
+def test_trace_summary_malformed_json_exits_nonzero(tmp_path, capsys):
+    from solvingpapers_tpu.cli import main
+
+    p = tmp_path / "wrong.json"
+    p.write_text('"a bare string is not a trace"')
+    rc = main(["trace-summary", str(p)])
+    assert rc == 2
+    assert "Traceback" not in capsys.readouterr().err
+
+
+# ------------------------------------------------- per-program roofline
+
+
+def test_roofline_joins_compiles_with_spans_in_trace_summary(gpt_tiny,
+                                                             tmp_path):
+    """With trace AND xla_obs on, the exported trace carries compile
+    events; summarize_trace joins them with the measured program spans
+    into the per-program roofline surfaced by `cli trace-summary`."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8,
+        xla_obs=True, trace=True,
+    ))
+    for p in _prompts(3, seed=4):
+        eng.submit(p, max_new_tokens=6)
+    eng.run()
+    path = str(tmp_path / "trace.json")
+    eng.trace.export_chrome(path)
+    summary = summarize_trace(path)
+    progs = summary["programs"]
+    assert "prefill_program" in progs and "decode_block" in progs
+    pf = progs["prefill_program"]
+    assert pf["compilations"] >= 1
+    assert pf["calls"] == 3
+    assert pf["total_s"] > 0
+    assert pf["achieved_flops_per_s"] > 0
+    assert pf["intensity_flops_per_byte"] > 0
+    text = format_summary(summary)
+    assert "per-program roofline" in text
+    assert "prefill_program" in text
+    # a plain PR-4 trace (no compile events) keeps its old summary shape
+    plain = summarize_trace({"traceEvents": []})
+    assert plain["programs"] == {}
+    assert "per-program roofline" not in format_summary(plain)
+
+
+def test_pytree_bytes_counts_leaves():
+    tree = {"a": np.zeros((4, 2), np.float32), "b": np.zeros(3, np.int8),
+            "c": 7}  # non-array leaves are skipped, not crashed on
+    assert pytree_bytes(tree) == 4 * 2 * 4 + 3
+
+
+def test_registry_storm_knob_validation():
+    with pytest.raises(ValueError, match="storm_k"):
+        CompileRegistry(storm_k=1)
+    with pytest.raises(ValueError, match="storm_window_s"):
+        CompileRegistry(storm_window_s=0)

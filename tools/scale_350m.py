@@ -21,6 +21,7 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import math
 import time
 
 import jax
@@ -109,13 +110,16 @@ def main() -> None:
 
     tok_s = args.bs * args.seq / dt
     fpt = transformer_flops_per_token(n_params, cfg.n_layers, cfg.dim, args.seq)
-    mfu = tok_s * fpt / chip_peak_flops()
+    peak = chip_peak_flops()
     print(json.dumps({
         "params_m": round(n_params / 1e6, 1), "bs": args.bs, "seq": args.seq,
         "flash": bool(args.flash), "remat": bool(args.remat),
         "block_q": block_q, "block_k": block_k,
         "step_ms": round(dt * 1e3, 1), "tokens_per_sec": round(tok_s),
-        "mfu": round(mfu, 4),
+        # unknown chips have no peak entry (NaN sentinel): omit the key —
+        # json.dumps would emit a bare non-RFC-8259 NaN token
+        **({"mfu": round(tok_s * fpt / peak, 4)}
+           if math.isfinite(peak) else {}),
     }))
 
 
