@@ -519,19 +519,21 @@ def cmd_trace_summary(args) -> int:
         return 0
     # request-less traces: a train trace keeps its per-phase summary even
     # when the observatory also recorded compile events — the roofline
-    # table rides along instead of displacing it
-    from solvingpapers_tpu.metrics.trace import format_roofline
+    # and mesh (bubble/comm) sections ride along instead of displacing it
+    from solvingpapers_tpu.metrics.trace import format_mesh, format_roofline
 
     train = summarize_train_trace(args.trace)
     roofline = format_roofline(summary.get("programs") or {})
+    mesh = format_mesh(summary.get("mesh"))
     if train is not None:
         print(format_train_summary(train))
-        if roofline:
-            print()
-            print(roofline)
+        for section in (roofline, mesh):
+            if section:
+                print()
+                print(section)
         return 0
-    if roofline:
-        print(roofline)
+    if roofline or mesh:
+        print("\n\n".join(s for s in (roofline, mesh) if s))
         return 0
     print(
         f"{args.trace} holds neither request lifecycle events "

@@ -184,8 +184,10 @@ def _cp_generate_program(model, mesh, s0_loc, max_new_tokens, sampler, eos_id):
 
     # check_vma off: the MoE stats path pmean/psums over axes the decode
     # inputs are replicated across (a vma type error, numerically a no-op)
+    from solvingpapers_tpu.sharding.pipeline import shard_map_compat
+
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             body, mesh=mesh,
             in_specs=(P(), P(None, "context"), P()),
             out_specs=P(),

@@ -15,6 +15,7 @@ from solvingpapers_tpu.metrics.trace import (
     AnomalyMonitor,
     FlightRecorder,
     TraceEvent,
+    format_mesh,
     format_summary,
     summarize_trace,
 )
@@ -29,5 +30,14 @@ from solvingpapers_tpu.metrics.xla_obs import (
     HBMLedger,
     device_capacity_bytes,
     pytree_bytes,
+    pytree_device_bytes,
+)
+from solvingpapers_tpu.metrics.mesh_obs import (
+    MeshObservatory,
+    PipelineScheduleInfo,
+    bubble_report,
+    link_bandwidth_bytes_per_s,
+    parse_hlo_collectives,
+    probe_stage_costs,
 )
 from solvingpapers_tpu.metrics.http import StatusServer

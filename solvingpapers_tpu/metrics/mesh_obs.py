@@ -1,0 +1,535 @@
+"""Mesh observatory: collective-traffic ledger, pipeline-bubble
+diagnosis, and per-device trace tracks for the sharding stack.
+
+The flight recorder (metrics/trace.py) sees host wall time and the
+compile observatory (metrics/xla_obs.py) sees single-program cost; a
+sharded engine fails in ways neither can name — a TP program whose
+all-reduces eat the step, a pipeline whose straggler stage doubles the
+bubble, a per-device HBM projection booked at global bytes. This module
+is the mesh-aware layer over both (MegaScale-style straggler/bubble
+diagnosis, Orca-style per-iteration accounting), built BEFORE the serve
+engine is sharded so multi-device regressions land debuggable:
+
+* **Collective ledger** — `parse_hlo_collectives` counts and sizes the
+  `all-reduce` / `all-gather` / `reduce-scatter` / `all-to-all` /
+  `collective-permute` ops in a compiled program's HLO text
+  (`compiled.as_text()`); the `CompileRegistry` runs it per compilation
+  when built with `collectives=True`, so every program the engines
+  dispatch carries its comm-bytes-per-call. Static counts: an op inside
+  a `while` body (a lax.scan schedule) is counted once, not per trip —
+  the ledger answers "which programs talk, how much, over which
+  collective kinds", not cycle-exact traffic. Bytes are the op's OUTPUT
+  shape bytes (the gathered/reduced tensor), a uniform proxy across
+  kinds. Joined with the registry's fenced per-call wall seconds and a
+  chip's ICI bandwidth (`link_bandwidth_bytes_per_s`, NaN-sentinel on
+  CPU/unknown like `chip_peak_flops`), it projects a per-program link
+  time and the gap to the measured wall.
+
+* **Pipeline-bubble diagnosis** — `probe_stage_costs` measures each
+  pipeline stage_fn standalone (forward, or forward+backward for
+  training schedules: the backward unit's cost mirrors 1F1B's
+  vjp-of-recompute); `bubble_report` combines the probed per-stage
+  seconds with the schedule algebra (sharding/pipeline.py
+  `schedule_ticks` / `analytic_bubble_fraction`) into: the analytic
+  balanced bubble fraction (S-1)/(M+S-1), a predicted fraction that
+  folds in the probed imbalance (every tick costs the slowest stage —
+  the schedules are ppermute-lockstep), the straggler stage, and — when
+  a fenced step wall is supplied — the measured fraction
+  1 - useful_work / (devices * wall).
+
+* **Mesh trace tracks** — with a `FlightRecorder` attached,
+  `MeshObservatory.observe_step` stamps one span per (stage, tick) on
+  `stage<N>` tracks, labeled F<i>/B<i>/bubble from the schedule algebra
+  and spread across the FENCED step wall (derived spans: the host
+  cannot see intra-program tick boundaries without a device profiler;
+  the labels are exact, the per-tick durations are wall/ticks). The
+  bubble report is recorded as a `bubble_report` instant so
+  `summarize_trace` / `cli trace-summary` can rebuild the diagnosis
+  offline.
+
+Everything is opt-in (`TrainConfig.mesh_obs`); off means no
+MeshObservatory exists and no `mesh/*` gauge is ever emitted —
+the same None-recorder contract as tracing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+import time
+import warnings
+from typing import Callable, Sequence
+
+import jax
+
+from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+from solvingpapers_tpu.sharding.pipeline import (
+    analytic_bubble_fraction,
+    schedule_ticks,
+    tick_unit,
+)
+
+# --------------------------------------------------- collective ledger
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# "= <output shape(s)> <collective>(" — defining occurrences only:
+# operand references sit inside the parens of another op's definition
+# and are never directly preceded by "= <shape>"; async pairs count at
+# the -start (the -done carries no new traffic); alternation order puts
+# longer names first so "all-reduce" never half-matches "all-reduce-s…".
+_COLL_RE = re.compile(
+    r"=\s*(?P<out>\([^)]*\)|\S+)\s+"
+    r"(?P<op>reduce-scatter|all-reduce|all-gather|all-to-all|"
+    r"collective-permute)"
+    r"(?:-start)?\("
+)
+
+_SHAPE_RE = re.compile(r"(?P<dt>[a-z]\d*[a-z0-9]*|pred)\[(?P<dims>[\d,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _shape_atom_bytes(dt: str, dims: str) -> int:
+    nbytes = _DTYPE_BYTES.get(dt)
+    if nbytes is None:
+        # fall back to the trailing bit-width (f8..., s4, u2, token-free)
+        digits = re.search(r"(\d+)$", dt)
+        nbytes = max(int(digits.group(1)) // 8, 1) if digits else 4
+    n = 1
+    if dims:
+        for d in dims.split(","):
+            n *= int(d)
+    return n * nbytes
+
+
+def parse_hlo_collectives(hlo_text: str) -> dict:
+    """Count and size the collective ops defined in an HLO module's text.
+
+    Returns ``{"ops": N, "bytes": B, "by_type": {kind: {"ops": n,
+    "bytes": b}}}`` — empty counts (``ops == 0``) for a program with no
+    collectives (the single-device case), which is a true zero, not an
+    absence. Bytes are output-shape bytes per op (tuple outputs summed);
+    ops inside while bodies count once (see the module docstring).
+    """
+    by_type: dict[str, dict[str, int]] = {}
+    total_ops = 0
+    total_bytes = 0
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        nbytes = sum(
+            _shape_atom_bytes(s.group("dt"), s.group("dims"))
+            for s in _SHAPE_RE.finditer(m.group("out"))
+        )
+        kind = m.group("op")
+        d = by_type.setdefault(kind, {"ops": 0, "bytes": 0})
+        d["ops"] += 1
+        d["bytes"] += nbytes
+        total_ops += 1
+        total_bytes += nbytes
+    return {"ops": total_ops, "bytes": total_bytes, "by_type": by_type}
+
+
+# aggregate per-chip ICI bandwidth in bytes/s (public spec sheets,
+# bidirectional across all links — planning numbers for projecting link
+# time, same table-or-NaN contract as metrics.mfu.chip_peak_flops)
+_ICI_BYTES_PER_S = {
+    "v4": 300e9,      # 2.4 Tbps
+    "v5 lite": 200e9,  # 1.6 Tbps
+    "v5e": 200e9,
+    "v5": 600e9,      # v5p, 4.8 Tbps
+    "v5p": 600e9,
+    "v6 lite": 448e9,  # 3.584 Tbps
+    "v6e": 448e9,
+}
+
+_warned_kinds: set[str] = set()
+
+
+def link_bandwidth_bytes_per_s(device=None) -> float:
+    """Aggregate ICI bytes/s for `device`, or NaN when unknown (CPU
+    hosts, unlisted chips) — the NaN propagates into an ABSENT link-time
+    gauge, never a mis-scaled one (the chip_peak_flops contract)."""
+    device = device or jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "") or "").lower()
+    for key, val in _ICI_BYTES_PER_S.items():
+        if key in kind:
+            return val
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        warnings.warn(
+            f"link_bandwidth_bytes_per_s: unrecognized device_kind "
+            f"{kind!r}; returning NaN — link-time gauges will be omitted "
+            "(extend metrics.mesh_obs._ICI_BYTES_PER_S for new chips)",
+            stacklevel=2,
+        )
+    return float("nan")
+
+
+# ------------------------------------------------- pipeline stage probe
+
+
+def probe_stage_costs(
+    stage_params,
+    x,
+    stage_fn,
+    *,
+    train: bool = False,
+    reps: int = 3,
+    clock: Callable[[], float] = time.monotonic,
+) -> list[float]:
+    """Measure each pipeline stage standalone: seconds per microbatch
+    unit, per stage.
+
+    `stage_params` is the stacked pytree (leading dim = number of
+    storage rows); `x` one microbatch-shaped activation; `stage_fn`
+    either one callable `(params, x) -> y` (the SPMD schedules' uniform
+    stage) or a sequence of per-stage callables (heterogeneous probes).
+    With `train=True` the probed unit is forward PLUS
+    grad-of-recompute — the cost shape of 1F1B's F unit + B unit (the B
+    unit re-runs the stage forward from its stashed input before the
+    vjp). Each variant jits once and is timed fenced over `reps` runs
+    (min — the schedule's lockstep tick is gated by compute, not by
+    scheduling noise).
+    """
+    n_rows = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    fns = (
+        list(stage_fn) if isinstance(stage_fn, Sequence) else
+        [stage_fn] * n_rows
+    )
+    if len(fns) != n_rows:
+        raise ValueError(
+            f"{len(fns)} stage fns for {n_rows} stage rows"
+        )
+
+    import jax.numpy as jnp
+
+    def unit_of(fn):
+        if not train:
+            return fn
+
+        def unit(p, xx):
+            y = fn(p, xx)  # the F unit
+
+            def scalar(p):  # the B unit: recompute forward, then vjp
+                yy = fn(p, xx)
+                return jnp.sum(yy.astype(jnp.float32) ** 2)
+
+            return y, jax.grad(scalar)(p)
+
+        return unit
+
+    costs: list[float] = []
+    jitted_cache: dict[int, Callable] = {}
+    for s in range(n_rows):
+        p_s = jax.tree_util.tree_map(lambda a: a[s], stage_params)
+        jitted = jitted_cache.get(id(fns[s]))
+        if jitted is None:
+            jitted = jax.jit(unit_of(fns[s]))
+            jitted_cache[id(fns[s])] = jitted
+        jax.block_until_ready(jitted(p_s, x))  # compile outside the timing
+        best = math.inf
+        for _ in range(max(reps, 1)):
+            t0 = clock()
+            jax.block_until_ready(jitted(p_s, x))
+            best = min(best, clock() - t0)
+        costs.append(best)
+    return costs
+
+
+def bubble_report(
+    stage_s: Sequence[float],
+    n_microbatches: int,
+    *,
+    n_devices: int | None = None,
+    schedule: str = "gpipe",
+    measured_step_s: float | None = None,
+) -> dict:
+    """Combine probed per-stage unit seconds with the schedule algebra.
+
+    The schedules are ppermute-lockstep: every tick lasts as long as the
+    slowest stage, so with probed unit costs t_s the predicted pass wall
+    is (M·v + P - 1) · max(t) and the waste fraction (bubble + imbalance)
+    is ``1 - useful / capacity`` with useful = M · Σt and capacity =
+    P · wall. For balanced stages that reduces exactly to the analytic
+    (P-1)/(M·v+P-1). `measured_step_s` (a fenced step wall covering one
+    pipeline pass) yields the measured fraction on the same definition.
+    `stage_s` has one entry per STORAGE ROW (P·v rows under the
+    interleaved schedule); `n_devices` defaults to the row count.
+    """
+    rows = len(stage_s)
+    if rows == 0:
+        raise ValueError("stage_s is empty")
+    n_dev = n_devices or rows
+    if rows % n_dev:
+        raise ValueError(f"{rows} stage rows not divisible by {n_dev} devices")
+    n_virtual = rows // n_dev
+    t_max = max(stage_s)
+    t_sum = sum(stage_s)
+    t_mean = t_sum / rows
+    unit_ticks = n_microbatches * n_virtual + n_dev - 1
+    predicted_step_s = unit_ticks * t_max
+    useful_s = n_microbatches * t_sum
+    report = {
+        "schedule": schedule,
+        "n_devices": n_dev,
+        "n_virtual": n_virtual,
+        "n_microbatches": n_microbatches,
+        "stage_s": [round(t, 6) for t in stage_s],
+        "straggler_stage": int(max(range(rows), key=lambda i: stage_s[i])),
+        "imbalance": round(t_max / t_mean, 4) if t_mean > 0 else 1.0,
+        "analytic_bubble_fraction": round(
+            analytic_bubble_fraction(n_microbatches, n_dev, n_virtual), 4
+        ),
+        "predicted_bubble_fraction": round(
+            1.0 - useful_s / (n_dev * predicted_step_s), 4
+        ) if predicted_step_s > 0 else 0.0,
+        "predicted_step_s": round(predicted_step_s, 6),
+    }
+    if measured_step_s is not None and measured_step_s > 0:
+        report["measured_step_s"] = round(measured_step_s, 6)
+        report["measured_bubble_fraction"] = round(
+            1.0 - useful_s / (n_dev * measured_step_s), 4
+        )
+    return report
+
+
+# ----------------------------------------------------- mesh observatory
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineScheduleInfo:
+    """What the observatory needs to label ticks: devices on the pipe
+    axis, microbatches per pass, virtual slices per device, schedule
+    kind ("gpipe" | "1f1b")."""
+
+    n_stages: int
+    n_microbatches: int
+    n_virtual: int = 1
+    schedule: str = "gpipe"
+
+    @property
+    def ticks(self) -> int:
+        return schedule_ticks(self.n_microbatches, self.n_stages,
+                              self.n_virtual, self.schedule)
+
+
+class MeshObservatory:
+    """Aggregates the mesh-side signals into `mesh/*` gauges, a
+    /statusz section, and mesh trace tracks.
+
+    `registry` (a CompileRegistry built with `collectives=True`)
+    supplies the collective ledger; `schedule` + `set_stage_probe`
+    supply the bubble diagnosis; `trace` (a FlightRecorder or None —
+    the None-recorder pattern) receives per-tick stage spans and the
+    bubble-report instant. `observe_step` expects FENCED step walls
+    (the engine only fences in observability modes). Per-tick span
+    synthesis is capped at `max_step_traces` steps so a long run's ring
+    holds the interesting window without paying O(stages·ticks) host
+    appends forever.
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        registry=None,
+        trace=None,
+        schedule: PipelineScheduleInfo | None = None,
+        link_bandwidth: float | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        max_step_traces: int = 64,
+    ):
+        self.mesh = mesh
+        self.registry = registry
+        self.trace = trace
+        self.schedule = schedule
+        self.link_bw = (
+            link_bandwidth if link_bandwidth is not None
+            else link_bandwidth_bytes_per_s()
+        )
+        self.clock = clock
+        self.max_step_traces = max_step_traces
+        self.n_devices = (
+            int(mesh.devices.size) if mesh is not None else len(jax.devices())
+        )
+        self._stage_probe: dict | None = None  # set_stage_probe kwargs
+        self._last_step_s: float | None = None
+        self._steps_traced = 0
+        self._report_emitted = False
+
+    # ------------------------------------------------------------ inputs
+
+    def attach_trace(self, trace) -> None:
+        """Re-point the observatory at a run's recorder (or None). The
+        engines build one FlightRecorder per fit()/run but keep the
+        observatory across runs — without re-attaching, a second run's
+        mesh events would land in the first run's dead ring. Resets the
+        per-run span-synthesis cap and the report-emitted latch."""
+        self.trace = trace
+        self._steps_traced = 0
+        self._report_emitted = False
+
+    def set_stage_probe(self, stage_s: Sequence[float],
+                        n_microbatches: int) -> None:
+        """Attach probed per-stage unit seconds (probe_stage_costs);
+        the bubble report is recomputed on read against the newest
+        fenced step wall."""
+        self._stage_probe = {
+            "stage_s": list(stage_s),
+            "n_microbatches": n_microbatches,
+        }
+        self._report_emitted = False
+
+    def observe_step(self, ts: float, dur_s: float, steps: int = 1) -> None:
+        """One fenced dispatch: `ts` start on the observatory clock,
+        `dur_s` wall, `steps` train steps inside (a scan window). Feeds
+        the measured bubble fraction and, with a recorder and schedule
+        attached, stamps per-tick spans on the stage tracks."""
+        per_step = dur_s / max(steps, 1)
+        self._last_step_s = per_step
+        if self._stage_probe is not None and not self._report_emitted \
+                and self.trace is not None:
+            report = self.bubble_report()
+            if report is not None:
+                self._report_emitted = True
+                self.trace.instant("bubble_report", "mesh", "mesh", **report)
+        if self.trace is None or self.schedule is None:
+            return
+        # clamp INSIDE the window too: one scan dispatch can carry many
+        # steps, and synthesizing all of them would blow the cap by a
+        # whole window (steps x stages x ticks ring appends)
+        todo = min(max(steps, 1), self.max_step_traces - self._steps_traced)
+        if todo <= 0:
+            return
+        self._steps_traced += todo
+        info = self.schedule
+        ticks = info.ticks
+        tick_s = dur_s / (ticks * max(steps, 1))
+        for k in range(todo):
+            t0 = ts + k * per_step
+            for d in range(info.n_stages):
+                for t in range(ticks):
+                    self.trace.complete(
+                        tick_unit(t, d, info.n_microbatches, info.n_stages,
+                                  info.n_virtual, info.schedule),
+                        "mesh", f"stage{d}",
+                        ts=t0 + t * tick_s, dur=tick_s, tick=t,
+                    )
+
+    # ----------------------------------------------------------- reading
+
+    def bubble_report(self) -> dict | None:
+        """The pipeline-bubble diagnosis, or None before a stage probe
+        ran (never invented)."""
+        if self._stage_probe is None:
+            return None
+        sched = self.schedule
+        return bubble_report(
+            self._stage_probe["stage_s"],
+            self._stage_probe["n_microbatches"],
+            n_devices=sched.n_stages if sched is not None else None,
+            schedule=sched.schedule if sched is not None else "gpipe",
+            measured_step_s=self._last_step_s,
+        )
+
+    def comm(self) -> dict:
+        """Per-program collective ledger joined with measured walls:
+        {program: {ops, bytes, by_type, calls, run_s[, link_s, gap_s]}}.
+        Empty when no registry is attached or nothing compiled yet."""
+        if self.registry is None:
+            return {}
+        stats = self.registry.collective_stats()
+        for d in stats.values():
+            if math.isfinite(self.link_bw) and self.link_bw > 0:
+                d["link_s"] = d["bytes"] / self.link_bw
+                if d.get("calls"):
+                    d["gap_s"] = d["run_s"] / d["calls"] - d["link_s"]
+        return stats
+
+    def gauges(self) -> dict[str, float]:
+        """Flat `mesh/*` metric keys (the log-row / ServeMetrics
+        gauge-provider shape). Present iff the observatory exists —
+        the key-surface contract mirroring `mem/*` / `compile/*`."""
+        out: dict[str, float] = {"mesh/devices": float(self.n_devices)}
+        comm = self.comm()
+        if self.registry is not None:
+            with_coll = {k: v for k, v in comm.items() if v["ops"]}
+            out["mesh/comm_programs"] = float(len(with_coll))
+            out["mesh/comm_ops"] = float(
+                sum(v["ops"] for v in comm.values())
+            )
+            out["mesh/comm_bytes_per_step"] = float(
+                sum(v["bytes"] for v in comm.values())
+            )
+            by_type: dict[str, dict[str, int]] = {}
+            for v in comm.values():
+                for kind, kd in v["by_type"].items():
+                    agg = by_type.setdefault(kind, {"ops": 0, "bytes": 0})
+                    agg["ops"] += kd["ops"]
+                    agg["bytes"] += kd["bytes"]
+            for kind, kd in by_type.items():
+                name = PrometheusTextWriter.sanitize(kind)
+                out[f"mesh/comm_{name}_ops"] = float(kd["ops"])
+                out[f"mesh/comm_{name}_bytes"] = float(kd["bytes"])
+            for prog, v in with_coll.items():
+                name = PrometheusTextWriter.sanitize(prog)
+                out[f"mesh/comm_{name}_bytes"] = float(v["bytes"])
+                if "link_s" in v:
+                    out[f"mesh/comm_{name}_link_s"] = float(v["link_s"])
+                if "gap_s" in v:
+                    out[f"mesh/comm_{name}_gap_s"] = float(v["gap_s"])
+        report = self.bubble_report()
+        if report is not None:
+            out["mesh/bubble_fraction_analytic"] = float(
+                report["analytic_bubble_fraction"]
+            )
+            out["mesh/bubble_fraction_predicted"] = float(
+                report["predicted_bubble_fraction"]
+            )
+            if "measured_bubble_fraction" in report:
+                out["mesh/bubble_fraction_measured"] = float(
+                    report["measured_bubble_fraction"]
+                )
+            out["mesh/straggler_stage"] = float(report["straggler_stage"])
+            out["mesh/stage_imbalance"] = float(report["imbalance"])
+            for d, t in enumerate(report["stage_s"]):
+                out[f"mesh/stage{d}_probe_s"] = float(t)
+        if self._last_step_s is not None:
+            out["mesh/step_wall_s"] = float(self._last_step_s)
+        return out
+
+    def snapshot(self) -> dict:
+        """Structured view for /statusz."""
+        snap: dict = {"devices": self.n_devices}
+        if self.mesh is not None:
+            from solvingpapers_tpu.sharding.mesh import mesh_axis_sizes
+
+            snap["mesh_axes"] = {
+                k: int(v) for k, v in mesh_axis_sizes(self.mesh).items()
+            }
+        if math.isfinite(self.link_bw):
+            snap["link_bandwidth_bytes_per_s"] = self.link_bw
+        comm = self.comm()
+        if comm:
+            snap["comm"] = comm
+        report = self.bubble_report()
+        if report is not None:
+            snap["bubble"] = report
+        if self._last_step_s is not None:
+            snap["step_wall_s"] = self._last_step_s
+        return snap

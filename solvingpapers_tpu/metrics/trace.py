@@ -75,9 +75,10 @@ class TraceEvent:
         return d
 
 
-# fixed display order for the well-known tracks; slot tracks sort by index
-# after them, anything else alphabetically at the end
-_TRACK_ORDER = {"engine": 0, "queue": 1, "prefix": 2, "train": 3}
+# fixed display order for the well-known tracks; slot tracks sort by
+# index after them, then per-device stage tracks (the mesh observatory's
+# pipeline lanes), anything else alphabetically at the end
+_TRACK_ORDER = {"engine": 0, "queue": 1, "prefix": 2, "train": 3, "mesh": 4}
 
 
 def _track_sort_key(track: str) -> tuple:
@@ -85,6 +86,8 @@ def _track_sort_key(track: str) -> tuple:
         return (0, _TRACK_ORDER[track], 0, track)
     if track.startswith("slot") and track[4:].isdigit():
         return (1, 0, int(track[4:]), track)
+    if track.startswith("stage") and track[5:].isdigit():
+        return (1, 1, int(track[5:]), track)
     return (2, 0, 0, track)
 
 
@@ -510,7 +513,7 @@ def summarize_trace(trace) -> dict:
             )
         for k, v in r["phases"].items():
             phase_totals[k] = phase_totals.get(k, 0.0) + v
-    return {
+    summary = {
         "requests": ordered,
         "n_requests": len(ordered),
         "rejected": rejected,
@@ -518,6 +521,83 @@ def summarize_trace(trace) -> dict:
         "phase_totals_s": phase_totals,
         "programs": _program_roofline(events),
     }
+    mesh = _mesh_section(events)
+    if mesh is not None:
+        # present IFF the trace holds mesh-observatory events — a PR-4/5
+        # era trace summarizes without the key (no invented zeros)
+        summary["mesh"] = mesh
+    return summary
+
+
+def _mesh_section(events: list[dict]) -> dict | None:
+    """Rebuild the mesh observatory's view from an exported trace: the
+    per-stage tick timeline (spans on `stage<N>` tracks, cat "mesh"),
+    the last `bubble_report` instant, and the collective ledger (compile
+    events carrying `comm_*` args — recorded when the engine ran with
+    mesh_obs + trace on). None when the trace holds none of the three —
+    the backward-compat contract for traces recorded before the mesh
+    observatory existed."""
+    tid_names = {
+        e.get("tid"): (e.get("args") or {}).get("name")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "thread_name"
+    }
+    stages: dict[str, dict] = {}
+    bubble: dict | None = None
+    comm: dict[str, dict] = {}
+    for e in events:
+        cat = e.get("cat")
+        if cat == "mesh":
+            if e.get("name") == "bubble_report" and e.get("ph") == "i":
+                bubble = dict(e.get("args") or {})
+            elif e.get("ph") == "X":
+                track = tid_names.get(e.get("tid")) or ""
+                if not track.startswith("stage"):
+                    continue
+                d = stages.setdefault(track, {
+                    "ticks": 0, "fwd": 0, "bwd": 0, "bubble": 0,
+                    "busy_s": 0.0, "bubble_s": 0.0,
+                })
+                dur_s = e.get("dur", 0.0) / 1e6
+                d["ticks"] += 1
+                name = e.get("name", "")
+                if name == "bubble":
+                    d["bubble"] += 1
+                    d["bubble_s"] += dur_s
+                else:
+                    d["busy_s"] += dur_s
+                    if name.startswith("B"):
+                        d["bwd"] += 1
+                    else:
+                        d["fwd"] += 1
+        elif cat == "xla" and e.get("name") == "compile":
+            args = e.get("args") or {}
+            if not args.get("comm_ops"):
+                continue
+            prog = args.get("program")
+            if not prog:
+                continue
+            c = comm.setdefault(prog, {"ops": 0, "bytes": 0, "by_type": {}})
+            # the largest-traffic signature stands for the program (the
+            # collective_stats convention)
+            if args.get("comm_bytes", 0) >= c["bytes"]:
+                c["ops"] = args.get("comm_ops", 0)
+                c["bytes"] = args.get("comm_bytes", 0)
+                c["by_type"] = dict(args.get("comm_by_type") or {})
+    if not stages and bubble is None and not comm:
+        return None
+    out: dict = {}
+    if stages:
+        out["stages"] = {
+            k: {**v, "busy_s": round(v["busy_s"], 6),
+                "bubble_s": round(v["bubble_s"], 6)}
+            for k, v in sorted(stages.items(), key=lambda kv: kv[0])
+        }
+    if bubble is not None:
+        out["bubble"] = bubble
+    if comm:
+        out["comm"] = comm
+    return out
 
 
 def _program_roofline(events: list[dict]) -> dict:
@@ -634,6 +714,63 @@ def format_summary(summary: dict, top: int = 5) -> str:
     if roofline:
         lines.append("")
         lines.append(roofline)
+    mesh = format_mesh(summary.get("mesh"))
+    if mesh:
+        lines.append("")
+        lines.append(mesh)
+    return "\n".join(lines)
+
+
+def format_mesh(mesh: dict | None) -> str:
+    """Human-readable mesh-observatory report (the `mesh` section of
+    `summarize_trace`), or "" when the trace held no mesh events."""
+    if not mesh:
+        return ""
+    lines: list[str] = []
+    bubble = mesh.get("bubble")
+    if bubble:
+        lines.append(
+            f"pipeline bubble report ({bubble.get('schedule')}, "
+            f"{bubble.get('n_devices')} stages x "
+            f"{bubble.get('n_microbatches')} microbatches):"
+        )
+        frac = [f"analytic={bubble.get('analytic_bubble_fraction')}"]
+        if bubble.get("predicted_bubble_fraction") is not None:
+            frac.append(f"predicted={bubble['predicted_bubble_fraction']}")
+        if bubble.get("measured_bubble_fraction") is not None:
+            frac.append(f"measured={bubble['measured_bubble_fraction']}")
+        lines.append("  bubble fraction: " + "  ".join(frac))
+        lines.append(
+            f"  straggler: stage{bubble.get('straggler_stage')} "
+            f"(imbalance {bubble.get('imbalance')}x mean; per-stage probe "
+            f"{bubble.get('stage_s')}s)"
+        )
+    stages = mesh.get("stages")
+    if stages:
+        lines.append("per-stage tick timeline (derived from fenced steps):")
+        lines.append(
+            f"  {'stage':<8} {'ticks':>6} {'fwd':>5} {'bwd':>5} "
+            f"{'bubble':>7} {'busy_s':>9} {'bubble_s':>9}"
+        )
+        for name, d in stages.items():
+            lines.append(
+                f"  {name:<8} {d['ticks']:>6} {d['fwd']:>5} {d['bwd']:>5} "
+                f"{d['bubble']:>7} {d['busy_s']:>9.4f} "
+                f"{d['bubble_s']:>9.4f}"
+            )
+    comm = mesh.get("comm")
+    if comm:
+        lines.append("collective ledger (static per-call counts, "
+                     "output-shape bytes):")
+        lines.append(f"  {'program':<18} {'ops':>5} {'bytes':>12}  by type")
+        for prog, d in sorted(comm.items(), key=lambda kv: -kv[1]["bytes"]):
+            kinds = ", ".join(
+                f"{k}x{v.get('ops', 0)}"
+                for k, v in sorted(d.get("by_type", {}).items())
+            )
+            lines.append(
+                f"  {prog:<18} {d['ops']:>5} {d['bytes']:>12}  {kinds}"
+            )
     return "\n".join(lines)
 
 

@@ -28,7 +28,9 @@ serving stacks triage capacity and latency regressions with:
 * `HBMLedger` — named live-byte pools (`params`, `kv_pool`,
   `prefix_cache`, `opt_state`, ...) registered as zero-arg providers and
   read lazily, plus the registry's max per-program temp bytes, give a
-  projected decode-step peak; against the device capacity
+  projected decode-step peak; capacity is a PER-CHIP number, so mesh-
+  aware providers use `pytree_device_bytes` (shard_shape bytes per
+  device) rather than global bytes; against the device capacity
   (`memory_stats()["bytes_limit"]` where the backend reports it, or an
   explicit override) the ledger computes headroom and warns BEFORE the
   projected peak exceeds capacity — the admission-control signal, not
@@ -67,12 +69,41 @@ from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
 
 
 def pytree_bytes(tree) -> int:
-    """Total bytes of every array leaf in a pytree (device or host)."""
+    """Total GLOBAL bytes of every array leaf in a pytree (device or
+    host) — the logical array sizes, regardless of sharding."""
     total = 0
     for leaf in jax.tree_util.tree_leaves(tree):
         size = getattr(leaf, "size", None)
         itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
         if size is not None and itemsize is not None:
+            total += int(size) * int(itemsize)
+    return total
+
+
+def pytree_device_bytes(tree) -> int:
+    """PER-DEVICE bytes of a pytree: a sharded leaf occupies its
+    `Sharding.shard_shape` bytes on each device, not its global bytes —
+    the number HBM capacity accounting must book under a mesh (a
+    TP-sharded kernel costs 1/model of its global size per chip; a
+    replicated one costs full size everywhere). Host arrays and leaves
+    without a sharding fall back to global bytes (single-device
+    semantics, where the two coincide)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if itemsize is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                total += int(
+                    math.prod(sharding.shard_shape(leaf.shape)) * itemsize
+                )
+                continue
+            except Exception:  # exotic sharding: global beats a crash
+                pass
+        size = getattr(leaf, "size", None)
+        if size is not None:
             total += int(size) * int(itemsize)
     return total
 
@@ -114,7 +145,8 @@ class _Executable:
     """One compiled program variant + its compile-time analyses."""
 
     __slots__ = ("compiled", "jitted", "compile_s", "flops",
-                 "bytes_accessed", "temp_bytes", "arg_bytes", "out_bytes")
+                 "bytes_accessed", "temp_bytes", "arg_bytes", "out_bytes",
+                 "collectives")
 
     def __init__(self, compiled, jitted, compile_s: float):
         self.compiled = compiled
@@ -125,6 +157,10 @@ class _Executable:
         self.temp_bytes = 0
         self.arg_bytes = 0
         self.out_bytes = 0
+        # parse_hlo_collectives result, or None while unparsed (parsing
+        # is lazy and gated on CompileRegistry(collectives=True) — the
+        # HLO text render is not free, and most registries never ask)
+        self.collectives: dict | None = None
         try:
             ca = compiled.cost_analysis()
             d = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
@@ -213,6 +249,7 @@ class CompileRegistry:
         storm_window_s: float = 60.0,
         clock: Callable[[], float] = time.monotonic,
         time_programs: bool = True,
+        collectives: bool = False,
     ):
         if storm_k < 2:
             raise ValueError(f"storm_k must be >= 2, got {storm_k}")
@@ -226,6 +263,10 @@ class CompileRegistry:
         self.storm_window_s = storm_window_s
         self.clock = clock
         self.time_programs = time_programs
+        # mesh observatory mode (metrics/mesh_obs.py): parse each
+        # compiled program's HLO text for collective ops so the ledger
+        # can report per-program comm bytes — compile-time-only cost
+        self.collectives = collectives
         self._programs: dict[str, _ProgramStats] = {}
         self._lock = threading.Lock()
         # chip peak for per-program MFU; NaN on backends without a table
@@ -281,6 +322,19 @@ class CompileRegistry:
             exe = _Executable(compiled, jitted, self.clock() - t0)
             with _AOT_LOCK:
                 exe = _AOT_CACHE.setdefault(global_key, exe)
+        if self.collectives and exe.collectives is None:
+            # lazy (a cache hit may come from a registry that never
+            # parsed); a benign race would just parse twice
+            from solvingpapers_tpu.metrics.mesh_obs import (
+                parse_hlo_collectives,
+            )
+
+            try:
+                exe.collectives = parse_hlo_collectives(
+                    exe.compiled.as_text()
+                )
+            except Exception:  # backend without as_text: absent, not 0s
+                exe.collectives = {}
         sig = _SigStats(exe, cached)
         with self._lock:
             st.signatures[key] = sig
@@ -314,6 +368,15 @@ class CompileRegistry:
             )
             if math.isfinite(self.peak_flops):
                 ev["peak_flops"] = self.peak_flops
+            if exe.collectives and exe.collectives.get("ops"):
+                # collective ledger (mesh observatory on): the offline
+                # trace-summary comm section joins on these
+                ev["comm_ops"] = exe.collectives["ops"]
+                ev["comm_bytes"] = exe.collectives["bytes"]
+                ev["comm_by_type"] = {
+                    k: dict(v)
+                    for k, v in exe.collectives["by_type"].items()
+                }
             self.trace.instant("compile", "xla", "xla", **ev)
         if storm:
             if not st.storm_warned:
@@ -333,6 +396,42 @@ class CompileRegistry:
         return sig
 
     # ------------------------------------------------------------- reading
+
+    def collective_stats(self) -> dict:
+        """Per-program collective ledger (programs whose registry was
+        built with `collectives=True` and that parsed): {program:
+        {"ops", "bytes", "by_type", "calls", "run_s"}} — ops/bytes from
+        the largest-traffic signature (the steady-state variant, the
+        flops_per_call convention), calls/run_s summed for the wall
+        join. A compiled program with no collectives reports a true
+        zero; an unparsed one (registry built without the flag) is
+        simply absent."""
+        with self._lock:
+            out = {}
+            for name, st in self._programs.items():
+                best: dict | None = None
+                for s in st.signatures.values():
+                    c = s.exe.collectives
+                    # None = never parsed; {} = parse FAILED (as_text
+                    # unavailable) — both are absence, never a zero. A
+                    # parsed zero-collective program carries the full
+                    # {"ops": 0, "bytes": 0, "by_type": {}} structure.
+                    if not c:
+                        continue
+                    if best is None or c.get("bytes", 0) > best.get(
+                            "bytes", 0):
+                        best = c
+                if best is None:
+                    continue
+                out[name] = {
+                    "ops": best.get("ops", 0),
+                    "bytes": best.get("bytes", 0),
+                    "by_type": {k: dict(v)
+                                for k, v in best.get("by_type", {}).items()},
+                    "calls": st.calls,
+                    "run_s": st.run_s,
+                }
+        return out
 
     def max_temp_bytes(self) -> int:
         """Largest per-program XLA temp allocation seen — the scratch the
@@ -416,10 +515,22 @@ class CompileRegistry:
                     ),
                     "_flops": st.weighted_flops(),
                     "_bytes": st.weighted_bytes(),
+                    # -1 = no signature parsed (collectives off, or the
+                    # parse failed — empty dict): the key is dropped
+                    # below rather than faked as zero
+                    "_comm": max(
+                        (s.exe.collectives.get("bytes", 0)
+                         if s.exe.collectives else -1
+                         for s in st.signatures.values()),
+                        default=-1,
+                    ),
                 }
                 for name, st in self._programs.items()
             }
         for d in progs.values():
+            comm = d.pop("_comm")
+            if comm >= 0:
+                d["comm_bytes_per_call"] = comm
             flops, nbytes = d.pop("_flops"), d.pop("_bytes")
             if d["run_time_s"] > 0 and d["calls"]:
                 d["achieved_flops_per_s"] = flops / d["run_time_s"]

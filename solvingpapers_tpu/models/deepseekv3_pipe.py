@@ -180,7 +180,8 @@ class DSV3Pipe:
             # init runs inside shard_map (blocks trace the context ring); a
             # constant dummy is axis-invariant and would clash with the
             # ring's varying carries under the vma checker
-            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+            if hasattr(jax.lax, "pcast"):  # no-op without vma typing
+                dummy = jax.lax.pcast(dummy, ("context",), to="varying")
 
         from solvingpapers_tpu.models.staged import interleaved_storage_order
 

@@ -143,7 +143,8 @@ class GPTPipe:
             # init runs inside shard_map (the blocks trace the context
             # ring); a constant dummy is axis-invariant and would clash
             # with the ring's varying carries under the vma checker
-            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+            if hasattr(jax.lax, "pcast"):  # no-op without vma typing
+                dummy = jax.lax.pcast(dummy, ("context",), to="varying")
 
         def stage_init(key):
             blocks = {}
@@ -209,6 +210,14 @@ class GPTPipe:
                 None if rng is None else jax.random.fold_in(rng, j),
             )
         return x
+
+    def stage_probe_fn(self, mb: int, seq: int):
+        """Standalone per-stage callable for the mesh observatory's
+        bubble probe (metrics/mesh_obs.probe_stage_costs): the
+        schedule's rng/virtual kwargs stripped. GPT blocks carry their
+        positions in the embedded input, so the shape args are unused."""
+        del mb, seq
+        return lambda p, x: self._stage_fn(p, x)
 
     def apply(
         self,

@@ -117,7 +117,8 @@ class LlamaPipe:
             cfg.compute_dtype,
         )
         if cfg.context_parallel:
-            dummy = jax.lax.pcast(dummy, ("context",), to="varying")
+            if hasattr(jax.lax, "pcast"):  # no-op without vma typing
+                dummy = jax.lax.pcast(dummy, ("context",), to="varying")
         from solvingpapers_tpu.models.staged import interleaved_storage_order
 
         stacked = init_stage_stack(
@@ -165,6 +166,17 @@ class LlamaPipe:
             return x
 
         return stage_fn
+
+    def stage_probe_fn(self, mb: int, seq: int):
+        """Standalone per-stage callable for the mesh observatory's
+        bubble probe (metrics/mesh_obs.probe_stage_costs): the stage
+        closure built over plain microbatch positions, rng/virtual
+        kwargs stripped."""
+        positions = default_positions(
+            mb, seq, False, max_positions=self.cfg.max_seq_len
+        )
+        fn = self._stage_fn(positions)
+        return lambda p, x: fn(p, x)
 
     def apply(
         self,

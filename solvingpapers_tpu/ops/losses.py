@@ -123,7 +123,8 @@ def _chunked_nll_sum_count(
     # under shard_map with vma tracking, the carry must match the body
     # output's varying axes (the logits are shard-varying on CP paths)
     zero = jnp.float32(0.0)
-    vma = tuple(getattr(jax.typeof(flat), "vma", ()) or ())
+    _typeof = getattr(jax, "typeof", None)  # absent pre-vma jax: no tracking
+    vma = tuple(getattr(_typeof(flat), "vma", ()) or ()) if _typeof else ()
     if vma:
         zero = jax.lax.pcast(zero, vma, to="varying")
     (tot, num), _ = jax.lax.scan(body, (zero, zero), (flat, lab))

@@ -56,7 +56,8 @@ def _psum_axes(x: jax.Array, axis_names) -> tuple:
     shard_map vma checker a psum over an invariant axis is a type error
     (e.g. CP x PP meshes where 'data' has size 1); without vma tracking
     the full tuple is kept (the extra psums are numeric no-ops)."""
-    vma = getattr(jax.typeof(x), "vma", None)
+    _typeof = getattr(jax, "typeof", None)  # absent pre-vma jax: no tracking
+    vma = getattr(_typeof(x), "vma", None) if _typeof else None
     if vma is None:
         return tuple(axis_names)
     return tuple(a for a in axis_names if a in vma)

@@ -17,6 +17,7 @@ from solvingpapers_tpu.sharding.mesh import (
     batch_spec,
     batch_sharding,
     get_ambient_mesh,
+    mesh_axis_sizes,
 )
 from solvingpapers_tpu.sharding.rules import (
     GPT_RULES,
@@ -34,8 +35,13 @@ from solvingpapers_tpu.sharding.ring_attention import (
     ulysses_attention_local,
 )
 from solvingpapers_tpu.sharding.pipeline import (
+    analytic_bubble_fraction,
     pipeline_apply,
+    schedule_ticks,
+    shard_map_compat,
     stack_stage_params,
+    tick_unit,
+    vma_axes,
 )
 from solvingpapers_tpu.sharding.distributed import (
     initialize as initialize_distributed,

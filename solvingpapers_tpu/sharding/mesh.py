@@ -89,6 +89,12 @@ def create_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def mesh_axis_sizes(mesh: Mesh) -> dict[str, int]:
+    """{axis_name: size} for a mesh — the lookup the engines and the
+    mesh observatory repeat (pipe depth, data-shard count, ...)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
 def batch_spec(extra_dims: int = 1, context: bool = False) -> P:
     """PartitionSpec for a batch-leading array: batch over (data, fsdp);
     with `context`, the next (sequence) dim over the 'context' axis — the

@@ -20,7 +20,100 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def vma_axes(x) -> frozenset:
+    """Varying-manual-axes of `x` under the jax-0.9 vma checker, or an
+    empty set on jax versions without `jax.typeof` (no vma tracking — and
+    every pcast in the schedules is gated on a nonempty result, so the
+    schedules degrade to plain SPMD semantics there)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(x), "vma", ()) or ())
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=None):
+    """jax.shard_map where it exists (passing `check_vma` when given);
+    the legacy jax.experimental.shard_map with the rep checker off
+    elsewhere (the legacy checker predates the vma typing the
+    schedules' pcasts target, and check_rep=False matches the
+    check_vma=False semantics the schedules are written for). THE
+    jax-version shim for every shard_map in this repo — exported from
+    `solvingpapers_tpu.sharding`; new multi-device code should route
+    through it rather than calling jax.shard_map directly."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+# short internal aliases (the schedule bodies below use them heavily)
+_vma = vma_axes
+_shard_map = shard_map_compat
+
+
+# ------------------------------------------------------ schedule algebra
+#
+# The tick math the schedules below implement, exposed as plain functions
+# so the mesh observatory (metrics/mesh_obs.py) can label per-tick trace
+# spans and compute bubble fractions without re-deriving (and drifting
+# from) the schedule internals.
+
+
+def schedule_ticks(n_microbatches: int, n_stages: int, n_virtual: int = 1,
+                   schedule: str = "gpipe") -> int:
+    """Scan length of one pipeline pass. GPipe/interleaved forward:
+    m*v + P - 1 ticks; 1F1B (forward AND backward units interleaved):
+    2(m + P) - 2 ticks, i.e. ~m + P - 1 full F+B unit-pairs."""
+    if schedule == "1f1b":
+        if n_virtual != 1:
+            raise ValueError("1f1b does not compose with virtual stages")
+        return 2 * (n_microbatches + n_stages) - 2
+    return n_microbatches * n_virtual + n_stages - 1
+
+
+def analytic_bubble_fraction(n_microbatches: int, n_stages: int,
+                             n_virtual: int = 1) -> float:
+    """The balanced-stage bubble fraction (P-1)/(m*v + P - 1): the share
+    of a pipeline pass spent ramping/draining when every stage costs the
+    same. Holds for the forward schedules tick-for-tick and for 1F1B in
+    F+B unit-pairs (its steady state is bubble-free, the ramp is the
+    same P-1 units)."""
+    return (n_stages - 1) / (n_microbatches * n_virtual + n_stages - 1)
+
+
+def tick_unit(t: int, device: int, n_microbatches: int, n_stages: int,
+              n_virtual: int = 1, schedule: str = "gpipe") -> str:
+    """Which unit device `device` computes at tick `t`: "F<i>" (forward,
+    microbatch i), "B<i>" (1F1B backward), "F<i>.v<j>" (interleaved,
+    virtual slice j), or "bubble" (ramp/drain garbage compute — this
+    implementation's bubbles BURN a tick computing masked-out garbage,
+    they do not idle). Mirrors the schedule bodies above exactly."""
+    m, P, v = n_microbatches, n_stages, n_virtual
+    if schedule == "1f1b":
+        rel_f = t - device
+        if rel_f >= 0 and rel_f % 2 == 0 and rel_f // 2 < m:
+            return f"F{rel_f // 2}"
+        rel_b = t - (2 * P - 1 - device)
+        if rel_b >= 0 and rel_b % 2 == 0 and rel_b // 2 < m:
+            return f"B{rel_b // 2}"
+        return "bubble"
+    rel = t - device
+    if rel < 0 or rel >= m * v:
+        return "bubble"
+    if v == 1:
+        return f"F{rel}"
+    g = rel // (v * P)
+    i = rel % P
+    j = (rel % (v * P)) // P
+    return f"F{g * P + i}.v{j}"
 
 
 def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
@@ -59,8 +152,8 @@ def _pipeline_local(stage_params, microbatches, stage_fn, axis_name,
     # tracking via the stage params, which enter sharded over the pipe axis
     # and therefore read as pipe-varying exactly when tracking is on.
     probe = jax.tree.leaves(stage_params)[0]
-    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
-    if tracking and axis_name not in jax.typeof(microbatches).vma:
+    tracking = axis_name in _vma(probe)
+    if tracking and axis_name not in _vma(microbatches):
         microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
     buf = jnp.zeros_like(microbatches[0])  # current activation on this device
     out = jnp.zeros_like(microbatches)     # collected at the last stage
@@ -172,8 +265,8 @@ def _pipeline_local_interleaved(stage_params, microbatches, stage_fn,
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     probe = jax.tree.leaves(stage_params)[0]
-    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
-    if tracking and axis_name not in jax.typeof(microbatches).vma:
+    tracking = axis_name in _vma(probe)
+    if tracking and axis_name not in _vma(microbatches):
         microbatches = jax.lax.pcast(microbatches, (axis_name,), to="varying")
     buf = jnp.zeros_like(microbatches[0])
     out = jnp.zeros_like(microbatches)
@@ -426,21 +519,19 @@ def pipeline_1f1b_value_and_grad(
     is_last = stage_id == n_stages - 1
 
     probe = jax.tree.leaves(stage_params)[0]
-    tracking = axis_name in getattr(jax.typeof(probe), "vma", frozenset())
+    tracking = axis_name in _vma(probe)
     # the schedule's carries must be varying over the pipe axis AND over
     # whatever batch axes the inputs already vary over (under the Trainer
     # the microbatches enter data-sharded), or the cond branches/scan
     # carry would type-mismatch under the vma checker
     _target_vma = {axis_name}
     for _x in (microbatches, targets, *jax.tree.leaves(head_params)):
-        _target_vma |= set(getattr(jax.typeof(_x), "vma", ()) or ())
+        _target_vma |= set(_vma(_x))
 
     def mark(x):
         if not tracking:
             return x
-        missing = tuple(
-            _target_vma - set(getattr(jax.typeof(x), "vma", ()) or ())
-        )
+        missing = tuple(_target_vma - set(_vma(x)))
         return jax.lax.pcast(x, missing, to="varying") if missing else x
 
     microbatches = mark(microbatches)
@@ -552,7 +643,7 @@ def pipeline_1f1b_value_and_grad(
             )
             # the cotangent's varying-axes type must match the primal's
             ct = jnp.ones((), f32)
-            vma = tuple(getattr(jax.typeof(primal), "vma", ()) or ())
+            vma = tuple(_vma(primal))
             if vma:
                 ct = jax.lax.pcast(ct, vma, to="varying")
             dp, dh, dx, _, _ = vjp(ct)
@@ -638,7 +729,7 @@ def pipeline_apply(
     fn = functools.partial(
         _pipeline_local, stage_fn=stage_fn, axis_name=axis_name
     )
-    out = jax.shard_map(
+    out = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(params_spec, P()),

@@ -18,9 +18,11 @@ from __future__ import annotations
 
 import functools
 
+from solvingpapers_tpu.sharding.pipeline import shard_map_compat
+
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from solvingpapers_tpu.ops.attention import BIG_NEG, repeat_kv
 
@@ -108,7 +110,7 @@ def ring_attention(
     fn = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
@@ -410,7 +412,7 @@ def ring_flash_attention(
         ring_flash_attention_local, axis_name=axis_name, causal=causal,
         scale=scale, interpret=interpret,
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )(q, k, v)
@@ -461,7 +463,7 @@ def ulysses_attention(
     fn = functools.partial(
         ulysses_attention_local, axis_name=axis_name, attn_fn=attn_fn
     )
-    return jax.shard_map(
+    return shard_map_compat(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

@@ -37,11 +37,21 @@ from solvingpapers_tpu.sharding import (
     create_mesh,
     param_specs,
 )
+from solvingpapers_tpu.sharding.pipeline import shard_map_compat
 from solvingpapers_tpu.train.optim import OptimizerConfig, make_optimizer
 from solvingpapers_tpu.train.state import TrainState
 
 # loss_fn(model, params, batch, rng, model_state, train) -> (loss, aux, new_model_state)
 LossFn = Callable[..., tuple[jax.Array, dict, Any]]
+
+# vma typing (jax.typeof / jax.shard_map's check_vma) exists from jax 0.9;
+# on older jax `shard_map_compat` (sharding/pipeline.py) runs the
+# legacy experimental shard_map with its rep checker off, which matches
+# the check_vma=False semantics every schedule here is also written for
+# — `Trainer._check_vma` reports False on such jax so the pmean paths
+# reduce over all axes, the plain SPMD semantics (hasattr swallows the
+# module-level deprecation getattr)
+_HAS_VMA = hasattr(jax, "typeof")
 
 
 def _pp_param_spec(path, _leaf) -> P:
@@ -109,6 +119,19 @@ class TrainConfig:
     # metrics row. Observability mode (steps are fenced like trace_path)
     # — leave off for production throughput runs.
     xla_obs: bool = False
+    # mesh observatory (metrics/mesh_obs.py, opt-in): extends the
+    # compile observatory with (1) a collective ledger — every compiled
+    # program's HLO parsed for all-reduce/all-gather/reduce-scatter/
+    # all-to-all/collective-permute ops, per-program comm bytes as
+    # mesh/comm_* gauges; (2) pipeline-bubble diagnosis when
+    # pipeline_parallel — each stage_fn probed standalone after the
+    # compile step, analytic (S-1)/(M+S-1) vs measured bubble fraction
+    # and the straggler stage in gauges, /statusz and trace-summary;
+    # (3) per-device HBM ledger math (shard_shape bytes, not global);
+    # (4) per-tick stage<N> trace tracks when trace_path is also set.
+    # Implies the compile registry (xla_obs); observability mode —
+    # steps are fenced, so leave off for production throughput runs.
+    mesh_obs: bool = False
     # live /healthz /metrics /statusz endpoint during fit()
     # (metrics/http.py); port 0 = ephemeral, None = off
     status_port: int | None = None
@@ -188,10 +211,12 @@ class Trainer:
         self._eval_step = None
         self._state_shardings = None
         self._batch_shardings = None
-        # compile & memory observatory (TrainConfig.xla_obs); built in
-        # fit() so the ledger can track the live TrainState
+        # compile & memory observatory (TrainConfig.xla_obs) and mesh
+        # observatory (TrainConfig.mesh_obs); built in fit() so the
+        # ledger can track the live TrainState
         self._registry = None
         self._ledger = None
+        self._mesh_obs = None
         self._status = None
 
     def _dispatch(self, name: str, jitted, state, batch):
@@ -218,7 +243,7 @@ class Trainer:
                 # a CP model's forward calls axis collectives, so init must
                 # also run inside shard_map; identical rngs/shapes on every
                 # shard make the params replicated (out_specs P())
-                out = jax.shard_map(
+                out = shard_map_compat(
                     lambda r, b: self.init_fn(self.model, r, b),
                     mesh=self.mesh, in_specs=(P(), self._batch_specs()),
                     out_specs=P(), check_vma=self._check_vma(),
@@ -459,8 +484,11 @@ class Trainer:
         """vma checking must be off whenever the model's attention core is
         a pallas kernel: a pallas_call inside lax.scan under the jax-0.9
         vma checker KeyErrors in the closed_call lowering cache. One gate
-        for every shard_map this Trainer builds (CP loss, PP loss, CP init)."""
-        return not getattr(getattr(self.model, "cfg", None), "use_flash", False)
+        for every shard_map this Trainer builds (CP loss, PP loss, CP init).
+        Always False on jax without vma typing (the legacy shard_map path)."""
+        return _HAS_VMA and not getattr(
+            getattr(self.model, "cfg", None), "use_flash", False
+        )
 
     def _pp_1f1b_vg_call(self):
         """Loss AND grads via the 1F1B schedule (TrainConfig.pp_schedule
@@ -556,7 +584,7 @@ class Trainer:
             # holds its shard-local grads (verified against per-shard
             # oracles), and the ONE explicit psum/n above is the whole
             # cross-shard story.
-            loss, aux, grads, new_ms = jax.shard_map(
+            loss, aux, grads, new_ms = shard_map_compat(
                 local, mesh=self.mesh,
                 in_specs=(p_specs, P(), batch_specs, P()),
                 out_specs=(P(), P(), p_specs, P()),
@@ -592,12 +620,13 @@ class Trainer:
             # aux may mix shard-varying values (per-shard loss terms) with
             # already-invariant ones (psum'd MoE stats). Under the vma
             # checker, reduce only the axes a value actually varies over;
-            # without vma tracking (check_vma=False), the plain pmean of an
-            # invariant value is a numeric no-op anyway.
-            vma = getattr(jax.typeof(a), "vma", None)
-            if check_vma and vma is not None:
-                ax = tuple(x for x in axes if x in vma)
-                return jax.lax.pmean(a, ax) if ax else a
+            # without vma tracking (check_vma=False, incl. pre-vma jax),
+            # the plain pmean of an invariant value is a numeric no-op.
+            if check_vma:  # only ever True when jax.typeof exists
+                vma = getattr(jax.typeof(a), "vma", None)
+                if vma is not None:
+                    ax = tuple(x for x in axes if x in vma)
+                    return jax.lax.pmean(a, ax) if ax else a
             return jax.lax.pmean(a, axes)
 
         def call(params, model_state, batch, rng, train):
@@ -644,7 +673,7 @@ class Trainer:
             # must leave replicated: the model's in-step updates have to be
             # shard-invariant (psum'd loads — DeepSeekV3Config.stats_axes);
             # out_specs P() asserts that contract under the vma checker
-            loss, aux, new_ms = jax.shard_map(
+            loss, aux, new_ms = shard_map_compat(
                 local, mesh=self.mesh,
                 in_specs=(p_specs, P(), batch_specs, P()),
                 out_specs=(P(), P(), P()), check_vma=check_vma,
@@ -886,24 +915,65 @@ class Trainer:
         if self._train_step is None:
             self._build_steps()
 
-        if cfg.xla_obs and self._registry is None:
+        if (cfg.xla_obs or cfg.mesh_obs) and self._registry is None:
             from solvingpapers_tpu.metrics.xla_obs import (
                 CompileRegistry,
                 HBMLedger,
-                pytree_bytes,
+                pytree_device_bytes,
             )
 
-            self._registry = CompileRegistry(trace=recorder)
+            # mesh_obs implies the compile registry (the collective
+            # ledger reads compiled HLO) with per-program HLO parsing on
+            self._registry = CompileRegistry(trace=recorder,
+                                             collectives=cfg.mesh_obs)
             self._ledger = HBMLedger()
             # the lambdas close over the loop variable `state`, so the
-            # gauges follow the live TrainState across step rebinding
+            # gauges follow the live TrainState across step rebinding;
+            # PER-DEVICE bytes (shard_shape), not global — capacity is a
+            # per-chip number and fsdp/pipe-sharded pools must not book
+            # their full global size against it
             self._ledger.register(
-                "params", lambda: pytree_bytes(state.params)
+                "params", lambda: pytree_device_bytes(state.params)
             )
             self._ledger.register(
-                "opt_state", lambda: pytree_bytes(state.opt_state)
+                "opt_state", lambda: pytree_device_bytes(state.opt_state)
             )
             self._ledger.temp_fn = self._registry.max_temp_bytes
+        if cfg.mesh_obs and self._mesh_obs is None:
+            from solvingpapers_tpu.metrics.mesh_obs import (
+                MeshObservatory,
+                PipelineScheduleInfo,
+            )
+            from solvingpapers_tpu.sharding import mesh_axis_sizes
+
+            sched = None
+            mcfg = getattr(self.model, "cfg", None)
+            if cfg.pipeline_parallel and mcfg is not None:
+                sched = PipelineScheduleInfo(
+                    n_stages=mesh_axis_sizes(self.mesh).get("pipe", 1),
+                    n_microbatches=getattr(mcfg, "n_microbatches", 1),
+                    n_virtual=getattr(mcfg, "virtual_stages", 1),
+                    schedule=cfg.pp_schedule,
+                )
+            self._mesh_obs = MeshObservatory(
+                mesh=self.mesh, registry=self._registry, trace=recorder,
+                schedule=sched,
+            )
+        # registry/observatory persist across fit() calls but the
+        # recorder is per-run: re-attach so a resumed fit's compile and
+        # mesh events land in ITS trace, not the first run's dead ring
+        if self._registry is not None:
+            self._registry.trace = recorder
+        if self._mesh_obs is not None:
+            self._mesh_obs.attach_trace(recorder)
+        # observability modes fence every dispatch so step walls are
+        # device-true; _obs_clock is the shared time base
+        _fenced = recorder is not None or self._mesh_obs is not None
+        _obs_clock = (
+            recorder.clock if recorder is not None
+            else self._mesh_obs.clock if self._mesh_obs is not None
+            else None
+        )
         # live status endpoint for the duration of fit(); last_row is
         # mutated at every log write so /metrics and /statusz always
         # serve the newest row without re-deriving device values
@@ -921,6 +991,8 @@ class Trainer:
                     d["compile"] = self._registry.snapshot()
                 if self._ledger is not None:
                     d["mem"] = self._ledger.snapshot()
+                if self._mesh_obs is not None:
+                    d["mesh"] = self._mesh_obs.snapshot()
                 return d
 
             def _metrics_fn() -> tuple[int, dict]:
@@ -928,6 +1000,8 @@ class Trainer:
                 if self._registry is not None:
                     m.update(self._registry.gauges())
                     m.update(self._ledger.gauges())
+                if self._mesh_obs is not None:
+                    m.update(self._mesh_obs.gauges())
                 return last_row["step"], m
 
             self._status = StatusServer(
@@ -1030,17 +1104,18 @@ class Trainer:
                         # out of the step timing, like eval/checkpoint
                         jax.device_get(metrics["train_loss"])
                         t_tail = time.perf_counter()
-                    t_span = recorder.clock() if recorder is not None else 0.0
+                    t_span = _obs_clock() if _fenced else 0.0
                     state, metrics = self._dispatch(
                         "train_step", self._train_step, state, batch
                     )
-                    if recorder is not None:
+                    if _fenced:
                         jax.block_until_ready(metrics)
-                        d_span = recorder.clock() - t_span
+                        d_span = _obs_clock() - t_span
                         compiled = step == start_step
-                        recorder.complete("step", "train", "train",
-                                          ts=t_span, dur=d_span, steps=1,
-                                          compiled=int(compiled))
+                        if recorder is not None:
+                            recorder.complete("step", "train", "train",
+                                              ts=t_span, dur=d_span, steps=1,
+                                              compiled=int(compiled))
                         if not compiled:
                             # goodput's numerator counts TRAINING time;
                             # folding the first step's jit compile in
@@ -1049,6 +1124,8 @@ class Trainer:
                             # the denominator, so compile-dominated runs
                             # honestly read as low goodput)
                             step_span_total += d_span
+                            if self._mesh_obs is not None:
+                                self._mesh_obs.observe_step(t_span, d_span)
                     if exclude_compile:
                         jax.device_get(metrics["train_loss"])
                         t_prev += time.perf_counter() - t_tail
@@ -1074,24 +1151,35 @@ class Trainer:
                                      else np.stack(xs)),
                         *window,
                     )
-                    t_span = recorder.clock() if recorder is not None else 0.0
+                    t_span = _obs_clock() if _fenced else 0.0
                     state, metrics = self._dispatch(
                         "train_step_scan", self._train_step_scan, state, batch
                     )
-                    if recorder is not None:
+                    if _fenced:
                         jax.block_until_ready(metrics)
-                        d_span = recorder.clock() - t_span
+                        d_span = _obs_clock() - t_span
                         compiled = step == start_step
-                        recorder.complete("step", "train", "train",
-                                          ts=t_span, dur=d_span, steps=kk,
-                                          compiled=int(compiled))
+                        if recorder is not None:
+                            recorder.complete("step", "train", "train",
+                                              ts=t_span, dur=d_span, steps=kk,
+                                              compiled=int(compiled))
                         if not compiled:  # see the kk == 1 branch
                             step_span_total += d_span
+                            if self._mesh_obs is not None:
+                                self._mesh_obs.observe_step(
+                                    t_span, d_span, steps=kk
+                                )
                 if step == start_step:
                     # fence the first step so compile time never pollutes
                     # step_time/tokens_per_sec/MFU metrics; the timed window
                     # therefore starts at the NEXT step
                     jax.device_get(metrics["train_loss"])
+                    if self._mesh_obs is not None and cfg.pipeline_parallel:
+                        # one-time stage probe for the bubble report,
+                        # after the compile step (params live, jit warm)
+                        # and before t_prev resets so its wall never
+                        # leaks into step timing
+                        self._probe_pipeline_stages(state, batch)
                     t_prev = time.perf_counter()
                     last_log_step = end
 
@@ -1162,6 +1250,8 @@ class Trainer:
                         row.update(self._registry.gauges())
                         row.update(self._ledger.gauges())
                         self._ledger.check()
+                    if self._mesh_obs is not None:
+                        row.update(self._mesh_obs.gauges())
                     last_row["step"] = end
                     last_row["metrics"] = row
                     writer.write(end, row)
@@ -1211,6 +1301,60 @@ class Trainer:
                 recorder.export_chrome(cfg.trace_path)
                 writer.write(step, {"goodput": goodput})
         return state
+
+    def _probe_pipeline_stages(self, state, batch) -> None:
+        """One-time mesh-observatory stage probe (TrainConfig.mesh_obs +
+        pipeline_parallel): run each stage_fn standalone on one
+        microbatch-shaped activation, forward plus grad-of-recompute
+        (the 1F1B unit-cost shape; a fair proxy for the GPipe backward
+        too), and hand the per-stage seconds to the observatory — the
+        bubble report then compares them against every later fenced step
+        wall. Diagnosis must never kill training: any failure degrades
+        to a warning and the report stays absent."""
+        import warnings
+
+        obs = self._mesh_obs
+        mcfg = getattr(self.model, "cfg", None)
+        probe_hook = getattr(self.model, "stage_probe_fn", None)
+        params = state.params if isinstance(state.params, dict) else {}
+        stages = params.get("stages")
+        if obs is None or mcfg is None or stages is None:
+            return
+        if probe_hook is None:
+            # explicit, not silent: the diagnosis needs a standalone
+            # per-stage callable and this model does not provide one
+            # (GPTPipe/LlamaPipe do; DSV3Pipe's stage_fn is entangled
+            # with the routing-bias stack and axis_index)
+            warnings.warn(
+                f"mesh_obs: {type(self.model).__name__} has no "
+                "stage_probe_fn — pipeline bubble diagnosis skipped "
+                "(collective ledger and stage trace tracks still run)",
+                stacklevel=2,
+            )
+            return
+        try:
+            from solvingpapers_tpu.metrics.mesh_obs import probe_stage_costs
+            from solvingpapers_tpu.sharding import mesh_axis_sizes
+
+            sizes = mesh_axis_sizes(self.mesh)
+            x_leaf = batch["x"] if isinstance(batch, dict) \
+                else jax.tree_util.tree_leaves(batch)[0]
+            seq = int(x_leaf.shape[-1])
+            n_micro = int(getattr(mcfg, "n_microbatches", 1))
+            local_b = self.config.batch_size // max(
+                sizes.get("data", 1) * sizes.get("fsdp", 1), 1
+            )
+            mb = max(local_b // n_micro, 1)
+            x = jnp.zeros(
+                (mb, seq, int(mcfg.dim)),
+                getattr(mcfg, "compute_dtype", jnp.float32),
+            )
+            stage_s = probe_stage_costs(
+                stages, x, probe_hook(mb, seq), train=True,
+            )
+            obs.set_stage_probe(stage_s, n_micro)
+        except Exception as e:  # noqa: BLE001 — observability, not training
+            warnings.warn(f"mesh_obs stage probe failed: {e}", stacklevel=2)
 
     def evaluate(self, state: TrainState, eval_iter: Iterator[dict]) -> dict:
         if self._eval_step is None:

@@ -85,6 +85,7 @@ def test_1f1b_matches_sequential_grads(devices):
 
     from solvingpapers_tpu.sharding.pipeline import (
         pipeline_1f1b_value_and_grad,
+        shard_map_compat,
     )
 
     n_stages, d, m, mb = 4, 8, 8, 2
@@ -116,7 +117,7 @@ def test_1f1b_matches_sequential_grads(devices):
             stage_local, head, micro, targets, mlp_stage, loss_fn
         )
 
-    l_new, dstage_new, dhead_new, dmicro_new = jax.shard_map(
+    l_new, dstage_new, dhead_new, dmicro_new = shard_map_compat(
         f1b, mesh=mesh,
         in_specs=(jax.tree.map(lambda _: P("pipe"), stacked), P(), P(), P()),
         out_specs=(P(), jax.tree.map(lambda _: P("pipe"), stacked), P(),

@@ -219,6 +219,8 @@ def test_pp_dropout_units_decorrelated():
     mesh = create_mesh(MeshConfig(pipe=2), jax.devices()[:2])
     from jax.sharding import PartitionSpec as P
 
+    from solvingpapers_tpu.sharding.pipeline import shard_map_compat
+
     def local(p, t):
         logits, _ = model.apply(
             {"params": p}, t, deterministic=False,
@@ -231,7 +233,7 @@ def test_pp_dropout_units_decorrelated():
     )
     specs = dict(specs, stages=jax.tree.map(lambda _: P("pipe"),
                                             params["stages"]))
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map_compat(
         local, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
         check_vma=False,
     ))

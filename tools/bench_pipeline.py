@@ -219,16 +219,85 @@ def _memory_body_1f1b(n_stages: int, batch: int = 64, seq: int = 512,
     }), flush=True)
 
 
+def _mesh_obs_overhead_body(n_steps: int = 24) -> None:
+    """Paired ABBA mesh-obs overhead arm (the BENCH_serve.json
+    trace/obs-overhead convention): a 2-stage 1F1B GPTPipe fit with
+    TrainConfig.mesh_obs off (A) and on (B), run A B B A so monotonic
+    load drift cancels, comparing the engine's own logged steady-state
+    step_time_s. mesh_obs is observability mode (fenced dispatches +
+    collective-ledger parse at compile + one stage probe outside the
+    timed window); the budget it must hold is the established 2%."""
+    import jax
+    import numpy as np
+
+    from solvingpapers_tpu.data.batches import lm_batch_iterator
+    from solvingpapers_tpu.models.gpt_pipe import GPTPipe, GPTPipeConfig
+    from solvingpapers_tpu.sharding import (
+        MeshConfig, PP_RULES, batch_sharding, create_mesh,
+    )
+    from solvingpapers_tpu.train import OptimizerConfig, TrainConfig, Trainer
+
+    mesh_cfg = MeshConfig(data=4, pipe=2)
+    mesh = create_mesh(mesh_cfg, jax.devices()[:8])
+
+    class _Last:
+        def __init__(self):
+            self.step_time = None
+
+        def write(self, step, metrics):
+            if "step_time_s" in metrics:
+                self.step_time = metrics["step_time_s"]
+
+        def close(self):
+            pass
+
+    def arm(mesh_obs: bool) -> float:
+        cfg = GPTPipeConfig(
+            vocab_size=256, block_size=128, dim=128, n_layers=2, n_heads=4,
+            n_stages=2, n_microbatches=4, pipeline_parallel=True,
+        )
+        tcfg = TrainConfig(
+            steps=n_steps, batch_size=32, log_every=n_steps, eval_every=0,
+            mesh=mesh_cfg, pipeline_parallel=True, pp_schedule="1f1b",
+            mesh_obs=mesh_obs,
+            optimizer=OptimizerConfig(max_lr=1e-3, total_steps=n_steps),
+        )
+        trainer = Trainer(GPTPipe(cfg), tcfg, rules=PP_RULES, mesh=mesh)
+        toks = np.random.default_rng(0).integers(0, 256, size=200_000)
+        it = lm_batch_iterator(toks, 32, cfg.block_size,
+                               sharding=batch_sharding(mesh))
+        w = _Last()
+        trainer.fit(it, writer=w)
+        return float(w.step_time)
+
+    walls = [arm(obs) for obs in (False, True, True, False)]  # A B B A
+    off = (walls[0] + walls[3]) / 2
+    on = (walls[1] + walls[2]) / 2
+    print(json.dumps({
+        "mesh_obs_overhead": {
+            "steps_per_arm": n_steps,
+            "step_time_s_off": round(off, 6),
+            "step_time_s_on": round(on, 6),
+            "mesh_obs_overhead_pct": round(100 * (on - off) / off, 2),
+        }
+    }), flush=True)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--stages", type=int, default=4)
     p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--mesh-obs", action="store_true",
+                   help="run only the paired ABBA mesh-obs overhead arm")
     args = p.parse_args()
 
     import jax
 
     if len(jax.devices()) >= 8:
-        _body(args.stages, args.batch)
+        if args.mesh_obs:
+            _mesh_obs_overhead_body()
+        else:
+            _body(args.stages, args.batch)
         return 0
     # re-exec on the virtual CPU mesh (same recipe as __graft_entry__)
     import re
@@ -239,12 +308,20 @@ def main() -> int:
     env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
     env["JAX_PLATFORMS"] = "cpu"
     here = pathlib.Path(__file__).resolve().parent.parent
-    snippet = (
-        "import jax; jax.config.update('jax_platforms', 'cpu'); "
-        f"import sys; sys.path.insert(0, {str(here)!r}); "
-        "from tools.bench_pipeline import _body; "
-        f"_body({args.stages}, {args.batch})"
-    )
+    if args.mesh_obs:
+        snippet = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.path.insert(0, {str(here)!r}); "
+            "from tools.bench_pipeline import _mesh_obs_overhead_body; "
+            "_mesh_obs_overhead_body()"
+        )
+    else:
+        snippet = (
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            f"import sys; sys.path.insert(0, {str(here)!r}); "
+            "from tools.bench_pipeline import _body; "
+            f"_body({args.stages}, {args.batch})"
+        )
     proc = subprocess.run([sys.executable, "-c", snippet], env=env,
                           cwd=str(here))
     return proc.returncode
