@@ -388,9 +388,10 @@ def cmd_serve_bench(args) -> int:
     """Continuous-batching engine vs sequential one-shot generate on a
     synthetic Poisson arrival stream — or, with --shared-prefix, prefix
     cache on vs off over K shared system prompts, or, with --sampling,
-    a per-request SamplingParams mix vs all-greedy on the same trace
-    (serve/bench.py); prints the BENCH-shaped JSON and optionally writes
-    it to --out."""
+    a per-request SamplingParams mix vs all-greedy on the same trace,
+    or, with --paged, the paged KV pool vs the lane pool (throughput,
+    equal-HBM capacity, zero-copy prefix TTFT) (serve/bench.py); prints
+    the BENCH-shaped JSON and optionally writes it to --out."""
     if args.checkpoint_dir or args.data_path:
         print(
             "serve-bench benchmarks scheduling throughput on random-init "
@@ -398,11 +399,12 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if args.shared_prefix and args.sampling:
-        print("--shared-prefix and --sampling are separate workloads; "
-              "pick one per run", file=sys.stderr)
+    if sum((args.shared_prefix, args.sampling, args.paged)) > 1:
+        print("--shared-prefix, --sampling and --paged are separate "
+              "workloads; pick one per run", file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
+        run_paged_bench,
         run_prefix_bench,
         run_sampling_bench,
         run_serve_bench,
@@ -425,7 +427,24 @@ def cmd_serve_bench(args) -> int:
         status_port=args.status_port,
         status_hold_s=args.status_hold_s,
     )
-    if args.sampling:
+    if args.paged:
+        result = run_paged_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(args.prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            n_prefixes=args.n_prefixes,
+            prefix_requests=args.prefix_requests,
+            suffix_len=args.suffix_len,
+            page_size=args.page_size,
+            seed=args.seed,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.sampling:
         result = run_sampling_bench(
             config=args.config,
             n_requests=n_requests,
@@ -713,6 +732,21 @@ def main(argv=None) -> int:
                               "trace decoded all-greedy vs with a "
                               "per-request temperature/top-p/top-k/min-p "
                               "mix (serve/bench.py run_sampling_bench)")
+    p_serve.add_argument("--paged", action="store_true",
+                         help="paged-KV-pool workload instead: ABBA-paired "
+                              "paged vs lane pool on the Poisson trace, a "
+                              "capacity arm at equal HBM (2x slots, "
+                              "lane-equivalent page budget), and a "
+                              "shared-prefix arm with zero-copy page "
+                              "sharing (serve/bench.py run_paged_bench)")
+    p_serve.add_argument("--page-size", type=int, default=16,
+                         help="[--paged] tokens per KV page "
+                              "(ServeConfig.page_size)")
+    p_serve.add_argument("--prefix-requests", type=int, default=None,
+                         help="[--paged] request count for the "
+                              "shared-prefix sub-arm (default 48, the "
+                              "committed measurement regime; CI smokes "
+                              "pass a small value)")
     p_serve.add_argument("--n-prefixes", type=int, default=4,
                          help="[--shared-prefix] distinct system prompts K")
     p_serve.add_argument("--prefix-len", type=int, default=None,

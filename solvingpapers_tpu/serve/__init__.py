@@ -1,9 +1,15 @@
-"""Continuous-batching serving: slot pool + FIFO scheduler + mixed
-prefill/decode engine + radix-tree prefix cache + per-request sampling
+"""Continuous-batching serving: slot/paged KV pools + FIFO scheduler +
+mixed prefill/decode engine + radix-tree prefix cache (zero-copy
+refcounted page sharing on the paged pool) + per-request sampling
 (SamplingParams / fused_sample) + latency metrics."""
 
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
-from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
+from solvingpapers_tpu.serve.kv_pool import (
+    KVSlotPool,
+    PagedKVPool,
+    extract_lane,
+    store_lane,
+)
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
 from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
@@ -13,6 +19,7 @@ __all__ = [
     "ServeConfig",
     "ServeEngine",
     "KVSlotPool",
+    "PagedKVPool",
     "extract_lane",
     "store_lane",
     "ServeMetrics",

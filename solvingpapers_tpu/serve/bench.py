@@ -26,6 +26,13 @@ all-greedy vs a per-request temperature/top-p/top-k/min-p mix
 sort-based masking relative to the sort-free greedy fast path, i.e. the
 price of SamplingParams when a batch actually uses them.
 
+`run_paged_bench` (`cli serve-bench --paged`) is the fourth: the paged
+KV pool against the lane pool — ABBA-paired Poisson throughput at equal
+slots+HBM (the paging tax), a capacity arm at EQUAL HBM with double the
+slots (peak concurrency > lane slot count = the decoupling claim), and
+an ABBA-paired shared-prefix arm whose zero-copy page-sharing hit TTFT
+is proven copy-free by the compile registry (no splice program exists).
+
 With `trace=True` every workload runs one EXTRA arm — the same arrival
 trace with the flight recorder on (`metrics/trace.py`) — and records
 `trace_overhead_pct` (tracing-on vs tracing-off req/s) in its detail,
@@ -175,24 +182,14 @@ def _paired_makespans(model, params, extra, requests, on_cfg, off_cfg,
     load drift (one side owns the last slot); ABBA + mean cancels linear
     drift exactly, and `reps=4` (8 runs) averages the residual noise
     below the 2% acceptance budget. Returns (mk_on, mk_off, last on-arm
-    engine)."""
-    mk_on: list[float] = []
-    mk_off: list[float] = []
-    eng = None
-    for rep in range(reps):
-        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
-        for arm in order:
-            e, _, mk = _run_engine_arm(
-                model, params, extra, requests,
-                on_cfg if arm == "on" else off_cfg, max_new,
-                params_for=params_for,
-            )
-            if arm == "on":
-                eng = e
-                mk_on.append(mk)
-            else:
-                mk_off.append(mk)
-    return mk_on, mk_off, eng
+    engine). Thin view over `_paired_arm_stats` — ONE implementation of
+    the pairing discipline every overhead number depends on."""
+    runs, engines = _paired_arm_stats(
+        model, params, extra, requests, on_cfg, off_cfg, max_new,
+        reps=reps, params_for=params_for,
+    )
+    return ([mk for mk, _ in runs["on"]],
+            [mk for mk, _ in runs["off"]], engines["on"])
 
 
 def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
@@ -616,6 +613,272 @@ def run_prefix_bench(
             **probe_fields,
             **trace_fields,
         },
+    }
+
+
+def _paired_arm_stats(model, params, extra, requests, on_cfg, off_cfg,
+                      max_new, reps: int = 2, params_for=None):
+    """ABBA-paired runs keeping each side's last engine + per-run
+    (makespan, metrics snapshot). THE single implementation of the
+    pairing discipline (`_paired_makespans` is a thin view over it) —
+    see that docstring for why ABBA + mean is the shape every overhead
+    number in BENCH_serve.json uses."""
+    runs = {"on": [], "off": []}
+    engines = {"on": None, "off": None}
+    for rep in range(reps):
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for arm in order:
+            eng, _, mk = _run_engine_arm(
+                model, params, extra, requests,
+                on_cfg if arm == "on" else off_cfg, max_new,
+                params_for=params_for,
+            )
+            runs[arm].append((mk, eng.metrics.snapshot()))
+            engines[arm] = eng
+    return runs, engines
+
+
+def _peak_concurrency(handles) -> int:
+    """Max simultaneously-active slots, reconstructed from the
+    requests' own [admit, finish) intervals — no per-step polling in
+    the timed loop."""
+    events = []
+    for h in handles:
+        if h.admit_time is not None and h.finish_time is not None:
+            events.append((h.admit_time, 1))
+            events.append((h.finish_time, -1))
+    events.sort()
+    cur = peak = 0
+    for _, d in events:
+        cur += d
+        peak = max(peak, cur)
+    return peak
+
+
+def run_paged_bench(
+    config: str = "gpt_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    n_prefixes: int = 4,
+    prefix_requests: int | None = None,
+    suffix_len: int = 8,
+    page_size: int = 16,
+    seed: int = 0,
+    reps: int = 2,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """Paged KV pool vs the lane pool — three sub-workloads, one entry.
+
+    1. Poisson (ABBA-paired, same slots, same HBM): the paging tax —
+       gather/scatter page translation vs contiguous lanes
+       (`paged_overhead_pct` on req/s).
+    2. Capacity at EQUAL HBM: a paged engine with 2x the slots but a
+       page budget equal to the lane pool's byte footprint, on a
+       shorter-stream workload; `capacity_peak_active` > `n_slots`
+       demonstrates slot count decoupled from max_seq (the HBM-ledger
+       bytes for both pools are in the entry).
+    3. Shared-prefix (ABBA-paired, paged cache-on vs cache-off): the
+       prefix-hit TTFT win with ZERO-COPY page sharing — the observatory
+       probe proves no splice/extract program is ever dispatched
+       (`splice_programs_dispatched` stays 0).
+    """
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    # page tables need whole pages per lane: round max_len up
+    max_len = -(-(max_prompt + max_new) // page_size) * page_size
+    limit = getattr(model, "max_positions", None)
+    if limit is not None and max_len > limit:
+        max_len = limit // page_size * page_size
+    base = dict(
+        n_slots=n_slots, max_len=max_len, decode_block=decode_block,
+        bucket=min(32, max_prompt), max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests), seed=seed,
+    )
+    lane_cfg = ServeConfig(**base)
+    paged_cfg = ServeConfig(**base, paged=True, page_size=page_size)
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    # observatory probe on the paged arm: cold compile times + the
+    # ledger's projected peak with the page pool booked
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, paged_cfg, max_new,
+        status_port=status_port,
+    )
+    try:
+        # ---- 1. Poisson: paged vs lane at the same slots + HBM -------
+        _run_engine_arm(model, params, extra, warm, lane_cfg, max_new)
+        runs, engines = _paired_arm_stats(
+            model, params, extra, requests, paged_cfg, lane_cfg, max_new,
+            reps=reps,
+        )
+        paged_rps = len(requests) / (
+            sum(mk for mk, _ in runs["on"]) / len(runs["on"]))
+        lane_rps = len(requests) / (
+            sum(mk for mk, _ in runs["off"]) / len(runs["off"]))
+        detail = {
+            "config": config,
+            "workload": "paged-vs-lane",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "page_size": page_size,
+            "max_len": max_len,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "paged_requests_per_sec": round(paged_rps, 2),
+            "lane_requests_per_sec": round(lane_rps, 2),
+            "paged_overhead_pct": round(
+                (1.0 - paged_rps / lane_rps) * 100.0, 2
+            ),
+            "paged_kv_pool_bytes": int(engines["on"].pool.nbytes),
+            "lane_kv_pool_bytes": int(engines["off"].pool.nbytes),
+            **probe_fields,
+        }
+
+        # ---- 2. capacity at equal HBM: 2x slots, lane-pool bytes -----
+        cap_new = max(8, max_new // 4)  # shorter streams: the mixed-
+        # length regime where per-page booking beats whole-lane booking
+        cap_budget = n_slots * (max_len // page_size)
+        cap_cfg = ServeConfig(**{**base, "n_slots": 2 * n_slots},
+                              paged=True, page_size=page_size,
+                              page_budget=cap_budget)
+        # observatory pass first: the "equal HBM" claim is about
+        # RESIDENT pool bytes; the paged decode's gather materializes a
+        # (2S, max_len, ...) lane view as PROGRAM TEMP, which must be
+        # reported alongside it, not hidden (on a capacity-squeezed
+        # device temp is the difference between fitting and OOM)
+        cap_obs = dataclasses.replace(cap_cfg, xla_obs=True)
+        obs_cap_eng, _, _ = _run_engine_arm(
+            model, params, extra, warm, cap_obs, cap_new,
+        )
+        cap_temp = int(obs_cap_eng.registry.max_temp_bytes())
+        _run_engine_arm(model, params, extra, warm, cap_cfg, cap_new)
+        cap_eng, cap_handles, cap_mk = _run_engine_arm(
+            model, params, extra, requests, cap_cfg, cap_new,
+        )
+        cap_snap = cap_eng.metrics.snapshot()
+        detail.update({
+            "capacity_n_slots": 2 * n_slots,
+            "capacity_page_budget": cap_budget,
+            "capacity_max_new_tokens": cap_new,
+            "capacity_peak_active_slots": _peak_concurrency(cap_handles),
+            "capacity_kv_pool_bytes": int(cap_eng.pool.nbytes),
+            "capacity_program_temp_bytes": cap_temp,
+            "capacity_requests_per_sec": round(n_requests / cap_mk, 2),
+            "capacity_preemptions": int(
+                cap_snap.get("serve/preemptions", 0.0)
+            ),
+        })
+
+        # ---- 3. shared-prefix: zero-copy hit TTFT -------------------
+        # run_prefix_bench's regime, where the TTFT story lives: long
+        # stems, tiny generation budget — a hit skips the stem's
+        # prefill, so prefill must dominate the request (the Poisson
+        # arm's 64-token decode would bury it under queue wait)
+        pmax_new = min(max_new, 4)
+        pblock = min(decode_block, 4)
+        # stretch the stem to the model's position budget (the regime
+        # the prefix cache exists for — a long system prompt ahead of a
+        # short tail), independent of the Poisson arm's tighter max_len
+        pmax_len = (limit or 256) // page_size * page_size
+        plen = max(page_size,
+                   ((pmax_len - suffix_len - pmax_new) // page_size)
+                   * page_size)
+        # run_prefix_bench's measurement regime (48 requests, 2 ms mean
+        # gap, the long-stem config): a tighter flood makes mean TTFT
+        # queue-wait-dominated and the speedup estimate noisy run-to-run
+        pn = 48 if prefix_requests is None else prefix_requests
+        preqs = shared_prefix_requests(
+            pn, vocab, n_prefixes=n_prefixes, prefix_len=plen,
+            suffix_len=suffix_len, mean_interarrival_s=0.002,
+            seed=seed,
+        )
+        pbase = dict(base, max_len=pmax_len,
+                     bucket=max(8, -(-suffix_len // 8) * 8),
+                     decode_block=pblock)
+        pcfg_on = ServeConfig(**pbase, paged=True, page_size=page_size,
+                              prefix_cache=True, prefix_page=page_size)
+        pcfg_off = ServeConfig(**pbase, paged=True, page_size=page_size)
+        lane_on = ServeConfig(**pbase, prefix_cache=True,
+                              prefix_page=page_size)
+        pwarm = shared_prefix_requests(
+            2 * n_prefixes, vocab, n_prefixes=n_prefixes, prefix_len=plen,
+            suffix_len=suffix_len, mean_interarrival_s=0.0, seed=seed + 1,
+        )
+        _run_engine_arm(model, params, extra, pwarm, pcfg_on, pmax_new)
+        _run_engine_arm(model, params, extra, pwarm, pcfg_off, pmax_new)
+        _run_engine_arm(model, params, extra, pwarm, lane_on, pmax_new)
+        # pair A: paged cache-on vs cache-off — the hit's TTFT win
+        # (one extra rep over the throughput pairs: TTFT means are
+        # noisier than makespans on the shared box)
+        pruns, _ = _paired_arm_stats(
+            model, params, extra, preqs, pcfg_on, pcfg_off, pmax_new,
+            reps=reps + 1,
+        )
+        # pair B: paged cache-on vs LANE cache-on — zero-copy page
+        # append vs the splice program's device copy, hit-for-hit
+        lruns, _ = _paired_arm_stats(
+            model, params, extra, preqs, pcfg_on, lane_on, pmax_new,
+            reps=reps,
+        )
+        ttft_on = float(np.mean(
+            [s["serve/ttft_s_mean"] for _, s in pruns["on"]]))
+        ttft_off = float(np.mean(
+            [s["serve/ttft_s_mean"] for _, s in pruns["off"]]))
+        ttft_lane = float(np.mean(
+            [s["serve/ttft_s_mean"] for _, s in lruns["off"]]))
+        on_snap = pruns["on"][-1][1]
+        # the zero-copy proof: run the cache-on arm once more under the
+        # observatory and assert no splice/extract program ever compiled
+        obs_on = dataclasses.replace(pcfg_on, xla_obs=True)
+        obs_eng, _, _ = _run_engine_arm(
+            model, params, extra, pwarm, obs_on, pmax_new,
+        )
+        splices = sum(
+            1 for name in obs_eng.registry.snapshot()["programs"]
+            if name in ("splice_program", "extract_program")
+        )
+        detail.update({
+            "prefix_len": plen,
+            "suffix_len": suffix_len,
+            "n_prefixes": n_prefixes,
+            "prefix_n_requests": pn,
+            "paged_prefix_mean_ttft_s": round(ttft_on, 4),
+            "paged_noprefix_mean_ttft_s": round(ttft_off, 4),
+            "lane_prefix_mean_ttft_s": round(ttft_lane, 4),
+            "paged_prefix_ttft_speedup": round(ttft_off / ttft_on, 2),
+            "paged_vs_lane_prefix_ttft": round(ttft_lane / ttft_on, 2),
+            "paged_prefix_hit_rate": round(
+                on_snap.get("serve/prefix_hit_rate", 0.0), 3
+            ),
+            "splice_programs_dispatched": splices,
+        })
+        if probe_eng is not None and status_hold_s > 0:
+            time.sleep(status_hold_s)
+    finally:
+        if probe_eng is not None:
+            probe_eng.close()
+    return {
+        "metric": "serve_paged_slots_at_equal_hbm",
+        "value": detail["capacity_peak_active_slots"],
+        "unit": "concurrent slots (lane-pool HBM budget)",
+        "vs_baseline": round(
+            detail["capacity_peak_active_slots"] / n_slots, 2
+        ),
+        "detail": detail,
     }
 
 

@@ -30,15 +30,29 @@ right to a multiple of ``bucket``; the pad region is causally invisible
 to real tokens and its cache slots are overwritten by the decode stream
 before ever being attended).
 
+Paged KV pool (`ServeConfig.paged`, `serve/kv_pool.py PagedKVPool`):
+instead of one contiguous `max_len` lane per slot, the cache is a
+physical pool of fixed-size KV pages with per-slot page tables; the
+jitted programs gather the logical lane view from the page table (which
+rides the existing packed control transfer), run the models unmodified,
+and scatter back only written pages. HBM is booked per page, slot count
+decouples from max_seq, the scheduler admits on a PAGE budget (free
+pages must cover prompt + a decode reservation), and a stream that
+outgrows the pool is preempted — pages freed, request requeued at the
+head, KV recomputed on resume (token streams unchanged). The lane pool
+stays the default and the bench baseline (`serve-bench --paged`).
+
 Cross-request prefix reuse (`serve/prefix_cache.py`, opt-in via
 `ServeConfig.prefix_cache` — see its docstring for the cost model):
-admission first splices the longest cached page-aligned prompt prefix
-into the freed lane
-(copy-on-acquire — one fused dynamic_update_slice program per segment)
-and prefills only the uncovered suffix from position `matched`, then
-snapshots the prompt's prefix back into the radix tree. Cached KV at
-position p depends only on tokens <= p, so greedy streams are token-exact
-with the cache on or off.
+admission first reuses the longest cached page-aligned prompt prefix —
+the lane pool splices it into the freed lane (copy-on-acquire — one
+fused dynamic_update_slice program per segment), the paged pool appends
+the cached PHYSICAL page ids to the slot's page table (a refcount bump:
+zero device copies, no program dispatched) — and prefills only the
+uncovered suffix from position `matched`, then hands the prompt's
+prefix back to the radix tree (snapshot copy vs page-id reference,
+respectively). Cached KV at position p depends only on tokens <= p, so
+greedy streams are token-exact with the cache on or off.
 
 Per-request sampling (`serve/sampling.py`): every request carries
 `SamplingParams` (temperature / top-k / top-p / min-p / seed / stop sets /
@@ -87,7 +101,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from solvingpapers_tpu.serve import metrics as smetrics
-from solvingpapers_tpu.serve.kv_pool import KVSlotPool, extract_lane, store_lane
+from solvingpapers_tpu.serve.kv_pool import (
+    KVSlotPool,
+    PagedKVPool,
+    extract_lane,
+    gather_lane,
+    gather_lanes,
+    scatter_lane_pages,
+    scatter_written_pages,
+    store_lane,
+)
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache
 from solvingpapers_tpu.serve.sampling import (
@@ -163,6 +186,31 @@ class ServeConfig:
     max_len: int = 512
     decode_block: int = 8
     bucket: int = 64
+    # Paged KV pool (serve/kv_pool.py PagedKVPool, vLLM-PagedAttention
+    # style): one physical pool of `page_budget` fixed-size KV pages +
+    # per-slot page tables instead of contiguous max_len lanes. HBM is
+    # booked per PAGE actually needed, so slot count decouples from
+    # max_len (more concurrent slots at equal HBM — the bench's
+    # --paged arm measures it), and the prefix cache shares pages
+    # zero-copy by refcount (a full-page hit dispatches NO device
+    # program). Admission moves from slot-count to page-budget
+    # accounting: a request is admitted while free pages cover its
+    # prompt + a decode-block reservation, and a growing stream that
+    # exhausts the pool preempts the youngest request
+    # (requeue-and-recompute; greedy/seeded streams are unchanged —
+    # resume re-prefills prompt + emitted tokens and the rng chain
+    # folds only (seed, sample index)).
+    #   page_size   tokens per page; defaults to `prefix_page` so tree
+    #               edges align with physical pages (required when both
+    #               paged and prefix_cache are on — zero-copy sharing
+    #               needs the alignment). max_len must be a multiple.
+    #   page_budget allocatable pages; None = n_slots * (max_len /
+    #               page_size), the lane-pool-equivalent HBM. Shrink it
+    #               (or raise n_slots) to trade worst-case headroom for
+    #               concurrency — the whole point of paging.
+    paged: bool = False
+    page_size: int | None = None
+    page_budget: int | None = None
     # static support bound for stochastic sampling (clamped to the vocab):
     # fused_sample draws inside the top `sample_cap` logits per step —
     # bounded-support sampling keeps the per-step cost at one top-k
@@ -223,6 +271,37 @@ class ServeConfig:
 _UNSET = object()
 
 
+def _prefill_lane(model, padded, chunk, start, variables, lane, prompt,
+                  length):
+    """Shared chunked-prefill core: run `prompt` (right-padded to
+    `padded`) through a batch-1 `lane` from position `start`, returning
+    the updated lane and the logits row of the LAST REAL token (index
+    `length - 1`, gathered from whichever chunk contains it). Both pool
+    layouts call this — the lane pool on an extracted lane, the paged
+    pool on a gathered page-table view — so the prefill semantics
+    (end-aligned attend_len, pad invisibility) cannot drift between
+    them."""
+    toks = prompt[None, :]
+    step = chunk or padded
+    last = None
+    for cs in range(0, padded, step):
+        ce = min(cs + step, padded)
+        tok_chunk = jax.lax.slice_in_dim(toks, cs, ce, axis=1)
+        positions = jnp.broadcast_to(
+            jnp.arange(start + cs, start + ce), (1, ce - cs)
+        )
+        logits, lane = model.apply(
+            variables, tok_chunk, positions=positions, caches=lane,
+            deterministic=True, attend_len=start + ce,
+        )
+        idx = jnp.clip(length - 1 - cs, 0, ce - cs - 1)
+        row = jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0,
+                                           keepdims=False)
+        sel = (length - 1 >= cs) & (length - 1 < ce)
+        last = row if last is None else jnp.where(sel, row, last)
+    return lane, last
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("model", "padded", "chunk", "start", "cap"),
@@ -258,24 +337,8 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
     """
     slot, length = ctl[0], ctl[1]
     lane = extract_lane(caches, slot)
-    toks = prompt[None, :]
-    step = chunk or padded
-    last = None
-    for cs in range(0, padded, step):
-        ce = min(cs + step, padded)
-        tok_chunk = jax.lax.slice_in_dim(toks, cs, ce, axis=1)
-        positions = jnp.broadcast_to(
-            jnp.arange(start + cs, start + ce), (1, ce - cs)
-        )
-        logits, lane = model.apply(
-            variables, tok_chunk, positions=positions, caches=lane,
-            deterministic=True, attend_len=start + ce,
-        )
-        idx = jnp.clip(length - 1 - cs, 0, ce - cs - 1)
-        row = jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0,
-                                           keepdims=False)
-        sel = (length - 1 >= cs) & (length - 1 < ce)
-        last = row if last is None else jnp.where(sel, row, last)
+    lane, last = _prefill_lane(model, padded, chunk, start, variables,
+                               lane, prompt, length)
     packed = PackedSampling(
         temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
         top_k=ctl[3:4], need_lp=ctl[5:6],
@@ -284,6 +347,44 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
                       samp_idx=jnp.int32(0))
     first, logprob = fused_sample(last[None], packed, key[None], cap=cap)
     return store_lane(caches, lane, slot), first[0], logprob[0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "padded", "chunk", "start", "cap"),
+    donate_argnames=("phys",),
+)
+def _paged_prefill_program(model, padded, chunk, start, cap, variables,
+                           phys, prompt, ctl, samp, rng):
+    """Paged-pool prefill: identical contract to `_prefill_program`, but
+    the lane is a GATHERED view of the physical page pool and only the
+    pages the prefill may have written go back.
+
+    `ctl = [slot, length, step, top_k, seed, need_lp, *page_table_row]`
+    — the slot's (pages_per_lane,) page-table row rides the same packed
+    int control transfer as the sampling knobs, so logical->physical
+    translation costs zero extra host->device transfers and the
+    compiled-program inventory keys on exactly the lane pool's
+    `(padded, chunk, start)` triple. On a prefix hit, pages
+    [0, start // page) hold SHARED prefix KV the gather materializes
+    into the lane view; the scatter starts at `start // page` (static),
+    so shared pages are read, never written — the zero-device-copy hit
+    the refcount design exists for."""
+    slot, length = ctl[0], ctl[1]
+    row = ctl[6:]
+    lane = gather_lane(phys, row)
+    lane, last = _prefill_lane(model, padded, chunk, start, variables,
+                               lane, prompt, length)
+    packed = PackedSampling(
+        temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
+        top_k=ctl[3:4], need_lp=ctl[5:6],
+    )
+    key = request_key(rng, step_tag=ctl[2], slot=slot, seed=ctl[4],
+                      samp_idx=jnp.int32(0))
+    first, logprob = fused_sample(last[None], packed, key[None], cap=cap)
+    page = jax.tree_util.tree_leaves(phys)[0].shape[1]
+    phys = scatter_lane_pages(phys, lane, row, start // page)
+    return phys, first[0], logprob[0]
 
 
 @functools.partial(
@@ -351,6 +452,86 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         step, (toks, pos, state[7], caches), None, length=block
     )
     return caches, out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "block", "cap"),
+    donate_argnames=("phys",),
+)
+def _paged_decode_program(model, block, cap, variables, phys, state, samp,
+                          rng):
+    """Paged-pool decode block: `_decode_program`'s semantics over a
+    physical page pool.
+
+    `state` is the packed int block grown by the page tables: rows
+    [0, 9) are exactly the lane program's control rows, rows [9, 9 +
+    pages_per_lane) carry `table.T` — per-call page tables ride the ONE
+    existing control transfer, so a paged decode call still costs two
+    host->device transfers total.
+
+    Translation is hoisted OUT of the scan: every slot's logical lane
+    view is gathered from its page table once up front (the same
+    (S, max_len, ...) layout the vmapped batch-1 apply already serves —
+    the models run unmodified), the block's token loop runs on the
+    carried lane views exactly like the lane program, and afterwards
+    only the WRITE WINDOW goes back to the pool: the block writes
+    positions [pos, pos + block), which spans a static number of pages
+    per slot — those pages are gathered per slot and scattered to their
+    physical ids. Sound because within one block every page outside a
+    slot's own write window is read-only (shared prefix pages always
+    PRECEDE the write frontier — see kv_pool.py's immutability
+    argument), and pages inside the window are exclusively owned.
+    Inactive slots' tables rest at the trash page, so their masked
+    dummy writes land there instead of in lane 0; an active slot's
+    unallocated tail also resolves to trash, which only discarded
+    overshoot (post-EOS / post-budget steps inside the block) can reach
+    — the host truncates those tokens anyway."""
+    toks, pos = state[0], state[1]
+    active, eos = state[2].astype(bool), state[3]
+    step_tag, seeds = state[4, 0], state[6]
+    table = state[9:].T  # (S, pages_per_lane)
+    pos0 = pos
+    packed = PackedSampling(
+        temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
+        need_lp=state[8],
+    )
+    lanes = gather_lanes(phys, table)
+
+    def one(tok, p, slot_caches):
+        lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
+        logits, lane = model.apply(
+            variables, tok[None, None], positions=jnp.reshape(p, (1, 1)),
+            caches=lane, deterministic=True,
+        )
+        return logits[0, 0], jax.tree_util.tree_map(
+            lambda a: jnp.squeeze(a, axis=0), lane
+        )
+
+    def step(carry, _):
+        toks, pos, samp_idx, lanes = carry
+        logits, lanes = jax.vmap(one)(toks, pos, lanes)
+        keys = slot_keys(rng, step_tag, seeds, samp_idx)
+        nxt, logprob = fused_sample(logits, packed, keys, cap=cap)
+        nxt = nxt.astype(toks.dtype)
+        hit_eos = (eos >= 0) & (toks == eos)
+        nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
+        nxt = jnp.where(active, nxt, toks)
+        pos = jnp.where(active, pos + 1, pos)
+        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob)
+
+    (toks, pos, _, lanes), out = jax.lax.scan(
+        step, (toks, pos, state[7], lanes), None, length=block
+    )
+    page = jax.tree_util.tree_leaves(phys)[0].shape[1]
+    # static window bound: positions [p, p + block) touch at most this
+    # many pages; windows clipped past the lane end rewrite the last
+    # page with its own (final) content — idempotent by construction
+    for w in range((block - 1) // page + 2):
+        phys = scatter_written_pages(phys, lanes, table,
+                                     jnp.clip(pos0 + w * page, 0,
+                                              table.shape[1] * page - 1))
+    return phys, out
 
 
 class ServeEngine:
@@ -436,12 +617,40 @@ class ServeEngine:
         self._step_idx = 0
         self._profiling = False
         self._profile_done = cfg.profile_dir is None
-        self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
+        self._paged = cfg.paged
+        if cfg.paged:
+            page = cfg.page_size or cfg.prefix_page
+            if cfg.prefix_cache and page != cfg.prefix_page:
+                raise ValueError(
+                    f"page_size {page} != prefix_page {cfg.prefix_page}: "
+                    "zero-copy prefix sharing appends PHYSICAL page ids "
+                    "to page tables, which needs tree edges and pool "
+                    "pages on one granularity — set them equal (or leave "
+                    "page_size None to inherit prefix_page)"
+                )
+            self.pool = PagedKVPool(
+                model, cfg.n_slots, cfg.max_len, page,
+                page_budget=cfg.page_budget,
+            )
+        else:
+            if cfg.page_size is not None or cfg.page_budget is not None:
+                raise ValueError(
+                    "page_size/page_budget configure the paged pool and "
+                    "need paged=True — on the lane pool they would "
+                    "silently do nothing"
+                )
+            self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
         self.prefix_cache = (
             PrefixCache(page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes,
-                        trace=self.trace)
+                        trace=self.trace,
+                        pool=self.pool if cfg.paged else None)
             if cfg.prefix_cache else None
         )
+        if cfg.paged:
+            # page-pool occupancy/fragmentation gauges ride every
+            # snapshot via the provider mechanism — present iff paged,
+            # the same key-surface discipline as the observatory gauges
+            self.metrics.add_gauge_provider(self._page_gauges)
         # compile & memory observatory (metrics/xla_obs.py): both None
         # when off, so every program call site is one `is not None`
         # branch — the same discipline as the flight recorder above
@@ -460,12 +669,21 @@ class ServeEngine:
                 storm_window_s=cfg.obs_storm_window_s,
                 clock=smetrics.now,
             )
-            self.pool.registry = self.registry
+            if not cfg.paged:
+                # the lane pool owns jitted splice/extract programs and
+                # routes them through the registry; the paged pool has
+                # NONE (sharing is host-side bookkeeping — the absence
+                # of a splice_program in the registry is the zero-copy
+                # acceptance check)
+                self.pool.registry = self.registry
             self.ledger = HBMLedger(capacity_bytes=cfg.obs_capacity_bytes)
             # params are fixed for the engine's lifetime: account once
             self.ledger.register("params", pytree_bytes(self.variables))
             self.ledger.register("kv_pool", lambda: self.pool.nbytes)
-            if self.prefix_cache is not None:
+            if self.prefix_cache is not None and not cfg.paged:
+                # paged trees hold REFERENCES into the fixed pool — their
+                # bytes are already inside kv_pool; a separate ledger
+                # entry would double-count the same HBM
                 self.ledger.register(
                     "prefix_cache", lambda: self.prefix_cache.bytes_held
                 )
@@ -480,6 +698,7 @@ class ServeEngine:
             max_wait_steps=cfg.max_wait_steps,
             prefer_cached=cfg.prefix_sched,
             prefix_lookup=self._match_len if self.prefix_cache else None,
+            can_admit=self._can_admit if cfg.paged else None,
             trace=self.trace,
         )
         self._slot_req: list[Request | None] = [None] * cfg.n_slots
@@ -652,6 +871,8 @@ class ServeEngine:
                 self._finish_unadmitted(req, "timeout", now)
                 finished.append(req)
         n_admitted = 0
+        if self._paged:
+            self._unblock_head()
         for req in self.scheduler.pick(self.pool.n_free, self.pool.n_active):
             if req.deadline is not None:
                 self._waiting_deadlines -= 1  # left the queue via pick
@@ -755,6 +976,15 @@ class ServeEngine:
             ],
             "metrics": self.metrics.snapshot(),
         }
+        if self._paged:
+            d["kv_pages"] = {
+                "page_size": self.pool.page_size,
+                "page_budget": self.pool.page_budget,
+                "pages_free": self.pool.pages_free,
+                "pages_active": self.pool.pages_active,
+                "fragmentation": self.pool.fragmentation,
+                "per_slot_pages": self.pool.n_alloc.tolist(),
+            }
         if self.prefix_cache is not None:
             d["prefix_cache"] = self.prefix_cache.stats()
         if self.registry is not None:
@@ -798,90 +1028,309 @@ class ServeEngine:
             return 0
         return self.prefix_cache.peek(prompt[: prompt.size - 1])
 
+    # -------------------------------------------------- paged-pool policy
+
+    def _page_gauges(self) -> dict[str, float]:
+        """Page-pool occupancy gauges riding every metrics snapshot
+        (registered iff `paged` — the present-iff-enabled key-surface
+        contract the observatory gauges set)."""
+        pool = self.pool
+        return {
+            "serve/pages_free": float(pool.pages_free),
+            "serve/pages_active": float(pool.pages_active),
+            "serve/page_fragmentation": float(pool.fragmentation),
+        }
+
+    def _page_need(self, req: Request) -> int:
+        """Pages a waiting request needs to start: prefill coverage of
+        its (resume-aware) sequence net of the cached-prefix hint, plus
+        one decode block's reservation. Deliberately an ESTIMATE — the
+        hint can go stale between gate and admit, and several admissions
+        in one iteration share the same free count; `_ensure_pages`'
+        reclaim path absorbs any over-admission."""
+        pool = self.pool
+        if req.tokens:
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+            )
+        else:
+            seq = req.prompt
+        matched = 0
+        if self.prefix_cache is not None and seq.size > 1:
+            matched = self.prefix_cache.peek(seq[: seq.size - 1])
+        suffix = int(seq.size) - matched
+        padded = self._bucketed(suffix, start=matched)
+        need = min(matched + padded + self.config.decode_block,
+                   self.config.max_len)
+        return pool.pages_for(need) - matched // pool.page_size
+
+    def _can_admit(self, req: Request) -> bool:
+        """The scheduler's page-budget admission gate (paged pools):
+        admit while free pages cover the request's prompt + a decode
+        reservation. Free SLOTS alone no longer imply capacity — that is
+        what decouples slot count from max_seq."""
+        return self.pool.pages_free >= self._page_need(req)
+
+    def _unblock_head(self) -> None:
+        """Shed prefix-tree page references for a page-starved queue
+        head BEFORE the scheduler picks. Without this the engine can
+        livelock: the tree's references persist after every stream
+        drains (that is the cache working as designed), but reclaim
+        otherwise only runs inside `_admit`/`_cover_decode` — which a
+        blocked `can_admit` gate prevents from ever running again.
+        Runs only with the pool fully IDLE: while streams are active,
+        their ordinary finish-and-release is what unblocks the head
+        (transient backpressure — shedding the tree then would destroy
+        the cache for nothing), and active streams are never preempted
+        for a WAITING request. Once they all drain, either the head
+        fits or only the tree still holds pages — and with the tree
+        spent, `page_budget >= pages_per_lane` guarantees any single
+        request fits."""
+        if (not self.scheduler.queue or self.pool.n_active > 0
+                or self.prefix_cache is None):
+            return
+        head = self.scheduler.queue[0]
+        shed = False
+        while (not self._can_admit(head)
+               and self.prefix_cache.evict_one()):
+            shed = True
+        if shed:
+            self.metrics.record_prefix_state(
+                self.prefix_cache.bytes_held, self.prefix_cache.evictions
+            )
+
+    def _ensure_pages(self, slot: int, n_tokens: int) -> bool:
+        """Grow `slot`'s page table to cover `n_tokens`, reclaiming
+        under pressure: shed prefix-tree references first (cheap — the
+        cache is advisory), then preempt the youngest other stream
+        (requeue-and-recompute). False only when the pool cannot cover
+        this slot even with everything else evicted."""
+        while not self.pool.ensure(slot, n_tokens):
+            if not self._reclaim_one(protect={slot}):
+                return False
+        return True
+
+    def _reclaim_one(self, protect: set) -> bool:
+        """Free page capacity by one unit: evict one prefix-tree leaf
+        (preferred — dropping cache never hurts correctness) or, with
+        the tree spent, preempt the YOUNGEST active request not in
+        `protect` (latest-admitted loses: it has the least sunk prefill
+        work and the oldest streams keep their latency contract). False
+        when nothing reclaimable remains. A tree eviction may free zero
+        pages (a slot still shares them) — callers loop, and each call
+        removes a node or a stream, so the loop terminates."""
+        pc = self.prefix_cache
+        if pc is not None and pc.evict_one():
+            self.metrics.record_prefix_state(pc.bytes_held, pc.evictions)
+            return True
+        victim = None
+        for r in self._slot_req:
+            if r is None or r.slot in protect:
+                continue
+            if victim is None or r.admit_time > victim.admit_time:
+                victim = r
+        if victim is None:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, req: Request) -> None:
+        """Evict an ACTIVE stream on page exhaustion: its pages free
+        immediately (shared ones survive under the tree's references —
+        often making its own resume a prefix HIT), the request returns
+        to the HEAD of the queue, and `_admit`'s resume path recomputes
+        its KV when pages free up. Runs only at block boundaries, so no
+        in-flight program output is lost."""
+        slot = req.slot
+        self.metrics.record_preemption()
+        if self.trace is not None:
+            self.trace.instant("preempt", "engine", f"slot{slot}",
+                               req=req.id, tokens=len(req.tokens))
+        self._slot_req[slot] = None
+        self._toks[slot] = 0
+        self._pos[slot] = 0
+        self._samp_f[:, slot] = GREEDY_ROW
+        self._top_k[slot] = 0
+        self._seed[slot] = -1
+        self._need_lp[slot] = 0
+        self.pool.release(slot)
+        req.slot = None
+        self.scheduler.requeue_front(req)
+        if req.deadline is not None:
+            self._waiting_deadlines += 1
+
+    def _cover_decode(self, block: int) -> None:
+        """Page-budget guard before a decode block: every surviving slot
+        must own pages for its next `block` writes (a slot that hits
+        EOS/budget mid-block keeps stepping — overshoot beyond coverage
+        lands in the trash page and is discarded host-side, but REAL
+        tokens' writes must be owned). Oldest streams are covered first;
+        reclaim preempts youngest-first, so under exhaustion the pool
+        degrades to fewer, older streams instead of corrupting any."""
+        active = [r for r in self._slot_req if r is not None]
+        active.sort(key=lambda r: r.admit_time)
+        covered: set[int] = set()
+        for req in active:
+            if req.slot is None:
+                continue  # preempted by an earlier slot's reclaim
+            slot = req.slot
+            covered.add(slot)
+            target = min(int(self._pos[slot]) + block, self.config.max_len)
+            ok = self.pool.ensure(slot, target)
+            while not ok:
+                if not self._reclaim_one(protect=covered):
+                    break
+                ok = self.pool.ensure(slot, target)
+            if not ok:
+                # nothing reclaimable left: this stream yields too
+                self._preempt(req)
+                covered.discard(slot)
+
     def _admit(self, req: Request) -> bool:
         """Prefill `req` into a free lane; True if it finished already.
 
-        With the prefix cache on: splice the longest cached page-aligned
-        prompt prefix into the lane (copy-on-acquire), prefill only the
-        uncovered suffix from position `matched`, then snapshot the
-        prompt's page-aligned prefix back into the tree so later requests
-        reuse it.
+        With the prefix cache on: reuse the longest cached page-aligned
+        prompt prefix — the lane pool SPLICES it into the lane
+        (copy-on-acquire, one fused device program), the paged pool
+        APPENDS the cached physical page ids to the slot's page table
+        (refcount bump, zero device copies) — prefill only the uncovered
+        suffix from position `matched`, then hand the prompt's
+        page-aligned prefix back to the tree (snapshot copy vs page-id
+        reference, same split).
+
+        A request with tokens already emitted is a PREEMPTED one being
+        resumed (paged pool only): the prefill recomputes KV for prompt
+        + emitted-so-far (minus the newest token, whose KV is written
+        when it is fed back), the program's sampled token is discarded
+        (the stream already holds it), and decode continues where it
+        stopped — token streams are unchanged because cached KV depends
+        only on the token ids, and seeded sampling chains fold only
+        (seed, sample index).
         """
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
         tr = self.trace
         now = smetrics.now()
+        resumed = bool(req.tokens)
         req.state = ACTIVE
         req.slot = slot
         req.admit_time = now
-        self.metrics.record_admit(req, now)
 
-        length = int(req.prompt.size)
+        if resumed:
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)]
+            )
+        else:
+            seq = req.prompt
+        length = int(seq.size)
         matched = 0
         if self.prefix_cache is not None and length > 1:
-            match = self.prefix_cache.match(req.prompt[: length - 1])
+            match = self.prefix_cache.match(seq[: length - 1])
             matched = match.length
-            self.metrics.record_prefix_lookup(matched)
             if matched:
-                # pin across the splice. In today's single-threaded engine
+                # pin across the reuse. In today's single-threaded engine
                 # nothing can evict between match and splice (eviction only
                 # runs inside insert, below) — the pin is the invariant a
                 # future async/threaded admission path must keep, kept live
                 # here so the refcount machinery stays exercised.
                 self.prefix_cache.pin(match)
-                t_sp = smetrics.now() if tr is not None else 0.0
-                offset = 0
-                for node in match.nodes:
-                    self.pool.splice_prefix(slot, node.segment, offset)
-                    offset += node.length
-                self.prefix_cache.unpin(match)
-                if tr is not None:
-                    # fence: the splice programs run async; without the
-                    # wait the span would record dispatch, not the copy
-                    jax.block_until_ready(self.pool.caches)
-                    t_sp1 = smetrics.now()
-                    self._dev_s += t_sp1 - t_sp
-                    tr.complete("splice", "prefix", f"slot{slot}", ts=t_sp,
-                                dur=t_sp1 - t_sp, req=req.id,
-                                matched=matched,
-                                pages=matched // self.prefix_cache.page)
+                if self._paged:
+                    # zero-copy hit: the matched nodes' PHYSICAL page ids
+                    # go straight into the slot's page table (host-side
+                    # incref) — no device program is dispatched at all,
+                    # which the compile registry can prove (no
+                    # splice_program entry ever appears)
+                    for node in match.nodes:
+                        self.pool.append_shared(slot, node.pages)
+                    self.prefix_cache.unpin(match)
+                    if tr is not None:
+                        tr.instant(
+                            "share", "prefix", f"slot{slot}", req=req.id,
+                            matched=matched,
+                            pages=matched // self.prefix_cache.page,
+                        )
+                else:
+                    t_sp = smetrics.now() if tr is not None else 0.0
+                    offset = 0
+                    for node in match.nodes:
+                        self.pool.splice_prefix(slot, node.segment, offset)
+                        offset += node.length
+                    self.prefix_cache.unpin(match)
+                    if tr is not None:
+                        # fence: the splice programs run async; without
+                        # the wait the span would record dispatch, not
+                        # the copy
+                        jax.block_until_ready(self.pool.caches)
+                        t_sp1 = smetrics.now()
+                        self._dev_s += t_sp1 - t_sp
+                        tr.complete("splice", "prefix", f"slot{slot}",
+                                    ts=t_sp, dur=t_sp1 - t_sp, req=req.id,
+                                    matched=matched,
+                                    pages=matched // self.prefix_cache.page)
 
         suffix = length - matched
         padded = self._bucketed(suffix, start=matched)
+        if self._paged and not self._ensure_pages(slot, matched + padded):
+            # pathological: even after shedding the whole tree and every
+            # other stream the pool cannot cover this prefill. Hand the
+            # pages and slot back and retry next iteration.
+            self.pool.release(slot)
+            req.slot = None
+            self.scheduler.requeue_front(req)
+            if req.deadline is not None:
+                self._waiting_deadlines += 1
+            return False
+        # admission metrics AFTER the bail point above: a requeued-and-
+        # retried admission must not add a second queue-wait sample or
+        # count its prefix lookup twice
+        if not resumed:
+            self.metrics.record_admit(req, now)
+        if self.prefix_cache is not None and length > 1:
+            self.metrics.record_prefix_lookup(matched)
         chunk = self.config.prefill_chunk
         if chunk is None and padded > 4096:
             chunk = 2048  # same auto-chunk threshold as infer.decode.generate
         if chunk is not None and chunk >= padded:
             chunk = None
         prompt_padded = np.zeros(padded, np.int32)
-        prompt_padded[:suffix] = req.prompt[matched:]
+        prompt_padded[:suffix] = seq[matched:]
         samp_row, top_k, seed = encode_params(req.params)
         need_lp = int(req.params.logprobs)
         self._samp_f[:, slot] = samp_row
         self._top_k[slot] = top_k
         self._seed[slot] = seed
         self._need_lp[slot] = need_lp
-        ctl = np.asarray(
+        head = np.asarray(
             [slot, suffix, self._rng_step, top_k, seed, need_lp], np.int32
         )
+        # the paged program reads the slot's page-table row off the SAME
+        # packed int transfer (logical->physical translation with zero
+        # extra host->device traffic)
+        ctl = (np.concatenate([head, self.pool.table[slot]])
+               if self._paged else head)
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
+        prog = _paged_prefill_program if self._paged else _prefill_program
+        pool_tree = self.pool.phys if self._paged else self.pool.caches
         pf_args = (
             self.model, padded, chunk, matched, self.config.sample_cap,
-            self.variables, self.pool.caches, jnp.asarray(prompt_padded),
+            self.variables, pool_tree, jnp.asarray(prompt_padded),
             jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
         )
         with self._scope("serve/prefill"):
             if self.registry is not None:
                 # signature = the static shape triple; everything else
                 # (params, caches, control arrays) is fixed per engine
-                self.pool.caches, first, logprob = self.registry.call(
+                pool_tree, first, logprob = self.registry.call(
                     "prefill_program", (padded, chunk, matched),
-                    _prefill_program, pf_args,
-                    static_argnums=(0, 1, 2, 3, 4),
+                    prog, pf_args, static_argnums=(0, 1, 2, 3, 4),
                 )
             else:
-                self.pool.caches, first, logprob = _prefill_program(*pf_args)
+                pool_tree, first, logprob = prog(*pf_args)
+        if self._paged:
+            self.pool.phys = pool_tree
+        else:
+            self.pool.caches = pool_tree
         first = int(first)  # blocks on the program — t_pf1 is device-true
         if tr is not None:
             t_pf1 = smetrics.now()
@@ -890,19 +1339,30 @@ class ServeEngine:
                         dur=t_pf1 - t_pf, req=req.id, padded=padded,
                         suffix=suffix, chunk=chunk or 0)
         if self.prefix_cache is not None:
-            # snapshot while the lane's [0, length) span is pristine (an
-            # active lane's decode writes land at positions >= length, and
-            # dummy writes only hit FREED lanes' slot 0)
+            # hand the prefilled span to the tree while [0, length) is
+            # pristine (an active lane's decode writes land at positions
+            # >= length, and dummy writes only hit FREED lanes' slot 0 /
+            # the trash page)
             page = self.prefix_cache.page
             aligned = (length - 1) // page * page
             # aligned == matched on a full hit: nothing new to cache, and
             # insert's internal re-match would re-walk the whole prefix on
             # the dispatch-bound host hot path for nothing
             if aligned > matched:
-                self.prefix_cache.insert(
-                    req.prompt[:aligned],
-                    lambda off, n: self.pool.extract_prefix(slot, off, n),
-                )
+                if self._paged:
+                    # reference, not copy: the tree increfs the slot's own
+                    # fully-filled pages (only a trailing PARTIAL page
+                    # would need a snapshot, and insert never takes one —
+                    # aligned is a page multiple)
+                    self.prefix_cache.insert(
+                        seq[:aligned],
+                        lambda off, n: self.pool.share_range(slot, off, n),
+                    )
+                else:
+                    self.prefix_cache.insert(
+                        seq[:aligned],
+                        lambda off, n: self.pool.extract_prefix(slot, off, n),
+                    )
             self.metrics.record_prefix_state(
                 self.prefix_cache.bytes_held, self.prefix_cache.evictions
             )
@@ -912,6 +1372,21 @@ class ServeEngine:
             # projected-peak check per admitted request, never per token
             self.ledger.check()
         now = smetrics.now()
+        if resumed:
+            # recompute complete: the sampled token is discarded (the
+            # stream already holds every emitted id) and decode resumes
+            # at the preempted position
+            self.metrics.record_recompute_tokens(suffix)
+            self._last_emit[slot] = now
+            self.pool.positions[slot] = length
+            self._toks[slot] = req.tokens[-1]
+            self._pos[slot] = length
+            self._slot_req[slot] = req
+            if tr is not None:
+                tr.instant("resume", "request", f"slot{slot}", req=req.id,
+                           ts=now, recomputed=suffix,
+                           tokens=len(req.tokens))
+            return False
         req.first_token_time = now
         req.tokens.append(first)
         if req.params.logprobs:
@@ -985,7 +1460,12 @@ class ServeEngine:
     def _decode_block(self) -> list[Request]:
         cfg = self.config
         block = cfg.decode_block
-        state = np.zeros((9, cfg.n_slots), np.int32)
+        if self._paged:
+            self._cover_decode(block)
+            if self.pool.n_active == 0:
+                return []  # exhaustion preempted every stream this block
+        rows = 9 + (self.pool.pages_per_lane if self._paged else 0)
+        state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
         state[3] = -1
@@ -1001,13 +1481,18 @@ class ServeEngine:
         state[5] = self._top_k
         state[6] = self._seed
         state[8] = self._need_lp
+        if self._paged:
+            # the page tables ride the SAME packed transfer: still two
+            # host->device control arrays per decode call
+            state[9:] = self.pool.table.T
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
+        prog = _paged_decode_program if self._paged else _decode_program
         dec_args = (
             self.model, block, self.config.sample_cap, self.variables,
-            self.pool.caches, jnp.asarray(state),
-            jnp.asarray(self._samp_f), self._rng,
+            self.pool.phys if self._paged else self.pool.caches,
+            jnp.asarray(state), jnp.asarray(self._samp_f), self._rng,
         )
         with self._scope("serve/decode_block"):
             if self.registry is not None:
@@ -1015,12 +1500,16 @@ class ServeEngine:
                 # IS the anomaly the registry exists to catch. Named
                 # after the trace span ("decode_block") so the offline
                 # roofline join in summarize_trace matches.
-                self.pool.caches, (out, lps) = self.registry.call(
-                    "decode_block", (block,), _decode_program, dec_args,
+                pool_tree, (out, lps) = self.registry.call(
+                    "decode_block", (block,), prog, dec_args,
                     static_argnums=(0, 1, 2),
                 )
             else:
-                self.pool.caches, (out, lps) = _decode_program(*dec_args)
+                pool_tree, (out, lps) = prog(*dec_args)
+        if self._paged:
+            self.pool.phys = pool_tree
+        else:
+            self.pool.caches = pool_tree
         t_dev = 0.0
         if tr is not None:
             # fence so the span is device wall time, not dispatch time;
@@ -1124,17 +1613,32 @@ class ServeEngine:
 
     def _finish_unadmitted(self, req: Request, reason: str,
                            now: float) -> None:
-        """Finish a request that never held a lane (cancelled or timed
-        out while still waiting in the queue)."""
+        """Finish a request cancelled or timed out while in the waiting
+        queue — either never admitted, or a PREEMPTED stream waiting to
+        resume (paged pool; it already has tokens and stamped queue +
+        prefill spans at its original admission)."""
         req.state = FINISHED
         req.finish_reason = reason
         req.finish_time = now
         self.metrics.record_finish(req, now)
         if self.trace is not None:
-            # its whole life was queue time; no prefill/decode phases
-            self.trace.complete("queue", "request", "queue",
-                                ts=req.submit_time,
-                                dur=now - req.submit_time, req=req.id)
+            if req.first_token_time is None:
+                # its whole life was queue time; no prefill/decode phases
+                self.trace.complete("queue", "request", "queue",
+                                    ts=req.submit_time,
+                                    dur=now - req.submit_time, req=req.id)
+            else:
+                # preempted mid-stream: queue/prefill spans exist from
+                # the original admission — close the lifecycle with the
+                # decode phase (first token -> finish) instead of a
+                # second full-life queue span, keeping the three-phase
+                # partition of finish - submit intact
+                self.trace.complete(
+                    "decode", "request", "queue",
+                    ts=req.first_token_time,
+                    dur=now - req.first_token_time,
+                    req=req.id, tokens=len(req.tokens),
+                )
             self.trace.instant("finish", "request", "queue", req=req.id,
                                ts=now, reason=reason)
             if self._mon is not None:

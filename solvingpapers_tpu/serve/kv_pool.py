@@ -1,11 +1,15 @@
-"""Fixed-size pool of per-slot KV/latent cache lanes.
+"""KV cache pools for the serving engine: contiguous per-slot lanes
+(`KVSlotPool`) and block-paged cache blocks (`PagedKVPool`).
 
 Continuous batching needs slot-granular cache reuse: when one sequence
 finishes, its cache storage must be handed to the next queued request
-immediately, without waiting for the rest of the batch (the vLLM
-PagedAttention insight, applied at lane granularity — one lane per slot
-rather than paged blocks, because the repo's caches are preallocated
-static-shape pytrees and XLA wants the batch dimension fixed).
+immediately, without waiting for the rest of the batch. `KVSlotPool`
+applies that at lane granularity — one `max_seq` lane per slot, HBM
+booked for the worst case. `PagedKVPool` (second half of this module)
+is the full vLLM-PagedAttention layout: one physical pool of fixed-size
+KV pages, per-slot page tables, and refcounted zero-copy prefix sharing
+(`ServeConfig.paged`); the lane pool remains the default and the paired
+baseline the bench measures the paged pool against.
 
 The pool is carved out of the existing cache machinery unchanged: the
 pooled pytrees come from ``model.init_caches(n_slots, max_len)``
@@ -102,7 +106,56 @@ def _extract_program(caches, ctl, length):
     return jax.tree_util.tree_map(ext, caches)
 
 
-class KVSlotPool:
+class _SlotBook:
+    """Shared slot bookkeeping for both pool layouts: a LIFO free list
+    (the freshest slot is reused while its buffers / table row are
+    warm) plus an O(1) membership mask — the double-release guard must
+    never scan the list on the hot release path. Subclasses call
+    `_init_slots` at construction and compose `_guard_release` /
+    `_finish_release` around their own teardown."""
+
+    def _init_slots(self, n_slots: int) -> None:
+        if n_slots < 1:
+            raise ValueError(f"need at least one slot, got {n_slots}")
+        self.n_slots = n_slots
+        self.positions = np.zeros(n_slots, np.int32)
+        self._free = list(range(n_slots - 1, -1, -1))
+        self._free_mask = np.ones(n_slots, bool)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return self.n_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.n_active / self.n_slots
+
+    def acquire(self) -> int | None:
+        """Claim a free slot (or None when all are taken)."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._free_mask[slot] = False
+        self.positions[slot] = 0
+        return slot
+
+    def _guard_release(self, slot: int) -> None:
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        if self._free_mask[slot]:
+            raise ValueError(f"slot {slot} is already free (double release)")
+
+    def _finish_release(self, slot: int) -> None:
+        self.positions[slot] = 0
+        self._free.append(slot)
+        self._free_mask[slot] = True
+
+
+class KVSlotPool(_SlotBook):
     """`n_slots` cache lanes + free-list bookkeeping.
 
     `caches` is the pooled pytree (list of per-layer caches, batch dim =
@@ -118,15 +171,9 @@ class KVSlotPool:
     """
 
     def __init__(self, model, n_slots: int, max_len: int):
-        if n_slots < 1:
-            raise ValueError(f"need at least one slot, got {n_slots}")
-        self.n_slots = n_slots
+        self._init_slots(n_slots)
         self.max_len = max_len
         self.caches = model.init_caches(n_slots, max_len)
-        self.positions = np.zeros(n_slots, np.int32)
-        # LIFO free list, seeded so acquire() hands out slot 0 first —
-        # recently-freed lanes are reused while their buffers are warm
-        self._free = list(range(n_slots - 1, -1, -1))
         # optional metrics.xla_obs.CompileRegistry (set by the engine
         # when the observatory is on): splice/extract program calls are
         # routed through it so their compilations and run seconds are
@@ -141,34 +188,10 @@ class KVSlotPool:
 
         return pytree_bytes(self.caches)
 
-    @property
-    def n_free(self) -> int:
-        return len(self._free)
-
-    @property
-    def n_active(self) -> int:
-        return self.n_slots - len(self._free)
-
-    @property
-    def occupancy(self) -> float:
-        return self.n_active / self.n_slots
-
-    def acquire(self) -> int | None:
-        """Claim a free lane (or None when the pool is exhausted)."""
-        if not self._free:
-            return None
-        slot = self._free.pop()
-        self.positions[slot] = 0
-        return slot
-
     def release(self, slot: int) -> None:
         """Return a lane to the pool; it is immediately reusable."""
-        if not 0 <= slot < self.n_slots:
-            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
-        if slot in self._free:
-            raise ValueError(f"slot {slot} is already free (double release)")
-        self.positions[slot] = 0
-        self._free.append(slot)
+        self._guard_release(slot)
+        self._finish_release(slot)
 
     # --------------------------------------------------- prefix segments
 
@@ -214,3 +237,338 @@ class KVSlotPool:
                 (self.caches, ctl, length), static_argnums=(2,),
             )
         return _extract_program(self.caches, ctl, length)
+
+
+# ======================================================================
+# Paged pool: block-paged cache lanes + refcounted zero-copy sharing
+# ======================================================================
+#
+# The lane pool above books `max_len` cache slots per engine slot — HBM
+# reserved for the worst case, slot count coupled to max_seq, and every
+# prefix hit paying a device copy (splice). `PagedKVPool` is the vLLM
+# PagedAttention layout instead: ONE physical pool of fixed-size KV
+# blocks ("pages"), carved from `model.init_caches(n_pages, page_size)`
+# so the batch dimension IS the page id, plus a host-side per-slot page
+# table mapping logical page index -> physical page id. The jitted
+# prefill/decode programs translate logical->physical with a gather
+# (`gather_lanes`) that materializes the familiar (S, max_len, ...)
+# lane view, run the models UNMODIFIED on it, and scatter only the
+# written page(s) back — so all four decoder families serve paged with
+# zero model changes, and the page table rides the engine's existing
+# packed control-array transfer.
+#
+# Sharing: the radix prefix cache holds PHYSICAL PAGE IDS with
+# refcounts instead of snapshot copies (the SGLang RadixAttention
+# move). A prefix hit is a host-side page-table append + incref — zero
+# device copies — and inserting a freshly prefilled prompt is an incref
+# of the slot's own fully-filled pages. This is sound because cached
+# pages are never rewritten by their producer: the engine only caches
+# prompt positions [0, aligned) with aligned <= len(prompt)-1
+# page-aligned, and the owning slot's future writes land at positions
+# >= len(prompt), i.e. in pages strictly AFTER every cached one; decode
+# scatters exactly the one page containing the written position, and
+# prefill scatters only pages >= the (page-aligned) match length. So a
+# shared page is immutable for as long as anything references it — no
+# copy-on-write machinery needed.
+#
+# Page 0 is a reserved TRASH page, never allocated: page-table entries
+# beyond a slot's allocation (and every entry of an idle slot) point at
+# it, so gathers always read valid (finite, masked-away) memory and
+# masked dummy writes / discarded overshoot land harmlessly there. The
+# stale-data contract is the lane pool's, per page: freed pages are not
+# zeroed, reuse is safe because prefill/decode overwrite before any
+# attention and position masking annihilates slack beyond the fill.
+
+
+def gather_lanes(phys, table):
+    """Logical lane view of the physical pool (traced): `table` is the
+    (S, pages_per_lane) page-table block; returns the (S, max_len, ...)
+    pytree the lane-pool programs operate on. One gather per leaf — the
+    logical->physical translation the paged programs do up front."""
+
+    def g(leaf):
+        pages = leaf[table]  # (S, PPL, page, ...)
+        s, ppl, page = pages.shape[:3]
+        return pages.reshape((s, ppl * page) + leaf.shape[2:])
+
+    return jax.tree_util.tree_map(g, phys)
+
+
+def gather_lane(phys, row):
+    """Batch-1 lane view for one slot: `row` is its (pages_per_lane,)
+    page-table row (traced)."""
+
+    def g(leaf):
+        pages = leaf[row]  # (PPL, page, ...)
+        ppl, page = pages.shape[:2]
+        return pages.reshape((1, ppl * page) + leaf.shape[2:])
+
+    return jax.tree_util.tree_map(g, phys)
+
+
+def scatter_lane_pages(phys, lane, row, start_page: int):
+    """Write a batch-1 lane's pages [start_page:] back to the pool at
+    `row[start_page:]` (traced; `start_page` static). The pages BELOW
+    `start_page` are deliberately untouched — on a prefix hit they are
+    shared, refcounted pages the prefill never wrote, and not rewriting
+    them is what makes the hit zero-copy. Unallocated tail entries point
+    at the trash page, so their (unchanged, garbage) lane pages land
+    there; duplicate trash indices are benign (.at[].set last-writer)."""
+    ppl = row.shape[0]
+    ids = row[start_page:]
+
+    def sc(p_leaf, lane_leaf):
+        page = p_leaf.shape[1]
+        pages = lane_leaf.reshape((ppl, page) + lane_leaf.shape[2:])
+        return p_leaf.at[ids].set(pages[start_page:])
+
+    return jax.tree_util.tree_map(sc, phys, lane)
+
+
+def scatter_written_pages(phys, lanes, table, pos):
+    """Per-slot single-page write-back for one decode step (traced):
+    slot s wrote exactly one token at position `pos[s]`, so exactly one
+    page — index pos[s] // page — of its gathered lane changed. Gather
+    that page per slot and scatter the batch to the physical ids. Active
+    slots' write pages are exclusively owned (see the module comment:
+    shared pages always precede the write frontier), so the batched
+    scatter indices never collide except on the trash page, where
+    garbage overwriting garbage is fine."""
+    ppl = table.shape[1]
+
+    def sc(p_leaf, lane_leaf):
+        page = p_leaf.shape[1]
+        pg = jnp.clip(pos.astype(jnp.int32) // page, 0, ppl - 1)
+        ids = jnp.take_along_axis(table, pg[:, None], axis=1)[:, 0]
+        pages = jax.vmap(
+            lambda lane, i: jax.lax.dynamic_slice_in_dim(
+                lane, i * page, page, axis=0
+            )
+        )(lane_leaf, pg)
+        return p_leaf.at[ids].set(pages)
+
+    return jax.tree_util.tree_map(sc, phys, lanes)
+
+
+TRASH_PAGE = 0  # physical page 0: reserved write sink, never allocated
+
+
+class PagedKVPool(_SlotBook):
+    """Block-paged KV pool: `page_budget` allocatable fixed-size pages +
+    per-slot page tables + refcounts (host-side bookkeeping; the traced
+    side is the gather/scatter helpers above).
+
+    `phys` is the physical pytree — `model.init_caches(page_budget + 1,
+    page_size)`, batch dim = page id, page 0 the trash page — and is
+    NEVER reallocated: `nbytes` is constant for the pool's lifetime,
+    which is the point (HBM booked once, up front, independent of slot
+    count and max_seq). `table` is the (n_slots, pages_per_lane) int32
+    page-table mirror shipped to the device inside the engine's packed
+    control arrays; entries [0, n_alloc[slot]) are live (refcounted),
+    the rest rest at the trash page.
+
+    Refcount protocol: an owned page (fresh `ensure` allocation) starts
+    at 1; every additional holder — a slot appending shared prefix pages
+    (`append_shared`) or the radix tree taking a reference
+    (`share_range`) — increfs; `release`/`decref` decrement and a page
+    returns to the free list at zero. The tree and the slots are
+    symmetric holders: either can outlive the other.
+
+    `positions[slot]` keeps the lane pool's fill-level semantics (prompt
+    + emitted - newest), for introspection and the fragmentation gauge.
+    """
+
+    def __init__(self, model, n_slots: int, max_len: int, page_size: int,
+                 page_budget: int | None = None):
+        self._init_slots(n_slots)
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size:
+            raise ValueError(
+                f"max_len {max_len} is not a multiple of page_size "
+                f"{page_size} — page tables need whole pages per lane"
+            )
+        self.max_len = max_len
+        self.page_size = page_size
+        self.pages_per_lane = max_len // page_size
+        if page_budget is None:
+            # lane-pool-equivalent capacity: every slot can hold a full
+            # lane at once (callers shrink it to trade worst-case room
+            # for more slots — that is the capacity win)
+            page_budget = n_slots * self.pages_per_lane
+        if page_budget < self.pages_per_lane:
+            raise ValueError(
+                f"page_budget {page_budget} cannot cover even one full "
+                f"lane ({self.pages_per_lane} pages) — a single max-length "
+                "request could never be scheduled"
+            )
+        self.page_budget = page_budget
+        self.n_pages = page_budget + 1  # + the trash page
+        self.phys = model.init_caches(self.n_pages, page_size)
+        self.table = np.full((n_slots, self.pages_per_lane), TRASH_PAGE,
+                             np.int32)
+        self.n_alloc = np.zeros(n_slots, np.int32)
+        self.refcount = np.zeros(self.n_pages, np.int32)
+        self.refcount[TRASH_PAGE] = 1  # permanently held, never freed
+        # LIFO free list: recently-freed pages are reused warm
+        self._free_pages = list(range(self.n_pages - 1, TRASH_PAGE, -1))
+
+    # ------------------------------------------------------------ gauges
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes of the physical pool — CONSTANT by construction
+        (the pool never grows or shrinks); the HBM ledger's kv_pool
+        gauge."""
+        from solvingpapers_tpu.metrics.xla_obs import pytree_bytes
+
+        return pytree_bytes(self.phys)
+
+    @property
+    def page_nbytes(self) -> int:
+        """Device bytes one page holds across every cache leaf — what a
+        radix-tree page reference costs in the prefix cache's budget."""
+        return self.nbytes // self.n_pages
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_active(self) -> int:
+        return self.page_budget - len(self._free_pages)
+
+    @property
+    def fragmentation(self) -> float:
+        """Internal fragmentation: the fraction of slot-allocated page
+        capacity not (yet) holding live KV — decode reservations and
+        trailing-page slack. 0.0 with nothing allocated; paged pools
+        have no EXTERNAL fragmentation (any free page serves any slot),
+        which is the property the gauge exists to contrast with the
+        lane pool's whole-lane booking."""
+        alloc_tokens = int(self.n_alloc.sum()) * self.page_size
+        if alloc_tokens == 0:
+            return 0.0
+        used = int(np.minimum(self.positions,
+                              self.n_alloc * self.page_size).sum())
+        return 1.0 - used / alloc_tokens
+
+    # ------------------------------------------------------------- slots
+    #
+    # acquire() is the shared _SlotBook one; pages are NOT reserved at
+    # acquire — `append_shared`/`ensure` populate the table as the
+    # request's footprint becomes known.
+
+    def release(self, slot: int) -> None:
+        """Free a slot: decref every table entry it holds (owned pages
+        free immediately; shared ones survive under their other
+        holders), park the row at the trash page."""
+        self._guard_release(slot)
+        n = int(self.n_alloc[slot])
+        self.decref(self.table[slot, :n].tolist())
+        self.table[slot, :n] = TRASH_PAGE
+        self.n_alloc[slot] = 0
+        self._finish_release(slot)
+
+    # ------------------------------------------------------------- pages
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to cover token positions [0, n_tokens)."""
+        return -(-n_tokens // self.page_size)
+
+    def ensure(self, slot: int, n_tokens: int) -> bool:
+        """Grow `slot`'s table to cover positions [0, n_tokens) with
+        freshly-owned pages. False when the free list runs dry — the
+        allocation KEEPS what it got (the pages stay booked to the slot;
+        the caller reclaims — prefix-tree eviction, then preemption —
+        and retries). Shared prefix pages must already be appended:
+        `ensure` only ever extends the tail."""
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        target = self.pages_for(min(n_tokens, self.max_len))
+        if target > self.pages_per_lane:
+            raise ValueError(
+                f"coverage of {n_tokens} tokens exceeds the lane capacity "
+                f"{self.max_len}"
+            )
+        while int(self.n_alloc[slot]) < target:
+            if not self._free_pages:
+                return False
+            pid = self._free_pages.pop()
+            self.refcount[pid] = 1
+            self.table[slot, self.n_alloc[slot]] = pid
+            self.n_alloc[slot] += 1
+        return True
+
+    def append_shared(self, slot: int, page_ids) -> None:
+        """Zero-copy prefix hit: extend `slot`'s page table with already-
+        populated shared pages (incref'd — the radix tree keeps its own
+        references). Must precede any `ensure` for the slot: shared
+        prefix pages are logically the lane's leading pages."""
+        if not page_ids:
+            return
+        n = int(self.n_alloc[slot])
+        if n + len(page_ids) > self.pages_per_lane:
+            raise ValueError(
+                f"shared append of {len(page_ids)} pages at table offset "
+                f"{n} exceeds the lane capacity {self.pages_per_lane}"
+            )
+        for pid in page_ids:
+            if not TRASH_PAGE < pid < self.n_pages:
+                raise ValueError(f"page id {pid} out of range")
+        self.incref(page_ids)
+        self.table[slot, n:n + len(page_ids)] = page_ids
+        self.n_alloc[slot] += len(page_ids)
+
+    def share_range(self, slot: int, offset: int, length: int) -> list[int]:
+        """Take references on the pages covering `slot`'s token span
+        [offset, offset + length) — the prefix cache's insert path
+        (page-aligned span; the lane-pool `extract_prefix` analogue,
+        minus the device copy). The returned ids are INCREF'D: the
+        caller owns one reference per page and must `decref` to drop
+        them (the radix tree does, on eviction)."""
+        if offset % self.page_size or length % self.page_size:
+            raise ValueError(
+                f"share span [{offset}, {offset + length}) is not "
+                f"page-aligned (page_size {self.page_size})"
+            )
+        if length < 1:
+            raise ValueError(f"length must be >= 1, got {length}")
+        first = offset // self.page_size
+        last = (offset + length) // self.page_size
+        if last > int(self.n_alloc[slot]):
+            raise ValueError(
+                f"share span [{offset}, {offset + length}) exceeds slot "
+                f"{slot}'s allocated coverage "
+                f"{int(self.n_alloc[slot]) * self.page_size}"
+            )
+        ids = self.table[slot, first:last].tolist()
+        self.incref(ids)
+        return ids
+
+    def incref(self, page_ids) -> None:
+        """Take one reference per id (the single bump path —
+        `append_shared`/`share_range` route through it). Per-element on
+        purpose: a numpy fancy-index `+= 1` silently under-counts
+        duplicate ids."""
+        for pid in page_ids:
+            if self.refcount[pid] < 1:
+                raise ValueError(f"page {pid} is free — cannot incref")
+        for pid in page_ids:
+            self.refcount[pid] += 1
+
+    def decref(self, page_ids) -> None:
+        """Drop one reference per id; pages hitting zero return to the
+        free list (LIFO). Over-release raises — a negative refcount
+        means a page was freed while someone still held it, the exact
+        bug the counts exist to make loud."""
+        for pid in page_ids:
+            if pid == TRASH_PAGE:
+                raise ValueError("the trash page is never released")
+            if self.refcount[pid] < 1:
+                raise ValueError(
+                    f"page {pid} over-released (refcount already 0)"
+                )
+            self.refcount[pid] -= 1
+            if self.refcount[pid] == 0:
+                self._free_pages.append(pid)

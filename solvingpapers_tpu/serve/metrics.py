@@ -17,6 +17,18 @@ console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
                              (eos / length / stop / cancelled / timeout —
                              see serve/scheduler.py Request.finish_reason)
 
+Paged-pool gauges (present iff `ServeConfig.paged`; the engine registers
+a gauge provider, same mechanism as the observatory below):
+
+    serve/pages_free           allocatable pages currently free
+    serve/pages_active         pages referenced by slots or the tree
+    serve/page_fragmentation   internal slack: 1 - live KV / allocated
+                               page capacity (reservations + tail slack)
+    serve/preemptions          requests evicted mid-stream on page
+                               exhaustion (recomputed on re-admission);
+                               present iff any occurred, with
+    serve/recompute_tokens     the tokens re-prefilled by those resumes
+
 Prefix-cache counters (serve/prefix_cache.py; present when the engine's
 prefix cache is on):
 
@@ -68,6 +80,8 @@ class ServeMetrics:
         self.prefix_cached_tokens = 0
         self.prefix_evictions = 0
         self.prefix_bytes_held = 0
+        self.preemptions = 0
+        self.recompute_tokens = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
         # zero-arg dict providers merged into every snapshot — how the
@@ -146,6 +160,17 @@ class ServeMetrics:
         self.prefix_bytes_held = bytes_held
         self.prefix_evictions = evictions
 
+    def record_preemption(self) -> None:
+        """A paged-pool request lost its slot to page exhaustion (it will
+        recompute on re-admission)."""
+        self.preemptions += 1
+
+    def record_recompute_tokens(self, n: int) -> None:
+        """Prompt+stream tokens re-prefilled by a preempted request's
+        resume — the compute cost of preemption-by-recompute."""
+        self.recompute_tokens += n
+        self.prefill_tokens += n
+
     def snapshot(self) -> dict[str, float]:
         """Current aggregate view, flat keys ready for a MetricsWriter."""
         out = {
@@ -169,6 +194,9 @@ class ServeMetrics:
             )
             out["serve/prefix_evictions"] = float(self.prefix_evictions)
             out["serve/prefix_hbm_bytes"] = float(self.prefix_bytes_held)
+        if self.preemptions:
+            out["serve/preemptions"] = float(self.preemptions)
+            out["serve/recompute_tokens"] = float(self.recompute_tokens)
         elapsed = self.elapsed_s
         if elapsed > 0:
             out["serve/tokens_per_sec"] = self.tokens_out / elapsed

@@ -22,14 +22,27 @@ This bounds the jitted splice/extract program inventory (segment time
 lengths are page multiples <= max_len) and keeps node splits aligned so a
 split never has to cut a device segment at an arbitrary offset mid-walk.
 
-Memory: segments are COPIES (snapshotted out of a lane after prefill by
-`extract_fn`), accounted against `max_bytes`; LRU leaves are evicted once
-the budget is exceeded. `refcount` pins a matched path while its splice
-is in flight — a pinned node (or any ancestor of one; `_split` preserves
-the invariant) is never evicted, so eviction under pressure cannot
-corrupt an active lane's stream. Lanes own their spliced copy, so once
-the splice returns the pins can drop and later evictions are irrelevant
-to in-flight requests.
+Memory: with the lane pool, segments are COPIES (snapshotted out of a
+lane after prefill by `extract_fn`), accounted against `max_bytes`; LRU
+leaves are evicted once the budget is exceeded. `refcount` pins a
+matched path while its splice is in flight — a pinned node (or any
+ancestor of one; `_split` preserves the invariant) is never evicted, so
+eviction under pressure cannot corrupt an active lane's stream. Lanes
+own their spliced copy, so once the splice returns the pins can drop and
+later evictions are irrelevant to in-flight requests.
+
+Paged pools (`PrefixCache(pool=PagedKVPool)`): nodes hold PHYSICAL PAGE
+IDS with refcounts instead of device copies — the RadixAttention sharing
+model in full. `extract_fn` then returns incref'd page ids
+(`PagedKVPool.share_range` — a host-side refcount bump, zero device
+copies), a hit appends those ids to the acquiring slot's page table
+(`append_shared`, zero copies again), splits are list splits, and
+eviction decrefs (the page frees only when no slot still references it
+— the tree and the slots are symmetric holders, so eviction can NEVER
+corrupt an in-flight stream by construction, not just by pinning).
+`max_bytes` then bounds the tree's page-reference footprint — how much
+of the fixed physical pool the tree may keep pinned away from the
+allocator — rather than extra HBM.
 """
 
 from __future__ import annotations
@@ -59,10 +72,64 @@ def slice_segment(segment, start: int, end: int):
     return jax.tree_util.tree_map(lambda a: a[:, start:end], segment)
 
 
+class _Segment:
+    """Lane-pool node payload: an OWNED batch-1 device segment (a copy
+    snapshotted out of a lane). Released by garbage collection — nothing
+    else references the buffers."""
+
+    __slots__ = ("segment",)
+
+    def __init__(self, segment):
+        self.segment = segment
+
+    @property
+    def nbytes(self) -> int:
+        return segment_bytes(self.segment)
+
+    def split(self, k: int):
+        """(upper payload of tokens [0, k), lower of [k, n)) — device
+        slices; both halves are independent copies of their spans."""
+        n = segment_length(self.segment)
+        return (_Segment(slice_segment(self.segment, 0, k)),
+                _Segment(slice_segment(self.segment, k, n)))
+
+    def release(self) -> None:
+        pass  # dropping the reference frees the device buffers
+
+
+class _PageRun:
+    """Paged-pool node payload: a run of REFERENCED physical page ids
+    (one tree-held refcount each, taken by `PagedKVPool.share_range`).
+    Zero device bytes of its own — `nbytes` is the pool bytes the run
+    keeps pinned, which is what the LRU budget must account."""
+
+    __slots__ = ("pages", "pool")
+
+    def __init__(self, pages: list, pool):
+        self.pages = list(pages)
+        self.pool = pool
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.pages) * self.pool.page_nbytes
+
+    def split(self, k: int):
+        """List split at the page boundary — no device work, no refcount
+        change: the run's references are distributed, not duplicated
+        (each half releases only its own ids)."""
+        kp = k // self.pool.page_size
+        return (_PageRun(self.pages[:kp], self.pool),
+                _PageRun(self.pages[kp:], self.pool))
+
+    def release(self) -> None:
+        self.pool.decref(self.pages)
+        self.pages = []
+
+
 class _Node:
-    """One radix edge: `tokens` (page-multiple id array) + the device
-    segment holding their KV, rooted at absolute prefix offset
-    = sum of ancestor edge lengths.
+    """One radix edge: `tokens` (page-multiple id array) + the payload
+    holding their KV (`_Segment` copy or `_PageRun` references), rooted
+    at absolute prefix offset = sum of ancestor edge lengths.
 
     `children` is keyed by the child edge's FIRST PAGE (`tokens[:page]`
     as bytes), not its first token: matches only ever advance in whole
@@ -70,21 +137,33 @@ class _Node:
     diverge mid-page (different pages, same first token) can coexist,
     which single-token keys would force into collision."""
 
-    __slots__ = ("tokens", "segment", "children", "parent", "refcount",
+    __slots__ = ("tokens", "payload", "children", "parent", "refcount",
                  "stamp", "nbytes")
 
-    def __init__(self, tokens: np.ndarray, segment, parent: "_Node | None"):
+    def __init__(self, tokens: np.ndarray, payload, parent: "_Node | None"):
         self.tokens = tokens
-        self.segment = segment
+        self.payload = payload
         self.children: dict[bytes, _Node] = {}
         self.parent = parent
         self.refcount = 0
         self.stamp = 0
-        self.nbytes = 0 if segment is None else segment_bytes(segment)
+        self.nbytes = 0 if payload is None else payload.nbytes
 
     @property
     def length(self) -> int:
         return int(self.tokens.size)
+
+    @property
+    def segment(self) -> object:
+        """The device segment (lane-pool payloads) — what the engine
+        splices; kept as the node's public face for that path."""
+        return self.payload.segment
+
+    @property
+    def pages(self) -> list:
+        """The physical page ids (paged-pool payloads) — what the engine
+        appends to a hitting slot's page table."""
+        return self.payload.pages
 
 
 @dataclasses.dataclass
@@ -100,16 +179,30 @@ class PrefixMatch:
 
 
 class PrefixCache:
-    """Radix tree + LRU byte-budget eviction + refcount pinning."""
+    """Radix tree + LRU byte-budget eviction + refcount pinning.
+
+    `pool=None` (lane pools): nodes own segment copies and `extract_fn`
+    returns batch-1 segment pytrees. With a `PagedKVPool` bound, nodes
+    hold refcounted page-id runs and `extract_fn` must return incref'd
+    page ids (the engine binds `pool.share_range`); `page` must then
+    equal the pool's `page_size` so tree edges and physical pages stay
+    aligned (splits never have to cut a page)."""
 
     def __init__(self, page: int = 16, max_bytes: int = 64 << 20,
-                 trace=None):
+                 trace=None, pool=None):
         if page < 1:
             raise ValueError(f"page must be >= 1, got {page}")
         if max_bytes < 0:
             raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        if pool is not None and pool.page_size != page:
+            raise ValueError(
+                f"tree page {page} != pool page_size {pool.page_size}: "
+                "page-id sharing needs tree edges aligned to physical "
+                "pages"
+            )
         self.page = page
         self.max_bytes = max_bytes
+        self.pool = pool
         # optional metrics.trace.FlightRecorder (the engine's); hooks are
         # single `is not None` branches when tracing is off
         self.trace = trace
@@ -222,15 +315,13 @@ class PrefixCache:
         a count that no `unpin` would ever drop."""
         assert 0 < k < node.tokens.size and k % self.page == 0
         old_bytes = node.nbytes
-        upper = _Node(
-            node.tokens[:k].copy(), slice_segment(node.segment, 0, k),
-            node.parent,
-        )
+        up_payload, lo_payload = node.payload.split(k)
+        upper = _Node(node.tokens[:k].copy(), up_payload, node.parent)
         upper.stamp = node.stamp
         node.parent.children[self._key(upper.tokens)] = upper
-        node.segment = slice_segment(node.segment, k, node.tokens.size)
+        node.payload = lo_payload
         node.tokens = node.tokens[k:].copy()
-        node.nbytes = segment_bytes(node.segment)
+        node.nbytes = lo_payload.nbytes
         node.parent = upper
         upper.children[self._key(node.tokens)] = node
         self.bytes_held += upper.nbytes + node.nbytes - old_bytes
@@ -248,12 +339,16 @@ class PrefixCache:
             node.refcount -= 1
 
     def insert(self, tokens, extract_fn) -> int:
-        """Cache `tokens` (length must be a page multiple); the portion not
-        already in the tree is snapshotted via ``extract_fn(offset,
-        length) -> segment`` (offset/length in token positions within the
-        prompt — the engine binds this to `KVSlotPool.extract_prefix` for
-        the freshly prefilled lane). Returns the number of NEW tokens
-        cached. May evict LRU leaves to respect `max_bytes`.
+        """Cache `tokens` (length must be a page multiple); the portion
+        not already in the tree is captured via ``extract_fn(offset,
+        length)`` (offset/length in token positions within the prompt).
+        With a lane pool that returns a snapshot segment (the engine
+        binds `KVSlotPool.extract_prefix` — a device copy); with a paged
+        pool it returns incref'd page ids (`PagedKVPool.share_range` —
+        zero device work; only a trailing partial page would ever need a
+        copy, and the engine never inserts one: insert lengths are page
+        multiples by contract). Returns the number of NEW tokens cached.
+        May evict LRU leaves to respect `max_bytes`.
         """
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size % self.page:
@@ -274,7 +369,10 @@ class PrefixCache:
         # overwriting would orphan a subtree and leak its byte accounting.
         if self._key(rem) in parent.children:
             return 0
-        node = _Node(rem.copy(), extract_fn(m.length, int(rem.size)), parent)
+        raw = extract_fn(m.length, int(rem.size))
+        payload = (_PageRun(raw, self.pool) if self.pool is not None
+                   else _Segment(raw))
+        node = _Node(rem.copy(), payload, parent)
         node.stamp = self._tick()
         parent.children[self._key(rem)] = node
         self.bytes_held += node.nbytes
@@ -287,24 +385,35 @@ class PrefixCache:
         self._evict_to_budget()
         return int(rem.size)
 
+    def evict_one(self) -> bool:
+        """Evict the LRU unpinned childless leaf unconditionally (the
+        paged engine's page-pressure reclaim: shedding tree references
+        is always preferable to preempting a live request). False when
+        everything left is pinned or interior — the tree cannot help."""
+        victim = None
+        for node in self._walk():
+            if node is self.root or node.children or node.refcount > 0:
+                continue
+            if victim is None or node.stamp < victim.stamp:
+                victim = node
+        if victim is None:
+            return False
+        del victim.parent.children[self._key(victim.tokens)]
+        self.bytes_held -= victim.nbytes
+        self.evictions += 1
+        freed = victim.nbytes
+        victim.payload.release()
+        if self.trace is not None:
+            self.trace.instant(
+                "prefix_evict", "prefix", "prefix",
+                tokens=victim.length, freed=freed,
+                held=self.bytes_held,
+            )
+        return True
+
     def _evict_to_budget(self) -> None:
         """Drop LRU unpinned leaves until under budget. Interior nodes
         become evictable once their children go; pinned nodes never do."""
         while self.bytes_held > self.max_bytes:
-            victim = None
-            for node in self._walk():
-                if node is self.root or node.children or node.refcount > 0:
-                    continue
-                if victim is None or node.stamp < victim.stamp:
-                    victim = node
-            if victim is None:
+            if not self.evict_one():
                 return  # everything left is pinned or interior
-            del victim.parent.children[self._key(victim.tokens)]
-            self.bytes_held -= victim.nbytes
-            self.evictions += 1
-            if self.trace is not None:
-                self.trace.instant(
-                    "prefix_evict", "prefix", "prefix",
-                    tokens=victim.length, freed=victim.nbytes,
-                    held=self.bytes_held,
-                )

@@ -23,6 +23,14 @@ requests get prefilled into free slots. Policy knobs:
   fastest TTFT under load, and hot prefixes stay hot). Requests past the
   wait budget still go first, in FIFO order — the anti-starvation
   guarantee is unchanged.
+* page-budget gate (`can_admit`, bound by the paged engine) — with a
+  `PagedKVPool`, a free SLOT is no longer a sufficient admission
+  condition: the request also needs free PAGES for its prompt plus a
+  decode reservation. `pick` stops at the first candidate the gate
+  rejects (head-of-line blocking is deliberate: admitting a shorter
+  request past a page-starved head would starve long prompts forever),
+  and `requeue_front` returns a preempted request to the head of the
+  queue so its recompute runs as soon as pages free up.
 """
 
 from __future__ import annotations
@@ -118,6 +126,7 @@ class FIFOScheduler:
         max_wait_steps: int = 64,
         prefer_cached: bool = False,
         prefix_lookup=None,
+        can_admit=None,
         trace=None,
     ):
         self.max_waiting = max_waiting
@@ -127,6 +136,9 @@ class FIFOScheduler:
         self.prefer_cached = prefer_cached
         # prompt (np.ndarray) -> cached-prefix match length; read-only
         self.prefix_lookup = prefix_lookup
+        # Request -> bool capacity gate beyond free slots (the paged
+        # engine's page-budget check); None = slots are the only gate
+        self.can_admit = can_admit
         # optional metrics.trace.FlightRecorder (the engine's); every
         # hook below is one `is not None` branch when tracing is off
         self.trace = trace
@@ -168,7 +180,13 @@ class FIFOScheduler:
                 )
         k = min(budget, n_free, len(self.queue))
         if not (self.prefer_cached and self.prefix_lookup is not None):
-            return [self.queue.popleft() for _ in range(k)]
+            picked = []
+            while len(picked) < k and self.queue:
+                if (self.can_admit is not None
+                        and not self.can_admit(self.queue[0])):
+                    break  # page-starved head blocks: strict FIFO
+                picked.append(self.queue.popleft())
+            return picked
         overdue = [r for r in self.queue
                    if r.waited_steps > self.max_wait_steps]
         fresh = [r for r in self.queue
@@ -177,10 +195,25 @@ class FIFOScheduler:
             if r.prefix_hint is None:
                 r.prefix_hint = self.prefix_lookup(r.prompt)
         fresh.sort(key=lambda r: r.prompt.size - r.prefix_hint)
-        picked = (overdue + fresh)[:k]
+        picked = []
+        for r in overdue + fresh:
+            if len(picked) >= k:
+                break
+            if self.can_admit is not None and not self.can_admit(r):
+                break  # same head-of-line discipline in preference order
+            picked.append(r)
         taken = {id(r) for r in picked}
         self.queue = deque(r for r in self.queue if id(r) not in taken)
         return picked
+
+    def requeue_front(self, req: Request) -> None:
+        """Return a PREEMPTED request to the head of the queue (the paged
+        engine's page-exhaustion path): it was already admitted once, so
+        it bypasses the `max_waiting` bound and keeps its accumulated
+        `waited_steps` (the anti-starvation clock must not reset — the
+        preemption already cost it its slot)."""
+        req.state = WAITING
+        self.queue.appendleft(req)
 
     def remove(self, req: Request) -> bool:
         """Drop a waiting request from the queue (identity match — the

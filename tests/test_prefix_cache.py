@@ -340,10 +340,14 @@ def _ref_stream(model, params, extra, prompt, max_new, eos_id=None):
     return gen
 
 
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
 @pytest.mark.parametrize("family", sorted(_FAMILIES))
-def test_streams_token_exact_with_cache_on_off_all_families(family):
+def test_streams_token_exact_with_cache_on_off_all_families(family, paged):
     """Greedy streams: cache-on == cache-off == one-shot generate, across
-    shared-prefix traffic. The cache-on runs must actually hit."""
+    shared-prefix traffic, on BOTH pool layouts. The cache-on runs must
+    actually hit — and on the paged pool a hit is a zero-copy page-table
+    append rather than a lane splice, which must be just as invisible in
+    the tokens."""
     model, params, extra = _FAMILIES[family]()
     prompts = _shared_prefix_prompts(6, seed=11)
     streams = {}
@@ -351,7 +355,8 @@ def test_streams_token_exact_with_cache_on_off_all_families(family):
         eng = ServeEngine(
             model, params,
             ServeConfig(n_slots=2, max_len=32, decode_block=4, bucket=8,
-                        prefix_cache=on, prefix_page=4),
+                        prefix_cache=on, prefix_page=4, paged=paged,
+                        page_size=4 if paged else None),
             extra_variables=extra,
         )
         handles = [eng.submit(p, max_new_tokens=6) for p in prompts]

@@ -61,13 +61,17 @@ def _ref_stream(model, params, prompt, max_new, eos_id=None):
 # ----------------------------------------------------------------- engine
 
 
-def test_staggered_requests_match_one_shot_generate(gpt_tiny):
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_staggered_requests_match_one_shot_generate(gpt_tiny, paged):
     """S slots, 2*S requests submitted in two staggered waves: every
-    stream must be token-exact vs per-request one-shot generate."""
+    stream must be token-exact vs per-request one-shot generate — on
+    both pool layouts (the paged pool's page-table indirection must be
+    invisible in the tokens)."""
     model, params = gpt_tiny
     S = 4
     eng = ServeEngine(model, params, ServeConfig(
-        n_slots=S, max_len=64, decode_block=4, bucket=8,
+        n_slots=S, max_len=64, decode_block=4, bucket=8, paged=paged,
+        page_size=8 if paged else None,
     ))
     prompts = _prompts(2 * S, seed=1)
     handles = [eng.submit(p, max_new_tokens=12) for p in prompts[:S]]
@@ -260,6 +264,26 @@ def test_kv_pool_acquire_release(gpt_tiny):
     pool.release(slots[1])
     with pytest.raises(ValueError, match="double release"):
         pool.release(slots[1])
+
+
+def test_kv_pool_release_guard_is_membership_tracked(gpt_tiny):
+    """Regression for the O(n_slots) `slot in free_list` scan on the
+    hot release path: free membership is a boolean mask kept in sync
+    with the LIFO list through arbitrary acquire/release interleavings,
+    and the double-release guard still fires from any state."""
+    model, _ = gpt_tiny
+    pool = KVSlotPool(model, n_slots=4, max_len=16)
+    held = [pool.acquire() for _ in range(4)]
+    for s in held:
+        assert not pool._free_mask[s]
+    pool.release(held[2])
+    pool.release(held[0])
+    assert pool._free_mask[held[0]] and pool._free_mask[held[2]]
+    assert pool.acquire() == held[0]  # LIFO order preserved by the list
+    with pytest.raises(ValueError, match="double release"):
+        pool.release(held[2])
+    # mask and list agree exactly after the churn
+    assert sorted(pool._free) == sorted(np.flatnonzero(pool._free_mask))
 
 
 def test_kv_pool_acquire_on_exhausted_is_stable(gpt_tiny):

@@ -99,6 +99,64 @@ def test_latency_summaries_present_iff_observed():
     assert "serve/ttft_s_mean" not in snap
 
 
+def test_preemption_keys_present_iff_observed():
+    """serve/preemptions + serve/recompute_tokens ride the snapshot only
+    once a preemption happened — the paged pool's exhaustion path must
+    not grow the lane pool's key surface."""
+    m = ServeMetrics()
+    assert "serve/preemptions" not in m.snapshot()
+    m.record_preemption()
+    m.record_recompute_tokens(24)
+    snap = m.snapshot()
+    assert snap["serve/preemptions"] == 1.0
+    assert snap["serve/recompute_tokens"] == 24.0
+    # recompute work counts as prefill compute too
+    assert snap["serve/tokens_prefilled"] == 24.0
+
+
+def test_page_gauges_present_iff_paged_engine():
+    """serve/pages_* appear exactly when the engine runs the paged pool
+    (the engine registers a gauge provider, same mechanism as the
+    observatory) and report the live free/active split."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    lane = ServeEngine(model, params, ServeConfig(n_slots=2, max_len=32))
+    assert not any(k.startswith("serve/pages")
+                   for k in lane.metrics.snapshot())
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8, paged=True,
+        page_size=4,
+    ))
+    snap = eng.metrics.snapshot()
+    budget = 2 * (32 // 4)
+    assert snap["serve/pages_free"] == float(budget)
+    assert snap["serve/pages_active"] == 0.0
+    assert snap["serve/page_fragmentation"] == 0.0
+    h = eng.submit(np.arange(9, dtype=np.int32), max_new_tokens=16)
+    eng.step()  # prefill + one block: the stream is still mid-flight
+    mid = eng.metrics.snapshot()
+    assert mid["serve/pages_active"] > 0
+    assert mid["serve/pages_free"] < budget
+    assert 0.0 <= mid["serve/page_fragmentation"] < 1.0
+    eng.run()
+    assert h.done
+    end = eng.metrics.snapshot()
+    assert end["serve/pages_free"] == float(budget)
+    # names survive the Prometheus grammar like every other serve/* key
+    for k in ("serve/pages_free", "serve/pages_active",
+              "serve/page_fragmentation"):
+        assert PrometheusTextWriter.sanitize(k).startswith("serve_")
+
+
 # ------------------------------------- observatory gauges (mem/compile)
 
 

@@ -14,9 +14,12 @@ Usage: python tools/bench_serve.py [--config llama3_shakespeare]
 BENCH_serve.json is JSON-lines, one entry per workload. The default run
 overwrites it with the Poisson entry; re-run with
 `--shared-prefix --append` to add the prefix-cache workload entry
-(cache-on vs cache-off TTFT over K shared system prompts) and with
+(cache-on vs cache-off TTFT over K shared system prompts), with
 `--sampling --append` for the per-request-sampling workload (mixed
-temperature/top-p/top-k/min-p vs all-greedy on the same trace).
+temperature/top-p/top-k/min-p vs all-greedy on the same trace), and
+with `--paged --append` for the paged-KV-pool workload (ABBA-paired
+paged vs lane throughput, equal-HBM capacity arm, zero-copy
+shared-prefix TTFT).
 
 Add `--trace` to any workload to run one extra flight-recorded arm: the
 entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
@@ -41,7 +44,11 @@ def main() -> int:
         # gpt_shakespeare's 8-layer / 256-position config shows the cache's
         # effect honestly on CPU; llama3_shakespeare (128 positions) stays
         # the Poisson-throughput default for cross-round comparability
-        default = ("gpt_shakespeare" if "--shared-prefix" in argv
+        # --paged shares --shared-prefix's reasoning for its prefix
+        # sub-arm: the 256-position config's long stems are the regime
+        # where the hit-TTFT claim is measured
+        default = ("gpt_shakespeare"
+                   if ("--shared-prefix" in argv or "--paged" in argv)
                    else "llama3_shakespeare")
         argv += ["--config", default]
     if not any(a == "--out" or a.startswith("--out=") for a in argv):
