@@ -23,7 +23,8 @@ __version__ = "0.1.0"
 
 _SERVE_API = ("ServeEngine", "ServeConfig", "KVSlotPool", "FIFOScheduler",
               "Request", "ServeMetrics", "PrefixCache", "PrefixMatch",
-              "SamplingParams")
+              "SamplingParams", "ApiServer", "EngineLoop", "JsonStepper",
+              "serve_api")
 
 
 def __getattr__(name):

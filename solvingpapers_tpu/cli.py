@@ -8,7 +8,10 @@ star: "every notebook's train() cell becomes a CLI entrypoint"):
                [--checkpoint-dir ckpts] [--jsonl metrics.jsonl]
     cli sample --config gpt_shakespeare --checkpoint-dir ckpts
                [--prompt "ROMEO:"] [--max-new-tokens 200] [--top-k 50]
-    cli serve-bench --config llama3_shakespeare [--trace]
+    cli serve  --config gpt_shakespeare [--checkpoint-dir ckpts]
+               [--port 8000] — OpenAI-compatible /v1/completions +
+               /v1/chat/completions (SSE streaming, json_object mode)
+    cli serve-bench --config llama3_shakespeare [--trace] [--http]
     cli trace-summary serve_trace.json [--top 10]
 """
 
@@ -384,6 +387,124 @@ def cmd_sample(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serve a model over the OpenAI-compatible HTTP front door
+    (serve/api.py): POST /v1/completions + /v1/chat/completions (SSE
+    streaming, json_object mode) plus /healthz /metrics /statusz on ONE
+    port. Ctrl-C / SIGTERM shuts down in order: drain active streams,
+    close the engine, stop the HTTP threads."""
+    import signal
+    import threading
+
+    from solvingpapers_tpu.configs import get_config
+    from solvingpapers_tpu.configs.factory import build_char_lm_run
+    from solvingpapers_tpu.serve.api import ApiServer
+    from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+    from solvingpapers_tpu.serve.openai import extend_token_table
+
+    cfg = get_config(args.config)
+    if cfg.train.pipeline_parallel:
+        print("serving is unsupported for pipeline-parallel configs; "
+              "export the stage-stacked params to the dense family first",
+              file=sys.stderr)
+        return 2
+    if getattr(cfg.model, "context_parallel", False):
+        # params are replicated at rest: serve the dense twin, exactly
+        # like cmd_sample's single-chip path
+        from solvingpapers_tpu.sharding import MeshConfig
+
+        cfg = dataclasses.replace(
+            cfg,
+            model=dataclasses.replace(cfg.model, context_parallel=False),
+            train=dataclasses.replace(
+                cfg.train, context_parallel=False, mesh=MeshConfig()
+            ),
+        )
+    if args.data_path:
+        cfg = dataclasses.replace(cfg, data={**cfg.data, "path": args.data_path})
+    cfg, model, tok, _, _ = build_char_lm_run(cfg)
+
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init({"params": jax.random.key(args.seed)}, dummy)
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    if args.checkpoint_dir:
+        restored = _restore_for_inference(
+            cfg, model, args.checkpoint_dir, {"x": dummy, "y": dummy}
+        )
+        if restored is None:
+            print(f"no checkpoint found in {args.checkpoint_dir}",
+                  file=sys.stderr)
+            return 1
+        _, params, extra_restored = restored
+        if extra_restored:
+            extra = extra_restored
+    else:
+        print("[serve] no --checkpoint-dir: serving RANDOM-INIT params "
+              "(endpoint/latency demo, not a language model)",
+              file=sys.stderr)
+
+    # token table over the FULL model vocab: corpus tokenizer ids decode
+    # normally, spare ids (model vocab_size > corpus charset) map to the
+    # missing JSON structural chars so json_object mode is expressible
+    vocab = getattr(model.cfg, "vocab_size", tok.vocab_size)
+    table = []
+    for i in range(vocab):
+        try:
+            table.append(tok.decode([i]))
+        except (KeyError, IndexError):
+            table.append(None)
+    table = extend_token_table(table, vocab)
+    stoi = {}
+    for i, t in enumerate(table):
+        if t is not None and len(t) == 1 and t not in stoi:
+            stoi[t] = i
+
+    def encode(s: str):
+        return [stoi[c] for c in s]
+
+    def decode(ids):
+        return "".join(table[int(i)] or "" for i in ids)
+
+    limit = getattr(model, "max_positions", None) or 512
+    max_len = args.max_len or min(512, limit)
+    serve_cfg = ServeConfig(
+        n_slots=args.slots,
+        max_len=max_len,
+        decode_block=args.decode_block,
+        bucket=min(args.bucket, max_len),
+        sample_cap=args.sample_cap,
+        paged=args.paged,
+        api_port=args.port,
+        api_host=args.host,
+        json_mode=not args.no_json_mode,
+        max_waiting=args.max_waiting,
+    )
+    engine = ServeEngine(model, params, serve_cfg,
+                         extra_variables=extra or None, detokenize=decode)
+    server = ApiServer(engine, encode=encode, decode=decode,
+                       token_table=table, model_name=args.config)
+    print(f"[serve] {args.config} on http://{server.host}:{server.port} "
+          f"— POST /v1/completions /v1/chat/completions, "
+          f"GET /healthz /metrics /statusz", file=sys.stderr)
+
+    stop = threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop.wait(0.5):
+            pass
+    finally:
+        print("[serve] shutting down: draining streams, closing engine",
+              file=sys.stderr)
+        server.close()
+    return 0
+
+
 def cmd_serve_bench(args) -> int:
     """Continuous-batching engine vs sequential one-shot generate on a
     synthetic Poisson arrival stream — or, with --shared-prefix, prefix
@@ -399,11 +520,12 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if sum((args.shared_prefix, args.sampling, args.paged)) > 1:
-        print("--shared-prefix, --sampling and --paged are separate "
-              "workloads; pick one per run", file=sys.stderr)
+    if sum((args.shared_prefix, args.sampling, args.paged, args.http)) > 1:
+        print("--shared-prefix, --sampling, --paged and --http are "
+              "separate workloads; pick one per run", file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
+        run_http_bench,
         run_paged_bench,
         run_prefix_bench,
         run_sampling_bench,
@@ -427,7 +549,18 @@ def cmd_serve_bench(args) -> int:
         status_port=args.status_port,
         status_hold_s=args.status_hold_s,
     )
-    if args.paged:
+    if args.http:
+        result = run_http_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(args.prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            seed=args.seed,
+        )
+    elif args.paged:
         result = run_paged_bench(
             config=args.config,
             n_requests=n_requests,
@@ -732,6 +865,13 @@ def main(argv=None) -> int:
                               "trace decoded all-greedy vs with a "
                               "per-request temperature/top-p/top-k/min-p "
                               "mix (serve/bench.py run_sampling_bench)")
+    p_serve.add_argument("--http", action="store_true",
+                         help="HTTP soak workload instead: the Poisson "
+                              "trace as N concurrent SSE clients through "
+                              "the OpenAI front door, ABBA-paired against "
+                              "direct engine.submit — req/s, client-side "
+                              "TTFT, p99 ITL and http_overhead_pct "
+                              "(serve/bench.py run_http_bench)")
     p_serve.add_argument("--paged", action="store_true",
                          help="paged-KV-pool workload instead: ABBA-paired "
                               "paged vs lane pool on the Poisson trace, a "
@@ -796,6 +936,28 @@ def main(argv=None) -> int:
                               "up this many seconds after the arms "
                               "finish (CI curl window)")
 
+    p_srv = sub.add_parser("serve")
+    _add_common(p_srv)
+    p_srv.add_argument("--port", type=int, default=8000,
+                       help="API port (0 = ephemeral, printed to stderr)")
+    p_srv.add_argument("--host", default="127.0.0.1",
+                       help="bind address (loopback by default — front "
+                            "with a real proxy to expose it)")
+    p_srv.add_argument("--slots", type=int, default=8)
+    p_srv.add_argument("--max-len", type=int, default=None,
+                       help="engine sequence capacity (default: min(512, "
+                            "model max positions))")
+    p_srv.add_argument("--decode-block", type=int, default=8)
+    p_srv.add_argument("--bucket", type=int, default=32)
+    p_srv.add_argument("--sample-cap", type=int, default=64)
+    p_srv.add_argument("--max-waiting", type=int, default=256)
+    p_srv.add_argument("--paged", action="store_true",
+                       help="serve over the paged KV pool")
+    p_srv.add_argument("--no-json-mode", action="store_true",
+                       help="reject response_format json_object instead "
+                            "of grammar-constraining the decode")
+    p_srv.add_argument("--seed", type=int, default=0)
+
     p_tsum = sub.add_parser("trace-summary")
     p_tsum.add_argument("trace",
                         help="Chrome trace-event JSON exported by the "
@@ -820,6 +982,7 @@ def main(argv=None) -> int:
         "list": cmd_list,
         "train": cmd_train,
         "sample": cmd_sample,
+        "serve": cmd_serve,
         "serve-bench": cmd_serve_bench,
         "trace-summary": cmd_trace_summary,
         "eval": cmd_eval,

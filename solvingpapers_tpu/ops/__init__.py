@@ -45,4 +45,5 @@ from solvingpapers_tpu.ops.sampling import (
     top_k_mask,
     top_p_mask,
     min_p_mask,
+    allowed_logits,
 )

@@ -83,6 +83,23 @@ def min_p_mask(logits: jax.Array, min_p) -> jax.Array:
     return jnp.where(probs >= thresh, logits, -jnp.inf)
 
 
+def allowed_logits(logits: jax.Array, allow: jax.Array):
+    """Gather `logits` at the `allow` token ids; -1 pads gather index 0
+    but land at -inf, so padded entries are never chosen.
+
+    `allow` is (..., A) int32 of token ids with -1 padding — the serving
+    engine's grammar-constrained allow-list (`serve/grammar.py`), packed
+    per slot into the jitted programs' control transfers. Returns
+    ``(vals, idx)`` where `vals` is the gathered (-inf-padded) logit row
+    over the allowed support and `idx` the (clipped) gather ids — the
+    same (values, indices) domain shape `lax.top_k` produces, so
+    `serve.sampling.fused_sample` swaps one for the other per row and
+    every downstream truncation mask applies unchanged."""
+    idx = jnp.clip(allow, 0, logits.shape[-1] - 1).astype(jnp.int32)
+    vals = jnp.take_along_axis(logits, idx, axis=-1)
+    return jnp.where(allow >= 0, vals, -jnp.inf), idx
+
+
 def sample_greedy(logits: jax.Array, rng: jax.Array | None = None) -> jax.Array:
     """Argmax over the last axis. rng accepted (ignored) for API uniformity."""
     del rng

@@ -1,9 +1,13 @@
 """Continuous-batching serving: slot/paged KV pools + FIFO scheduler +
 mixed prefill/decode engine + radix-tree prefix cache (zero-copy
 refcounted page sharing on the paged pool) + per-request sampling
-(SamplingParams / fused_sample) + latency metrics."""
+(SamplingParams / fused_sample) + grammar-constrained JSON decoding
+(JsonStepper) + OpenAI-compatible HTTP front door (ApiServer) + latency
+metrics."""
 
+from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.grammar import JsonStepper
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
     PagedKVPool,
@@ -16,6 +20,10 @@ from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request
 
 __all__ = [
+    "ApiServer",
+    "EngineLoop",
+    "JsonStepper",
+    "serve_api",
     "ServeConfig",
     "ServeEngine",
     "KVSlotPool",

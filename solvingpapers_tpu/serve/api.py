@@ -1,0 +1,653 @@
+"""OpenAI-compatible HTTP front door over a live `ServeEngine`.
+
+The engine can batch, page, sample and observe, but it is an in-process
+object: this module is the network boundary — the vLLM-shaped serving
+surface ROADMAP item 5 calls for. One stdlib `ThreadingHTTPServer` (the
+`metrics/http.py` daemon-thread pattern — zero dependencies) exposes:
+
+    POST /v1/completions        OpenAI completions, string or token-id
+                                prompts, SSE streaming (`stream: true`)
+    POST /v1/chat/completions   chat messages through a minimal template
+    GET  /v1/models             the one hosted model
+    GET  /healthz /metrics /statusz   the PR-5 inspection surface, on
+                                the SAME port family (one listener to
+                                probe, scrape and debug)
+
+Concurrency model: the engine stays single-threaded. `EngineLoop` owns
+the only thread that calls `engine.step()`, and serializes `submit` /
+`cancel` from HTTP handler threads behind one lock (a submit waits at
+most one decode block). Token flow back out is lock-free: the engine's
+per-request `stream_cb` fires on the engine thread and pushes a COUNT
+into the connection's bounded queue; the handler thread wakes, reads
+the request's token list (append-only — a count-prefix read is safe
+under the GIL), detokenizes the delta and writes the SSE event. A slow
+reader fills its queue and events coalesce (counts, not payloads), so
+no client can block the engine.
+
+Cancellation is disconnect-driven: the SSE writer maps a broken pipe —
+or a half-closed socket, probed between events — to `engine.cancel`,
+freeing the slot at the next block boundary; `timeout_s` maps to
+`submit(deadline_s=)`. Admission pressure maps to HTTP: a full waiting
+queue (or the paged pool's page-budget gate rejecting) answers 503 +
+Retry-After, invalid requests answer 400 with the OpenAI error
+envelope (serve/openai.py) — never a traceback over a socket.
+
+Shutdown ordering (`ApiServer.close`, idempotent): stop accepting new
+work (503), drain active streams up to `drain_timeout_s` then cancel
+the stragglers, stop the engine loop, `engine.close()`, then tear down
+the HTTP threads — so no handler ever touches a closed engine.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import select
+import socket
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+from solvingpapers_tpu.serve import openai as oai
+from solvingpapers_tpu.serve.grammar import JsonStepper
+from solvingpapers_tpu.serve.openai import ApiError
+from solvingpapers_tpu.serve.scheduler import ACTIVE
+
+
+class EngineLoop:
+    """The engine's single driver thread + the submit/cancel gateway.
+
+    Every engine interaction from a handler thread goes through
+    `self.lock`; the loop holds it across each `step()`, so the engine
+    never sees concurrent mutation. Idle (no work) it parks on an event
+    that `submit` sets — no busy-spin, sub-ms wake."""
+
+    def __init__(self, engine, start: bool = True):
+        self.engine = engine
+        self.lock = threading.RLock()
+        self._waiters = 0
+        self._waiter_lock = threading.Lock()  # += is not atomic
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="engine-loop", daemon=True
+        )
+        if start:
+            self._thread.start()
+
+    def _locked(self, fn):
+        """Run an engine call under the step lock, counted as a waiter
+        so the loop hands the lock over instead of convoying."""
+        with self._waiter_lock:
+            self._waiters += 1
+        try:
+            with self.lock:
+                return fn()
+        finally:
+            with self._waiter_lock:
+                self._waiters -= 1
+
+    def submit(self, *args, **kwargs):
+        if self.error is not None:
+            raise RuntimeError(
+                f"engine loop died: {type(self.error).__name__}: "
+                f"{self.error}"
+            )
+        req = self._locked(lambda: self.engine.submit(*args, **kwargs))
+        self._wake.set()
+        return req
+
+    def cancel(self, req) -> None:
+        # lock-free fast path for a live stream: cancelling an ACTIVE
+        # request is ONE flag write the engine reads at the next block
+        # boundary — taking the step lock here would make disconnect
+        # cancel wait out the whole remaining stream (the loop re-wins
+        # its own lock back-to-back; a handler thread parked on it can
+        # starve for seconds — the classic convoy). The flag is written
+        # directly, NOT via engine.cancel: its state re-check could race
+        # a paged-pool preemption (ACTIVE -> WAITING) and run unlocked
+        # queue surgery on this thread; the bare flag is safe in every
+        # state (a preempted-then-resumed stream cancels at its next
+        # block boundary, a finished one ignores it). A request we see
+        # WAITING does need the lock for the queue removal; if it races
+        # the other way (WAITING -> ACTIVE), the locked engine.cancel
+        # re-checks and degrades to the same flag write.
+        if req.state == ACTIVE:
+            req.cancelled = True
+        else:
+            self._locked(lambda: self.engine.cancel(req))
+        self._wake.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    busy = self.engine.has_work()
+                    if busy:
+                        self.engine.step()
+            except BaseException as e:  # noqa: BLE001 — must not die mute
+                self._fail(e)
+                return
+            if self._waiters:
+                # hand the lock over: without an explicit yield this
+                # thread re-acquires it before a parked submitter ever
+                # gets scheduled (lock convoy), and submissions stall
+                # until the engine drains
+                time.sleep(0.001)
+            elif not busy:
+                self._wake.wait(0.05)
+                self._wake.clear()
+
+    def _fail(self, exc: BaseException) -> None:
+        """A step() raised: the engine may be inconsistent, so the loop
+        stops driving it — but silently wedging every open stream would
+        be worse (heartbeats forever, /healthz green). Record the error
+        (new submissions fail fast), then force-finish every in-flight
+        request host-side with reason "error" so each connection gets
+        its terminal event and closes."""
+        import traceback
+
+        self.error = exc
+        traceback.print_exception(type(exc), exc, exc.__traceback__)
+        with self.lock:
+            inflight = [r for r in self.engine._slot_req if r is not None]
+            inflight += list(self.engine.scheduler.queue)
+            now = time.monotonic()
+            for r in inflight:
+                r.state = "finished"
+                r.finish_reason = "error"
+                r.finish_time = now
+                cb = r.stream_cb
+                if cb is not None:
+                    try:
+                        cb(r, 0, True)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def close(self, drain_timeout_s: float = 0.0) -> None:
+        """Stop the loop; with a drain timeout, let in-flight work
+        finish first, then cancel whatever remains so the loop can
+        exit having returned every lane."""
+        if not self._thread.is_alive():
+            return
+        deadline = time.monotonic() + drain_timeout_s
+        while time.monotonic() < deadline:
+            with self.lock:
+                if not self.engine.has_work():
+                    break
+            time.sleep(0.01)
+        with self.lock:
+            for r in list(self.engine._slot_req):
+                if r is not None:
+                    self.engine.cancel(r)
+            for r in list(self.engine.scheduler.queue):
+                self.engine.cancel(r)
+            # one bounded drain pass finishes the cancelled streams;
+            # cancels resolve at the next block boundary
+            steps = 0
+            while self.engine.has_work() and steps < 64:
+                self.engine.step()
+                steps += 1
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+
+class _Stream:
+    """Per-connection bridge from the engine's stream_cb to a handler
+    thread: a bounded queue of (n_new, finished) counts. Full queue =
+    coalesce (the reader catches up from the request's token list);
+    the terminal event always lands (a slot is drained to make room)."""
+
+    def __init__(self, maxsize: int):
+        self.q: queue.Queue = queue.Queue(maxsize=max(2, maxsize))
+
+    def __call__(self, req, n_new: int, finished: bool) -> None:
+        try:
+            self.q.put_nowait((n_new, finished))
+        except queue.Full:
+            if finished:
+                try:
+                    self.q.get_nowait()
+                except queue.Empty:
+                    pass
+                self.q.put_nowait((n_new, finished))
+
+
+class ApiServer:
+    """The front door: binds `engine.config.api_host:api_port` and
+    serves the OpenAI surface + the status endpoints over one listener.
+
+    `decode` (ids -> text) renders streamed text and backs json_object
+    mode's token table; `encode` (text -> ids) admits string prompts —
+    without it only token-id prompts are accepted. `token_table`
+    (id -> string list) skips the per-id decode probe when the caller
+    already built one (`cli serve` does — one source of truth). `loop`
+    lets tests inject an unstarted `EngineLoop`; by default the server
+    owns one.
+    """
+
+    def __init__(self, engine, *, encode=None, decode=None,
+                 token_table=None, model_name: str = "solvingpapers",
+                 loop=None):
+        cfg = engine.config
+        self.engine = engine
+        self.encode = encode
+        self.decode = decode
+        self.model_name = model_name
+        self.loop = loop if loop is not None else EngineLoop(engine)
+        self.closing = threading.Event()
+        self._closed = False
+        self._active = 0          # streams currently open
+        self._counts = {
+            "requests": 0, "streams": 0, "disconnects": 0,
+            "rejected": 0, "client_errors": 0,
+        }
+        self._count_lock = threading.Lock()
+        vocab = getattr(getattr(engine.model, "cfg", None), "vocab_size",
+                        None) or (1 << 31)
+        self.vocab_size = vocab
+        # token table for grammar mode: caller-supplied, or derived by
+        # decoding each id once (None = id outside the detokenizer's
+        # range / unprintable)
+        self.token_table = list(token_table) if token_table else None
+        if self.token_table is None and decode is not None \
+                and vocab < (1 << 20):
+            table = []
+            for i in range(vocab):
+                try:
+                    table.append(decode([i]))
+                except Exception:
+                    table.append(None)
+            self.token_table = table
+        # allowed-set memo shared by every request's stepper: all
+        # steppers run over the one token table, so state-keyed entries
+        # are valid across requests (serve/grammar.py)
+        self._grammar_cache: dict = {}
+        self._grammar_err = None
+        if cfg.json_mode and self.token_table is not None:
+            try:
+                JsonStepper(self.token_table)  # vocabulary viability
+            except ValueError as e:
+                self._grammar_err = str(e)
+        elif cfg.json_mode:
+            self._grammar_err = (
+                "json_object mode needs the server constructed with a "
+                "`decode` callable (token table)"
+            )
+        self.engine.metrics.add_gauge_provider(self._gauges)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.0 close-delimited framing: SSE bodies end when the
+            # connection does, no chunked encoding needed
+            def log_message(self, fmt, *args):  # noqa: A003
+                pass
+
+            def do_GET(self):  # noqa: N802
+                server._get(self)
+
+            def do_POST(self):  # noqa: N802
+                server._post(self)
+
+        self._httpd = ThreadingHTTPServer(
+            (cfg.api_host, cfg.api_port or 0), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.host = cfg.api_host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="api-http", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ plumbing
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def _gauges(self) -> dict:
+        c = self._counts
+        return {
+            "serve/http_connections": float(self._active),
+            "serve/http_requests": float(c["requests"]),
+            "serve/http_streams": float(c["streams"]),
+            "serve/http_disconnects": float(c["disconnects"]),
+            "serve/http_rejected": float(c["rejected"]),
+            "serve/http_client_errors": float(c["client_errors"]),
+        }
+
+    def _bump(self, key: str, delta: int = 1) -> None:
+        with self._count_lock:
+            self._counts[key] += delta
+
+    def _bump_active(self, delta: int) -> None:
+        with self._count_lock:
+            self._active += delta
+
+    @staticmethod
+    def _send(h, code: int, body: str, ctype: str,
+              headers: dict | None = None) -> None:
+        data = body.encode()
+        h.send_response(code)
+        h.send_header("Content-Type", ctype)
+        h.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            h.send_header(k, v)
+        h.end_headers()
+        h.wfile.write(data)
+
+    def _send_json(self, h, code: int, obj: dict,
+                   headers: dict | None = None) -> None:
+        self._send(h, code, json.dumps(obj) + "\n", "application/json",
+                   headers)
+
+    def _send_error(self, h, err: ApiError) -> None:
+        self._bump("rejected" if err.status == 503 else "client_errors")
+        headers = {}
+        if err.status == 503:
+            headers["Retry-After"] = "1"
+        try:
+            self._send_json(h, err.status, err.body(), headers)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ------------------------------------------------------------- routes
+
+    def _get(self, h) -> None:
+        path = h.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                self._send(h, 200, "ok\n", "text/plain")
+            elif path == "/metrics":
+                with self.loop.lock:
+                    step, snap = (self.engine._step_idx,
+                                  self.engine.metrics.snapshot())
+                self._send(h, 200, PrometheusTextWriter.render(step, snap),
+                           "text/plain; version=0.0.4")
+            elif path == "/statusz":
+                with self.loop.lock:
+                    doc = self.engine.statusz()
+                self._send_json(h, 200, doc)
+            elif path == "/v1/models":
+                self._send_json(h, 200, {
+                    "object": "list",
+                    "data": [{"id": self.model_name, "object": "model",
+                              "owned_by": "local"}],
+                })
+            else:
+                self._send(h, 404, "not found\n", "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — a handler must not die
+            try:
+                self._send(h, 500, f"{type(e).__name__}: {e}\n",
+                           "text/plain")
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    def _post(self, h) -> None:
+        path = h.path.split("?", 1)[0]
+        chat = path == "/v1/chat/completions"
+        if not chat and path != "/v1/completions":
+            self._send(h, 404, "not found\n", "text/plain")
+            return
+        self._bump("requests")
+        try:
+            body = self._read_body(h)
+            self._serve_completion(h, body, chat=chat)
+        except ApiError as e:
+            self._send_error(h, e)
+        except (BrokenPipeError, ConnectionResetError):
+            self._bump("disconnects")
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send_json(h, 500, {"error": {
+                    "message": f"{type(e).__name__}: {e}",
+                    "type": "internal_error", "param": None, "code": None,
+                }})
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+    @staticmethod
+    def _read_body(h) -> dict:
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+        except ValueError:
+            n = 0
+        if n <= 0 or n > (8 << 20):
+            raise ApiError("request body required (JSON)", param=None)
+        raw = h.rfile.read(n)
+        try:
+            body = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"request body is not valid JSON: {e.msg}",
+                           param=None) from None
+        if not isinstance(body, dict):
+            raise ApiError("request body must be a JSON object")
+        return body
+
+    # -------------------------------------------------------- completion
+
+    def _serve_completion(self, h, body: dict, chat: bool) -> None:
+        cfg = self.engine.config
+        if self.closing.is_set():
+            raise ApiError("server is shutting down", status=503,
+                           err_type="server_error", code="shutting_down")
+        if self.loop.error is not None:
+            raise ApiError(
+                "engine loop failed — the server needs a restart "
+                f"({type(self.loop.error).__name__})", status=503,
+                err_type="server_error", code="engine_failed",
+            )
+        params, max_tokens, timeout_s = oai.parse_sampling(body)
+        stream = bool(body.get("stream", False))
+        json_mode = oai.wants_json(body, cfg.json_mode)
+        if json_mode and self._grammar_err:
+            raise ApiError(self._grammar_err, param="response_format")
+        if chat:
+            prompt_ids = oai.parse_prompt(
+                {"prompt": oai.chat_prompt(body)}, self.encode,
+                self.vocab_size,
+            )
+        else:
+            prompt_ids = oai.parse_prompt(body, self.encode,
+                                          self.vocab_size)
+        if stream and self._active >= cfg.api_max_connections:
+            raise ApiError(
+                f"too many concurrent streams "
+                f"({cfg.api_max_connections}) — retry shortly",
+                status=503, err_type="server_error", code="overloaded",
+            )
+        if self.engine.scheduler.capacity_left == 0:
+            raise ApiError(
+                "waiting queue is full — retry shortly", status=503,
+                err_type="server_error", code="overloaded",
+            )
+        grammar = (JsonStepper(self.token_table, cache=self._grammar_cache)
+                   if json_mode else None)
+        bridge = _Stream(cfg.stream_queue)
+        try:
+            req = self.loop.submit(
+                np.asarray(prompt_ids, np.int32),
+                max_new_tokens=max_tokens, params=params,
+                deadline_s=timeout_s, grammar=grammar, stream_cb=bridge,
+            )
+        except ValueError as e:
+            code = ("context_length_exceeded"
+                    if "exceeds the engine capacity" in str(e) else None)
+            raise ApiError(str(e), code=code) from None
+        if req.state == "rejected":
+            self._bump("rejected")
+            self._send_json(h, 503, ApiError(
+                "waiting queue is full — retry shortly", status=503,
+                err_type="server_error", code="overloaded",
+            ).body(), {"Retry-After": "1"})
+            return
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        if stream:
+            self._bump("streams")
+            self._stream_response(h, req, bridge, rid, chat)
+        else:
+            self._blocking_response(h, req, bridge, rid, chat)
+
+    def _delta(self, tokens, upto: int, rendered: str) -> tuple[str, str]:
+        """Text delta for tokens[:upto] given what was already rendered.
+        Full re-decode (not per-token) so merge-y detokenizers stay
+        correct; suffix-after-prefix keeps the stream append-only."""
+        if self.decode is None:
+            text = "".join(str(t) + " " for t in tokens[:upto])
+        else:
+            text = self.decode(list(tokens[:upto]))
+        if text.startswith(rendered):
+            return text[len(rendered):], text
+        return text, text  # non-prefix-stable detokenizer: resend
+
+    def _disconnected(self, h) -> bool:
+        """Probe the socket for a client half-close without consuming
+        request data (there is none after the body in this protocol)."""
+        try:
+            r, _, _ = select.select([h.connection], [], [], 0)
+            if r:
+                return h.connection.recv(1, socket.MSG_PEEK) == b""
+        except OSError:
+            return True
+        return False
+
+    def _stream_response(self, h, req, bridge, rid: str,
+                         chat: bool) -> None:
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.end_headers()
+
+        def event(obj) -> None:
+            h.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+            h.wfile.flush()
+
+        self._bump_active(1)
+        emitted = 0
+        rendered = ""
+        try:
+            if chat:
+                event(oai.chat_chunk(rid, self.model_name, None, role=True))
+            while True:
+                try:
+                    _, finished = bridge.q.get(timeout=0.5)
+                except queue.Empty:
+                    if req.done:
+                        finished = True  # cb raced the queue; finish now
+                    elif self._disconnected(h):
+                        self.loop.cancel(req)
+                        self._bump("disconnects")
+                        return
+                    else:
+                        # SSE comment heartbeat: keeps proxies from
+                        # timing the stream out AND surfaces a dead
+                        # socket as a write error between tokens
+                        h.wfile.write(b": ping\n\n")
+                        h.wfile.flush()
+                        continue
+                # probe for a half-closed client BEFORE writing: a FIN
+                # arrives long before a write raises (small SSE events
+                # vanish into the send buffer and tiny models finish a
+                # whole stream before the first EPIPE), and the peek is
+                # two syscalls against a network round trip of tokens
+                if self._disconnected(h):
+                    if not req.done:
+                        self.loop.cancel(req)
+                    self._bump("disconnects")
+                    return
+                upto = len(req.tokens)
+                if upto > emitted:
+                    delta, rendered = self._delta(req.tokens, upto, rendered)
+                    if chat:
+                        event(oai.chat_chunk(rid, self.model_name, delta))
+                    else:
+                        event(oai.completion_chunk(rid, self.model_name,
+                                                   delta))
+                    emitted = upto
+                if finished:
+                    usage = oai.usage_block(req)
+                    if chat:
+                        event(oai.chat_chunk(rid, self.model_name, None,
+                                             reason=req.finish_reason,
+                                             usage=usage))
+                    else:
+                        event(oai.completion_chunk(rid, self.model_name,
+                                                   "",
+                                                   reason=req.finish_reason,
+                                                   usage=usage))
+                    h.wfile.write(b"data: [DONE]\n\n")
+                    h.wfile.flush()
+                    return
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # client went away mid-stream: free the slot at the next
+            # block boundary and count the disconnect
+            if not req.done:
+                self.loop.cancel(req)
+            self._bump("disconnects")
+        finally:
+            self._bump_active(-1)
+
+    def _blocking_response(self, h, req, bridge, rid: str,
+                           chat: bool) -> None:
+        self._bump_active(1)
+        try:
+            while not req.done:
+                try:
+                    _, finished = bridge.q.get(timeout=0.5)
+                    if finished:
+                        break
+                except queue.Empty:
+                    if self._disconnected(h):
+                        self.loop.cancel(req)
+                        self._bump("disconnects")
+                        return
+            if self.decode is not None:
+                text = self.decode(list(req.tokens))
+            else:
+                text = "".join(str(t) + " " for t in req.tokens)
+            if chat:
+                self._send_json(h, 200, oai.chat_response(
+                    rid, self.model_name, req, text))
+            else:
+                self._send_json(h, 200, oai.completion_response(
+                    rid, self.model_name, req, text))
+        finally:
+            self._bump_active(-1)
+
+    # -------------------------------------------------------------- close
+
+    def close(self) -> None:
+        """Graceful shutdown, idempotent: refuse new work, drain active
+        streams (up to `drain_timeout_s`, then cancel), stop the engine
+        loop, close the engine, then the HTTP threads."""
+        if self._closed:
+            return
+        self._closed = True
+        self.closing.set()
+        cfg = self.engine.config
+        deadline = time.monotonic() + cfg.drain_timeout_s
+        while self._active > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        self.loop.close(drain_timeout_s=max(
+            0.0, deadline - time.monotonic()))
+        self.engine.close()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def serve_api(engine, *, encode=None, decode=None,
+              model_name: str = "solvingpapers") -> ApiServer:
+    """Start the front door for `engine` (reads its ServeConfig api_*
+    knobs); returns the running server — call `.close()` to shut the
+    whole stack down in order."""
+    return ApiServer(engine, encode=encode, decode=decode,
+                     model_name=model_name)

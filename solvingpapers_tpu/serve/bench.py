@@ -1012,3 +1012,201 @@ def run_sampling_bench(
             **trace_fields,
         },
     }
+
+
+def _run_http_arm(model, params, extra, requests, serve_cfg, max_new):
+    """The same arrival trace served over the OpenAI HTTP front door:
+    one SSE client thread per request, submitted at its Poisson offset.
+
+    Latency is measured where a real user feels it — at the CLIENT side
+    of the socket: TTFT = first text chunk - arrival, ITL = inter-chunk
+    gaps amortized over the chunk's token count (the server has no
+    tokenizer here, so tokens stream as "id " text and counts fall out
+    of a split). Returns ``(makespan, stats)`` where stats carries
+    per-request ttft/itl samples and the streamed token ids (the
+    token-exactness check against the direct-submit arm)."""
+    import http.client
+    import json as _json
+    import threading
+
+    from solvingpapers_tpu.serve.api import ApiServer
+
+    eng = ServeEngine(model, params, serve_cfg, extra_variables=extra)
+    srv = ApiServer(eng)
+    pending = sorted(requests, key=lambda r: r[0])
+    results: list = [None] * len(pending)
+    t0 = time.monotonic()
+
+    def client(i: int, arrival: float, prompt) -> None:
+        delay = arrival - (time.monotonic() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=600)
+        body = _json.dumps({
+            "prompt": [int(t) for t in prompt], "max_tokens": max_new,
+            "temperature": 0, "stream": True,
+        })
+        conn.request("POST", "/v1/completions", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()[:200]
+        ttft = None
+        last = None
+        gaps: list[float] = []
+        text_parts: list[str] = []
+        reason = None
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[6:]
+            if payload == b"[DONE]":
+                break
+            now = time.monotonic()
+            chunk = _json.loads(payload)
+            choice = chunk["choices"][0]
+            reason = choice["finish_reason"] or reason
+            text = choice["text"]
+            n = len(text.split())
+            if n == 0:
+                continue
+            text_parts.append(text)
+            if ttft is None:
+                ttft = now - (t0 + arrival)
+                n -= 1  # the first token stamps TTFT, not an ITL gap
+            if last is not None and n > 0:
+                gaps.extend([(now - last) / n] * n)
+            last = now
+        conn.close()
+        ids = [int(x) for x in "".join(text_parts).split()]
+        results[i] = {
+            "ttft": ttft, "gaps": gaps, "ids": ids, "reason": reason,
+            "finish": time.monotonic() - t0,
+        }
+
+    threads = [
+        threading.Thread(target=client, args=(i, a, p), daemon=True)
+        for i, (a, p) in enumerate(pending)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    srv.close()
+    assert all(r is not None and r["reason"] for r in results), \
+        "an HTTP stream died or ended without a finish_reason"
+    makespan = max(r["finish"] for r in results) - pending[0][0]
+    return makespan, results
+
+
+def run_http_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    seed: int = 0,
+    reps: int = 2,
+) -> dict:
+    """`cli serve-bench --http`: the concurrent-SSE-connection soak.
+
+    The same Poisson arrival trace runs ABBA-paired through (A) the
+    OpenAI front door — n_requests concurrent SSE clients over real
+    loopback sockets, engine driven by the ApiServer's EngineLoop
+    thread — and (B) direct in-process `engine.submit` + `step()` (the
+    `run_serve_bench` engine arm). `http_overhead_pct` is the full cost
+    of the network path: HTTP parsing, the submit lock, per-block SSE
+    writes, disconnect probes, client-side scheduling jitter. The
+    acceptance budget is <= 10%. Every streamed id sequence is also
+    checked token-exact against the direct arm's handle for the same
+    prompt — the wire must not change the tokens."""
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    serve_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_prompt + max_new,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        # every client streams concurrently: the front door's
+        # per-connection cap must clear the client count or the soak
+        # 503s itself
+        api_max_connections=max(64, n_requests),
+        seed=seed,
+    )
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, _ = _obs_probe(model, params, extra, warm, serve_cfg,
+                                 max_new)
+    _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
+
+    http_mk: list[float] = []
+    direct_mk: list[float] = []
+    http_stats = None
+    direct_handles = None
+    for r in range(reps):
+        order = ("http", "direct") if r % 2 == 0 else ("direct", "http")
+        for arm in order:
+            if arm == "http":
+                mk, http_stats = _run_http_arm(
+                    model, params, extra, requests, serve_cfg, max_new
+                )
+                http_mk.append(mk)
+            else:
+                _, direct_handles, mk = _run_engine_arm(
+                    model, params, extra, requests, serve_cfg, max_new
+                )
+                direct_mk.append(mk)
+    # the wire must not change the tokens: every streamed id sequence
+    # matches the direct arm's handle for the same prompt (both arms
+    # process the arrival-sorted trace, so indexes align)
+    exact = all(
+        http_stats[j]["ids"] == direct_handles[j].tokens
+        for j in range(len(requests))
+    )
+    http_rps = n_requests / (sum(http_mk) / len(http_mk))
+    direct_rps = n_requests / (sum(direct_mk) / len(direct_mk))
+    ttfts = [r["ttft"] for r in http_stats]
+    gaps = [g for r in http_stats for g in r["gaps"]]
+    return {
+        "metric": "serve_http_stream_requests_per_sec",
+        "value": round(http_rps, 2),
+        "unit": "req/s",
+        # ~1.0 = the front door is free; the acceptance budget is >= 0.9
+        "vs_baseline": round(http_rps / direct_rps, 3),
+        "detail": {
+            "config": config,
+            "workload": "http-stream-soak",
+            "n_requests": n_requests,
+            "n_clients": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "reps": reps,
+            "http_requests_per_sec": round(http_rps, 2),
+            "direct_requests_per_sec": round(direct_rps, 2),
+            "http_overhead_pct": round(
+                (1.0 - http_rps / direct_rps) * 100.0, 2
+            ),
+            "http_mean_ttft_s": round(float(np.mean(ttfts)), 4),
+            "http_ttft_p95_s": round(float(np.percentile(ttfts, 95)), 4),
+            "http_itl_p99_s": round(float(np.percentile(gaps, 99)), 5)
+            if gaps else None,
+            "stream_token_exact": bool(exact),
+            **probe_fields,
+        },
+    }

@@ -101,6 +101,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from solvingpapers_tpu.serve import metrics as smetrics
+from solvingpapers_tpu.serve.grammar import encode_allow
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
     PagedKVPool,
@@ -266,6 +267,41 @@ class ServeConfig:
     # engine.status.port); None = no server. Close with engine.close().
     status_port: int | None = None
     status_host: str = "127.0.0.1"
+    # OpenAI-compatible HTTP front door (serve/api.py — started by `cli
+    # serve` or `serve.api.ApiServer`, NEVER by the engine itself: the
+    # API server owns the step-loop thread and the shutdown ordering).
+    # Knobs live here so ONE config object describes a serving process:
+    #   api_port    port for /v1/completions + /v1/chat/completions
+    #               (plus /healthz /metrics /statusz on the same
+    #               listener); 0 = ephemeral, published as
+    #               ApiServer.port
+    #   api_host    bind address (loopback by default — an inspection/
+    #               demo surface; front with a real proxy to expose it)
+    #   api_max_connections  concurrent streaming connections before
+    #               the front door answers 503 (per-connection
+    #               backpressure AHEAD of the scheduler's bounded
+    #               waiting queue, which 503s the overflow after it)
+    #   json_mode   accept `response_format {"type": "json_object"}`:
+    #               grammar-constrained decoding via the (S, sample_cap)
+    #               allow-mask (serve/grammar.py); constrained slots
+    #               share the one compiled decode program but advance
+    #               ONE token per decode block (the mask rides the
+    #               per-call control transfer and is stale after the
+    #               first draw), so JSON-mode throughput is ~1/block of
+    #               unconstrained — size decode_block accordingly
+    #   stream_queue  per-connection pending stream events before
+    #               coalescing (events carry counts, not payloads — a
+    #               slow SSE reader never blocks the engine thread)
+    #   drain_timeout_s  ApiServer.close(): seconds to wait for active
+    #               streams to finish before cancelling them (shutdown
+    #               order: drain streams -> engine.close() -> HTTP
+    #               threads)
+    api_port: int | None = None
+    api_host: str = "127.0.0.1"
+    api_max_connections: int = 64
+    json_mode: bool = True
+    stream_queue: int = 256
+    drain_timeout_s: float = 10.0
 
 
 _UNSET = object()
@@ -312,11 +348,13 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
     """Prefill one request into lane `ctl[0]` and sample its first token.
 
     `prompt` is (padded,) right-padded; `ctl = [slot, length, step,
-    top_k, seed, need_lp]` is the host's packed int control word (one
-    transfer instead of six — the host loop's dispatch overhead is the
-    serving bottleneck on small models, see tools/bench_serve.py), where
-    `length` is the real token count, so one compiled program serves
-    every prompt in the bucket. `samp = [temperature, top_p, min_p]` is
+    top_k, seed, need_lp, *allow_row]` is the host's packed int control
+    word (one transfer instead of many — the host loop's dispatch
+    overhead is the serving bottleneck on small models, see
+    tools/bench_serve.py), where `length` is the real token count, so
+    one compiled program serves every prompt in the bucket.
+    `allow_row` is the (cap,) grammar allow-list for the FIRST sampled
+    token (-1-padded; all -1 = unconstrained — see serve/grammar.py). `samp = [temperature, top_p, min_p]` is
     the float half of the request's SamplingParams — every sampling knob
     is a traced operand, so the compiled inventory is untouched by the
     param mix (`cap` = ServeConfig.sample_cap is static but fixed per
@@ -345,7 +383,8 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
     )
     key = request_key(rng, step_tag=ctl[2], slot=slot, seed=ctl[4],
                       samp_idx=jnp.int32(0))
-    first, logprob = fused_sample(last[None], packed, key[None], cap=cap)
+    first, logprob = fused_sample(last[None], packed, key[None], cap=cap,
+                                  allow=ctl[6:6 + cap][None, :])
     return store_lane(caches, lane, slot), first[0], logprob[0]
 
 
@@ -360,9 +399,10 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     the lane is a GATHERED view of the physical page pool and only the
     pages the prefill may have written go back.
 
-    `ctl = [slot, length, step, top_k, seed, need_lp, *page_table_row]`
-    — the slot's (pages_per_lane,) page-table row rides the same packed
-    int control transfer as the sampling knobs, so logical->physical
+    `ctl = [slot, length, step, top_k, seed, need_lp, *allow_row,
+    *page_table_row]` — the slot's (pages_per_lane,) page-table row
+    rides the same packed int control transfer as the sampling knobs
+    and the (cap,) grammar allow-list, so logical->physical
     translation costs zero extra host->device transfers and the
     compiled-program inventory keys on exactly the lane pool's
     `(padded, chunk, start)` triple. On a prefix hit, pages
@@ -371,7 +411,7 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     so shared pages are read, never written — the zero-device-copy hit
     the refcount design exists for."""
     slot, length = ctl[0], ctl[1]
-    row = ctl[6:]
+    row = ctl[6 + cap:]
     lane = gather_lane(phys, row)
     lane, last = _prefill_lane(model, padded, chunk, start, variables,
                                lane, prompt, length)
@@ -381,7 +421,8 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     )
     key = request_key(rng, step_tag=ctl[2], slot=slot, seed=ctl[4],
                       samp_idx=jnp.int32(0))
-    first, logprob = fused_sample(last[None], packed, key[None], cap=cap)
+    first, logprob = fused_sample(last[None], packed, key[None], cap=cap,
+                                  allow=ctl[6:6 + cap][None, :])
     page = jax.tree_util.tree_leaves(phys)[0].shape[1]
     phys = scatter_lane_pages(phys, lane, row, start // page)
     return phys, first[0], logprob[0]
@@ -395,13 +436,16 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
 def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     """Advance every slot `block` tokens; inactive slots run masked.
 
-    `state` is the host's packed (9, n_slots) int32 control block — rows
-    [toks, pos, active, eos, step, top_k, seed, samp_idx, need_lp] — and
-    `samp` the packed (3, n_slots) float32 half of every slot's
-    SamplingParams (rows [temperature, top_p, min_p]), so each call
-    costs two host->device transfers regardless of slot count or param
-    mix; the host keeps numpy mirrors and only the emitted streams come
-    back. Every sampling knob is traced, so the compiled decode program
+    `state` is the host's packed (9 + cap, n_slots) int32 control block
+    — rows [toks, pos, active, eos, step, top_k, seed, samp_idx,
+    need_lp] then the transposed (cap, S) grammar allow-lists (all -1 =
+    unconstrained; a constrained slot samples only listed ids and the
+    HOST accepts one token per block, the mask being stale after the
+    first draw — see serve/grammar.py) — and `samp` the packed
+    (3, n_slots) float32 half of every slot's SamplingParams (rows
+    [temperature, top_p, min_p]), so each call costs two host->device
+    transfers regardless of slot count or param mix; the host keeps
+    numpy mirrors and only the emitted streams come back. Every sampling knob is traced, so the compiled decode program
     count is identical to the static-greedy engine's (`cap` =
     ServeConfig.sample_cap is static but fixed per engine). `rng` is the
     engine's base key (a constant buffer); per-slot keys fold in the
@@ -421,6 +465,7 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     toks, pos = state[0], state[1]
     active, eos = state[2].astype(bool), state[3]
     step_tag, seeds = state[4, 0], state[6]
+    allow = state[9:9 + cap].T  # (S, cap)
     packed = PackedSampling(
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
         need_lp=state[8],
@@ -440,7 +485,8 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         toks, pos, samp_idx, caches = carry
         logits, caches = jax.vmap(one)(toks, pos, caches)
         keys = slot_keys(rng, step_tag, seeds, samp_idx)
-        nxt, logprob = fused_sample(logits, packed, keys, cap=cap)
+        nxt, logprob = fused_sample(logits, packed, keys, cap=cap,
+                                    allow=allow)
         nxt = nxt.astype(toks.dtype)
         hit_eos = (eos >= 0) & (toks == eos)
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
@@ -465,9 +511,10 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     physical page pool.
 
     `state` is the packed int block grown by the page tables: rows
-    [0, 9) are exactly the lane program's control rows, rows [9, 9 +
-    pages_per_lane) carry `table.T` — per-call page tables ride the ONE
-    existing control transfer, so a paged decode call still costs two
+    [0, 9 + cap) are exactly the lane program's control rows (incl. the
+    grammar allow-lists), rows [9 + cap, 9 + cap + pages_per_lane)
+    carry `table.T` — per-call page tables ride the ONE existing
+    control transfer, so a paged decode call still costs two
     host->device transfers total.
 
     Translation is hoisted OUT of the scan: every slot's logical lane
@@ -490,7 +537,8 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     toks, pos = state[0], state[1]
     active, eos = state[2].astype(bool), state[3]
     step_tag, seeds = state[4, 0], state[6]
-    table = state[9:].T  # (S, pages_per_lane)
+    allow = state[9:9 + cap].T  # (S, cap)
+    table = state[9 + cap:].T  # (S, pages_per_lane)
     pos0 = pos
     packed = PackedSampling(
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
@@ -512,7 +560,8 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
         toks, pos, samp_idx, lanes = carry
         logits, lanes = jax.vmap(one)(toks, pos, lanes)
         keys = slot_keys(rng, step_tag, seeds, samp_idx)
-        nxt, logprob = fused_sample(logits, packed, keys, cap=cap)
+        nxt, logprob = fused_sample(logits, packed, keys, cap=cap,
+                                    allow=allow)
         nxt = nxt.astype(toks.dtype)
         hit_eos = (eos >= 0) & (toks == eos)
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
@@ -714,6 +763,10 @@ class ServeEngine:
         self._samp_f = np.tile(
             np.asarray(GREEDY_ROW, np.float32)[:, None], (1, cfg.n_slots)
         )
+        # grammar allow-lists, slot-major (-1 = unconstrained): refreshed
+        # from each constrained request's stepper before every program
+        # call, riding the packed int control transfers
+        self._allow = np.full((cfg.n_slots, cfg.sample_cap), -1, np.int32)
         self._top_k = np.zeros(cfg.n_slots, np.int32)
         self._seed = np.full(cfg.n_slots, -1, np.int32)
         self._need_lp = np.zeros(cfg.n_slots, np.int32)
@@ -748,6 +801,8 @@ class ServeEngine:
         eos_id=_UNSET,
         params: SamplingParams | None = None,
         deadline_s: float | None = None,
+        grammar=None,
+        stream_cb=None,
     ) -> Request:
         """Enqueue one request; returns its live handle immediately.
 
@@ -757,10 +812,23 @@ class ServeEngine:
         decoding `deadline_s` seconds after submit finishes "timeout" at
         the next scheduler iteration / block boundary.
 
+        `grammar` constrains decoding to a formal grammar (one
+        `serve.grammar.JsonStepper` per request — it is stateful): every
+        draw is restricted to the stepper's allowed-token list via the
+        traced allow-mask, and the stream finishes ("stop") when the
+        grammar accepts a complete document. EOS is not meaningful
+        mid-document, so a grammar request must not also carry an
+        `eos_id` (the engine default is ignored; an explicit one
+        raises). `stream_cb(request, n_new, finished)` is called on the
+        engine thread after every token append and at finish — the
+        HTTP front door's streaming hook (see `Request.stream_cb`).
+
         Bad inputs raise `ValueError` HERE, host-side — never inside a
         traced program: non-integer or non-1-D prompts, empty prompts,
         budgets < 1, prompts beyond the engine capacity, non-positive
-        deadlines, and stop strings without a `detokenize` callable.
+        deadlines, stop strings without a `detokenize` callable, a
+        grammar alongside an explicit eos_id, and a budget too small
+        for the grammar's shortest complete document.
         """
         arr = np.asarray(prompt)
         # size first: np.asarray([]) defaults to float64, and leading with
@@ -807,11 +875,28 @@ class ServeEngine:
                 f"= {total} exceeds the engine capacity {cap} "
                 "(min of ServeConfig.max_len and the model's max positions)"
             )
+        if grammar is not None:
+            if eos_id is not _UNSET and eos_id is not None:
+                raise ValueError(
+                    "a grammar-constrained request cannot carry an eos_id: "
+                    "EOS is only legal at a complete document, where the "
+                    "grammar finishes the stream itself"
+                )
+            eos_id = None  # the engine default must not leak in either
+            min_close = getattr(grammar, "min_close", 0)
+            if max_new_tokens < min_close:
+                raise ValueError(
+                    f"max_new_tokens {max_new_tokens} cannot complete the "
+                    f"grammar's shortest document ({min_close} tokens) — "
+                    "the constrained stream would be cut mid-structure"
+                )
         req = Request(
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             eos_id=self.config.eos_id if eos_id is _UNSET else eos_id,
             params=params,
+            grammar=grammar,
+            stream_cb=stream_cb,
         )
         if deadline_s is not None:
             req.deadline = req.submit_time + deadline_s
@@ -1020,6 +1105,30 @@ class ServeEngine:
         cap = min(self.config.max_len, limit or self.config.max_len) - start
         return max(length, min(padded, cap))
 
+    def _notify(self, req: Request, n_new: int) -> None:
+        """Fire the request's streaming hook (engine thread): `n_new`
+        tokens were appended (0 for a tokenless finish boundary —
+        cancel/timeout), `finished` mirrors the lifecycle state."""
+        cb = req.stream_cb
+        if cb is not None:
+            cb(req, n_new, req.state == FINISHED)
+
+    def _grammar_allow(self, req: Request) -> np.ndarray:
+        """The request's current allowed-token list packed into a
+        (sample_cap,) allow row. The grammar contract says the list is
+        never empty before the document completes (and a completed
+        document finishes the request immediately), so emptiness here
+        is a stepper bug — failing loudly beats silently decoding
+        unconstrained."""
+        ids = req.grammar.allowed(req.remaining)
+        if not ids:
+            raise RuntimeError(
+                f"grammar for request {req.id} returned an empty "
+                f"allow-list mid-generation (budget {req.remaining}) — "
+                "the mask-never-empty contract is broken"
+            )
+        return encode_allow(ids, self.config.sample_cap)
+
     def _match_len(self, prompt: np.ndarray) -> int:
         """Cached page-aligned prefix length for `prompt` (read-only; the
         scheduler's admission lookup). Capped at len-1: the suffix prefill
@@ -1150,6 +1259,7 @@ class ServeEngine:
         self._toks[slot] = 0
         self._pos[slot] = 0
         self._samp_f[:, slot] = GREEDY_ROW
+        self._allow[slot] = -1
         self._top_k[slot] = 0
         self._seed[slot] = -1
         self._need_lp[slot] = 0
@@ -1303,11 +1413,18 @@ class ServeEngine:
         head = np.asarray(
             [slot, suffix, self._rng_step, top_k, seed, need_lp], np.int32
         )
+        # grammar allow-list for the FIRST sampled token (resumed
+        # requests discard that sample, but the mask must still be
+        # well-formed); free/unconstrained lanes rest at -1
+        self._allow[slot] = (self._grammar_allow(req)
+                             if req.grammar is not None else -1)
         # the paged program reads the slot's page-table row off the SAME
-        # packed int transfer (logical->physical translation with zero
-        # extra host->device traffic)
-        ctl = (np.concatenate([head, self.pool.table[slot]])
-               if self._paged else head)
+        # packed int transfer as the allow-list (logical->physical
+        # translation with zero extra host->device traffic)
+        ctl = np.concatenate(
+            [head, self._allow[slot]]
+            + ([self.pool.table[slot]] if self._paged else [])
+        )
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
         prog = _paged_prefill_program if self._paged else _prefill_program
@@ -1389,6 +1506,8 @@ class ServeEngine:
             return False
         req.first_token_time = now
         req.tokens.append(first)
+        if req.grammar is not None:
+            req.grammar.advance(first)
         if req.params.logprobs:
             req.logprobs.append(float(logprob))
         self.metrics.record_first_token(req, now, prefilled=suffix)
@@ -1409,11 +1528,15 @@ class ServeEngine:
         self._pos[slot] = length
         self._slot_req[slot] = req
         reason = self._stop_reason(req, first)
+        if req.grammar is not None and req.grammar.done:
+            reason = "stop"  # complete document beats a length finish
         if reason != "eos" and self._stop_string_at(req, 0) is not None:
             reason = "stop"  # the first token alone completed a match
         if reason is None:
+            self._notify(req, 1)
             return False
         self._finish(req, reason, now)
+        self._notify(req, 1)
         return True
 
     def _stop_reason(self, req: Request, tok: int) -> str | None:
@@ -1464,7 +1587,8 @@ class ServeEngine:
             self._cover_decode(block)
             if self.pool.n_active == 0:
                 return []  # exhaustion preempted every stream this block
-        rows = 9 + (self.pool.pages_per_lane if self._paged else 0)
+        acap = cfg.sample_cap
+        rows = 9 + acap + (self.pool.pages_per_lane if self._paged else 0)
         state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
@@ -1477,14 +1601,20 @@ class ServeEngine:
                 # sample index of this block's first draw: the request
                 # has emitted len(tokens) so far (index 0 was prefill's)
                 state[7, slot] = len(r.tokens)
+                if r.grammar is not None:
+                    # the stepper advanced with last block's accepted
+                    # token: refresh this slot's allow-list (only the
+                    # FIRST draw of the block is accepted — see below)
+                    self._allow[slot] = self._grammar_allow(r)
         state[4] = self._rng_step
         state[5] = self._top_k
         state[6] = self._seed
         state[8] = self._need_lp
+        state[9:9 + acap] = self._allow.T
         if self._paged:
             # the page tables ride the SAME packed transfer: still two
             # host->device control arrays per decode call
-            state[9:] = self.pool.table.T
+            state[9 + acap:] = self.pool.table.T
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
@@ -1536,20 +1666,32 @@ class ServeEngine:
                 # output is discarded, the lane frees for the next pick
                 self._finish(req, "cancelled", now)
                 finished.append(req)
+                self._notify(req, 0)
                 continue
             if req.deadline is not None and now >= req.deadline:
                 self._finish(req, "timeout", now)
                 finished.append(req)
+                self._notify(req, 0)
                 continue
             appended = 0
             reason = None
             base = len(req.tokens)
-            for t, lp in zip(out[:, slot], lps[:, slot]):
+            # a grammar-constrained slot accepts only the block's FIRST
+            # draw: the allow-mask rode this call's control transfer and
+            # is stale after one advance — the tail is discarded exactly
+            # like post-EOS overshoot (stale writes in the slot's own
+            # lane are overwritten before they are ever attended)
+            span = 1 if req.grammar is not None else block
+            for t, lp in zip(out[:span, slot], lps[:span, slot]):
                 req.tokens.append(int(t))
+                if req.grammar is not None:
+                    req.grammar.advance(int(t))
                 if req.params.logprobs:
                     req.logprobs.append(float(lp))
                 appended += 1
                 reason = self._stop_reason(req, int(t))
+                if req.grammar is not None and req.grammar.done:
+                    reason = "stop"  # complete document ends the stream
                 if reason is not None:
                     break  # the tail of the block is discarded overshoot
             k = self._stop_string_at(req, base)
@@ -1573,10 +1715,17 @@ class ServeEngine:
             if reason is not None:
                 self._finish(req, reason, now)
                 finished.append(req)
+            elif req.grammar is not None:
+                # the mirror advances by the ONE accepted token; the
+                # device's remaining writes land beyond the mirror
+                # position and are overwritten by the next block
+                self._toks[slot] = out[0, slot]
+                self._pos[slot] += 1
             else:
                 # mirror the device carry: the slot ran the full block
                 self._toks[slot] = out[-1, slot]
                 self._pos[slot] += block
+            self._notify(req, appended)
         return finished
 
     def _finish(self, req: Request, reason: str, now: float) -> None:
@@ -1601,11 +1750,12 @@ class ServeEngine:
         self._slot_req[slot] = None
         # park the idle lane at position 0 with greedy sampling rows: the
         # masked dummy writes land in slot 0 (overwritten by the next
-        # prefill), and an all-greedy resting state keeps idle batches on
-        # fused_sample's sort-free fast path
+        # prefill), and an all-greedy, unconstrained resting state keeps
+        # idle batches on fused_sample's sort-free fast path
         self._toks[slot] = 0
         self._pos[slot] = 0
         self._samp_f[:, slot] = GREEDY_ROW
+        self._allow[slot] = -1
         self._top_k[slot] = 0
         self._seed[slot] = -1
         self._need_lp[slot] = 0
@@ -1643,3 +1793,4 @@ class ServeEngine:
                                ts=now, reason=reason)
             if self._mon is not None:
                 self._mon.observe_finish(reason)
+        self._notify(req, 0)

@@ -41,6 +41,19 @@ prefix cache is on):
     serve/prefix_evictions         LRU leaf evictions so far
     serve/prefix_hbm_bytes         device bytes the radix tree holds now
 
+HTTP front-door gauges (serve/api.py; present iff an `ApiServer` is
+attached to the engine — it registers a gauge provider, the same
+mechanism as the paged-pool and observatory gauges):
+
+    serve/http_connections     streams currently open (SSE + blocking)
+    serve/http_requests        completion requests received (cumulative)
+    serve/http_streams         SSE streams started
+    serve/http_disconnects     clients that dropped mid-stream (each one
+                               maps to engine.cancel — pair with
+                               serve/finish_cancelled)
+    serve/http_rejected        503s (queue full / too many streams)
+    serve/http_client_errors   400s (validation failures)
+
 Compile & memory observatory gauges (metrics/xla_obs.py; present iff
 `ServeConfig.xla_obs` is on, via `add_gauge_provider`):
 

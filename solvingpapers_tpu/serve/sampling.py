@@ -236,7 +236,8 @@ def slot_keys(base, step_tag, seeds, samp_idx):
     )(slots, seeds, samp_idx)
 
 
-def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64):
+def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64,
+                 allow=None):
     """Sample one token per slot under per-slot params; returns
     ``(tokens (S,) i32, logprobs (S,) f32)``.
 
@@ -251,13 +252,25 @@ def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64):
     returned logprob is the log-softmax of the RAW full-vocab logits at
     the chosen token, or 0 where `need_lp` is unset.
 
-    Runtime `lax.cond` fast paths: an all-greedy batch runs argmax only
-    (no selection, no masking — the cost of the old static greedy
-    sampler), and the full-vocab log-softmax runs only when some slot
-    wants logprobs. Full-vocab sorts would be correct but are ~100x the
-    model forward on XLA:CPU inside the decode scan — the cap is what
-    makes a mixed batch affordable (see the module docstring for the
-    semantics of the truncation).
+    `allow` is the optional grammar constraint: (S, cap) int32 token ids
+    with -1 padding (`serve/grammar.py`), a TRACED operand riding the
+    engine's packed control transfers. A row whose first entry is >= 0
+    is constrained: its candidate domain becomes the allowed ids
+    themselves (`ops.allowed_logits` — the same (values, indices) shape
+    `lax.top_k` yields, so every truncation mask and the categorical
+    draw apply unchanged), and a greedy constrained row takes argmax
+    over that domain instead of the raw vocab. All-(-1) rows (every
+    unconstrained slot) are untouched — a mixed constrained/plain batch
+    shares this one compiled program.
+
+    Runtime `lax.cond` fast paths: an all-greedy batch with no
+    constrained row runs argmax only (no selection, no masking — the
+    cost of the old static greedy sampler), and the full-vocab
+    log-softmax runs only when some slot wants logprobs. Full-vocab
+    sorts would be correct but are ~100x the model forward on XLA:CPU
+    inside the decode scan — the cap is what makes a mixed batch
+    affordable (see the module docstring for the semantics of the
+    truncation).
     """
     cap = min(cap, logits.shape[-1])
     greedy = packed.temperature <= 0.0
@@ -265,12 +278,33 @@ def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64):
     # scalar-emulated on XLA:CPU (a bf16 top_k here measured ~27x the f32
     # one — slower than the whole model forward)
     logits32 = logits.astype(jnp.float32)
+    if allow is not None:
+        # reconcile widths: the engine packs ServeConfig.sample_cap
+        # entries, the effective cap may have clamped to a smaller
+        # vocab. Truncation is lossless — allowed ids are distinct and
+        # < vocab, so past index `cap` only -1 padding can remain.
+        if allow.shape[-1] > cap:
+            allow = allow[:, :cap]
+        elif allow.shape[-1] < cap:
+            allow = jnp.pad(allow, ((0, 0), (0, cap - allow.shape[-1])),
+                            constant_values=-1)
+        constrained = allow[:, 0] >= 0
 
     def _all_greedy():
         return jnp.argmax(logits32, axis=-1).astype(jnp.int32)
 
     def _mixed():
         top_vals, top_idx = jax.lax.top_k(logits32, cap)  # sorted desc
+        greedy_tok = _all_greedy()
+        if allow is not None:
+            a_vals, a_idx = ops.allowed_logits(logits32, allow)
+            top_vals = jnp.where(constrained[:, None], a_vals, top_vals)
+            top_idx = jnp.where(constrained[:, None], a_idx, top_idx)
+            # greedy under a constraint = argmax over the allowed domain
+            dom = jnp.take_along_axis(
+                top_idx, jnp.argmax(top_vals, axis=-1)[:, None], axis=-1
+            )[:, 0]
+            greedy_tok = jnp.where(constrained, dom, greedy_tok)
         temp = jnp.where(greedy, 1.0, packed.temperature)[:, None]
         scaled = top_vals / temp
         masked = ops.top_k_mask(scaled, packed.top_k[:, None])
@@ -280,9 +314,12 @@ def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64):
             lambda row, key: jax.random.categorical(key, row)
         )(masked, rngs)
         drawn = jnp.take_along_axis(top_idx, sel[:, None], axis=-1)[:, 0]
-        return jnp.where(greedy, _all_greedy(), drawn.astype(jnp.int32))
+        return jnp.where(greedy, greedy_tok, drawn.astype(jnp.int32))
 
-    toks = jax.lax.cond(jnp.all(greedy), _all_greedy, _mixed)
+    fast = jnp.all(greedy)
+    if allow is not None:
+        fast = fast & ~jnp.any(constrained)
+    toks = jax.lax.cond(fast, _all_greedy, _mixed)
 
     def _logprobs():
         chosen = jnp.take_along_axis(logits32, toks[:, None], axis=-1)[:, 0]

@@ -92,6 +92,19 @@ class Request:
     # set by engine.cancel on an ACTIVE request; the lane is freed (and
     # the request finished "cancelled") at the next block boundary
     cancelled: bool = False
+    # grammar-constrained decoding (serve/grammar.py JsonStepper or any
+    # object with allowed(budget)/advance(tok)/done): the engine packs
+    # its allowed-token list into the jitted programs' allow-mask and
+    # finishes the stream ("stop") when the grammar accepts. One stepper
+    # per request — it is stateful and advances with the stream.
+    grammar: object | None = None
+    # streaming hook: called on the ENGINE thread as
+    # ``stream_cb(request, n_new_tokens, finished)`` after every token
+    # append and once at finish (n_new may be 0 for a cancel/timeout
+    # boundary). Must be cheap and non-blocking — the HTTP front door
+    # (serve/api.py) pushes a count into a bounded per-connection queue
+    # and does all I/O on its own handler thread.
+    stream_cb: object | None = None
     # memoized cached-prefix match length for prefix-aware scheduling:
     # computed once at first pick() (a per-request tree walk per iteration
     # would burden the dispatch-bound host loop). Slightly stale by design
@@ -146,6 +159,14 @@ class FIFOScheduler:
 
     def __len__(self) -> int:
         return len(self.queue)
+
+    @property
+    def capacity_left(self) -> int:
+        """Waiting-queue room before `submit` starts rejecting — the
+        HTTP front door's cheap backpressure probe (serve/api.py sizes
+        its 503 Retry-After hint from queue pressure without burning a
+        submission on a request it knows will bounce)."""
+        return max(0, self.max_waiting - len(self.queue))
 
     def submit(self, req: Request) -> bool:
         """Enqueue, or reject when the waiting queue is at capacity."""

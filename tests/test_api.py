@@ -1,0 +1,369 @@
+"""OpenAI-compatible HTTP front door (serve/api.py + serve/openai.py).
+
+The serving contract over a REAL socket: an SSE stream is token-exact
+vs direct `engine.submit` for the same prompt/params, client
+disconnects cancel the request and free its slot (and, on the paged
+pool, every page) within a block boundary, validation failures are
+structured 400s in the OpenAI error envelope, admission pressure is a
+503 with Retry-After, and shutdown is ordered and idempotent.
+"""
+
+import json
+import socket
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve import (
+    ApiServer,
+    EngineLoop,
+    ServeConfig,
+    ServeEngine,
+)
+
+ALPHABET = '{}[]":,-.0123456789 \nabcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOP\\'
+TABLE = list(ALPHABET[:64])
+STOI = {c: i for i, c in enumerate(TABLE)}
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=128, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+
+
+def _encode(s):
+    return [STOI[c] for c in s]
+
+
+def _decode(ids):
+    return "".join(TABLE[int(i)] for i in ids)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    model = GPT(GPT_TINY)
+    rng = jax.random.key(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def server(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=4, max_len=128, decode_block=4, bucket=8, api_port=0,
+    ), detokenize=_decode)
+    srv = ApiServer(eng, encode=_encode, decode=_decode,
+                    model_name="gpt-tiny")
+    yield srv, eng
+    srv.close()
+
+
+def _post(srv, path, body, timeout=120):
+    req = urllib.request.Request(
+        srv.url(path), data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _stream_events(srv, body, timeout=120):
+    """POST with stream=true over a raw socket; returns parsed SSE
+    events (the trailing '[DONE]' sentinel included as a string)."""
+    payload = json.dumps({**body, "stream": True}).encode()
+    s = socket.create_connection((srv.host, srv.port), timeout=timeout)
+    s.sendall(
+        b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+        b"Content-Type: application/json\r\nContent-Length: "
+        + str(len(payload)).encode() + b"\r\n\r\n" + payload
+    )
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(4096)
+    head, buf = buf.split(b"\r\n\r\n", 1)
+    assert b"200" in head.split(b"\r\n")[0], head
+    events = []
+    while True:
+        while b"\n\n" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                s.close()
+                return events
+            buf += chunk
+        frame, buf = buf.split(b"\n\n", 1)
+        frame = frame.strip()
+        if not frame.startswith(b"data: "):
+            continue  # heartbeat comments
+        payload = frame[6:]
+        if payload == b"[DONE]":
+            s.close()
+            events.append("DONE")
+            return events
+        events.append(json.loads(payload))
+
+
+# ------------------------------------------------------------- happy path
+
+
+def test_stream_token_exact_vs_direct_submit(server):
+    """Acceptance: the SSE stream carries exactly the tokens
+    `engine.submit` produces for the same prompt/params."""
+    srv, eng = server
+    prompt = list(range(20, 28))
+    events = _stream_events(srv, {
+        "prompt": prompt, "max_tokens": 12, "temperature": 0,
+    })
+    assert events[-1] == "DONE"
+    chunks = [e for e in events if e != "DONE"]
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    terminal = [c for c in chunks if c["choices"][0]["finish_reason"]]
+    assert terminal and terminal[-1]["choices"][0]["finish_reason"] == "length"
+    assert terminal[-1]["usage"]["completion_tokens"] == 12
+
+    ref = srv.loop.submit(np.asarray(prompt, np.int32), max_new_tokens=12)
+    deadline = time.monotonic() + 60
+    while not ref.done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert ref.done
+    assert text == _decode(ref.tokens)
+
+
+def test_nonstreaming_completion_shape(server):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(10, 16)), "max_tokens": 8, "temperature": 0,
+    })
+    assert st == 200
+    assert doc["object"] == "text_completion"
+    choice = doc["choices"][0]
+    assert choice["finish_reason"] == "length"
+    assert doc["usage"] == {"prompt_tokens": 6, "completion_tokens": 8,
+                            "total_tokens": 14}
+    # same prompt, same params -> same greedy text (served twice)
+    st2, _, doc2 = _post(srv, "/v1/completions", {
+        "prompt": list(range(10, 16)), "max_tokens": 8, "temperature": 0,
+    })
+    assert doc2["choices"][0]["text"] == choice["text"]
+
+
+def test_string_prompt_and_stop_strings(server):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": "abcd", "max_tokens": 16, "temperature": 0,
+    })
+    assert st == 200 and len(doc["choices"][0]["text"]) == 16
+    gen = doc["choices"][0]["text"]
+    stop = gen[2:4]  # a substring the greedy stream will emit
+    st, _, doc2 = _post(srv, "/v1/completions", {
+        "prompt": "abcd", "max_tokens": 16, "temperature": 0,
+        "stop": stop,
+    })
+    assert st == 200
+    assert doc2["choices"][0]["finish_reason"] == "stop"
+    assert doc2["choices"][0]["text"].endswith(stop)
+
+
+def test_chat_completion_shape(server):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "abc"}],
+        "max_tokens": 6, "temperature": 0,
+    })
+    assert st == 200
+    assert doc["object"] == "chat.completion"
+    msg = doc["choices"][0]["message"]
+    assert msg["role"] == "assistant" and len(msg["content"]) == 6
+
+
+def test_json_mode_parses(server):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(5, 10)), "max_tokens": 24, "temperature": 0,
+        "response_format": {"type": "json_object"},
+    })
+    assert st == 200
+    assert doc["choices"][0]["finish_reason"] == "stop"
+    json.loads(doc["choices"][0]["text"])
+
+
+def test_models_and_status_surface(server):
+    srv, _ = server
+    with urllib.request.urlopen(srv.url("/v1/models"), timeout=30) as r:
+        models = json.loads(r.read())
+    assert models["data"][0]["id"] == "gpt-tiny"
+    with urllib.request.urlopen(srv.url("/healthz"), timeout=30) as r:
+        assert r.read() == b"ok\n"
+    with urllib.request.urlopen(srv.url("/metrics"), timeout=30) as r:
+        prom = r.read().decode()
+    assert "serve_http_requests" in prom
+    assert "serve_http_connections" in prom
+    with urllib.request.urlopen(srv.url("/statusz"), timeout=30) as r:
+        doc = json.loads(r.read())
+    assert "engine" in doc and "slots" in doc
+
+
+# ----------------------------------------------------------- error mapping
+
+
+@pytest.mark.parametrize("body,param", [
+    ({"prompt": [1, 2], "temperature": -1}, None),
+    ({"prompt": [1, 2], "top_p": 0}, None),
+    ({"prompt": "abc", "n": 2}, "n"),
+    ({"prompt": "abc", "echo": True}, "echo"),
+    ({"prompt": [], "max_tokens": 4}, "prompt"),
+    ({"prompt": [999999]}, "prompt"),
+    ({"prompt": [1, 2], "stop": [1]}, "stop"),
+    ({"prompt": [1, 2], "logprobs": 5}, "logprobs"),
+    ({"prompt": [1, 2], "response_format": {"type": "xml"}},
+     "response_format"),
+    ({"prompt": [1, 2], "timeout_s": -1}, "timeout_s"),
+])
+def test_400_envelope(server, body, param):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", body)
+    assert st == 400, doc
+    err = doc["error"]
+    assert err["type"] == "invalid_request_error"
+    assert err["message"]
+    if param is not None:
+        assert err["param"] == param
+
+
+def test_400_submit_validation_maps_to_envelope(server):
+    """Engine-side ValueErrors (host-side submit validation) come back
+    as the same structured envelope — never a traceback."""
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(8)), "max_tokens": 10_000,
+    })
+    assert st == 400
+    assert doc["error"]["code"] == "context_length_exceeded"
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(8)), "top_k": 4096,  # over sample_cap
+    })
+    assert st == 400
+    assert "sample_cap" in doc["error"]["message"]
+
+
+def test_400_malformed_json(server):
+    srv, _ = server
+    req = urllib.request.Request(
+        srv.url("/v1/completions"), data=b"{not json",
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+    assert "not valid JSON" in json.loads(ei.value.read())["error"]["message"]
+
+
+def test_503_retry_after_when_queue_full(gpt_tiny):
+    """A full waiting queue (the admission gate) maps to 503 +
+    Retry-After instead of an unbounded backlog. The engine loop is
+    deliberately NOT running, so the queue cannot drain mid-test."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=128, decode_block=4, bucket=8, api_port=0,
+        max_waiting=2,
+    ))
+    loop = EngineLoop(eng, start=False)
+    srv = ApiServer(eng, decode=_decode, loop=loop)
+    try:
+        for _ in range(2):
+            eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=4)
+        st, headers, doc = _post(srv, "/v1/completions", {
+            "prompt": [1, 2, 3], "max_tokens": 4,
+        })
+        assert st == 503
+        assert headers.get("Retry-After") == "1"
+        assert doc["error"]["code"] == "overloaded"
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------- disconnect-driven cancel
+
+
+def test_disconnect_cancels_and_frees_pages(gpt_tiny):
+    """Acceptance: a client dropping mid-stream cancels the request
+    within a block boundary — the slot frees, `serve/finish_cancelled`
+    counts it, and the paged pool leaks ZERO pages (refcounts return
+    to the trash-page-only baseline)."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=128, decode_block=4, bucket=8, api_port=0,
+        paged=True, page_size=8,
+    ))
+    srv = ApiServer(eng, decode=_decode)
+    try:
+        payload = json.dumps({
+            "prompt": [5, 6, 7, 8], "max_tokens": 100, "temperature": 0,
+            "stream": True,
+        }).encode()
+        s = socket.create_connection((srv.host, srv.port), timeout=60)
+        s.sendall(
+            b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\nContent-Length: "
+            + str(len(payload)).encode() + b"\r\n\r\n" + payload
+        )
+        buf = b""
+        while buf.count(b"data: ") < 2:
+            buf += s.recv(4096)
+        s.close()  # the disconnect
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = eng.metrics.snapshot()
+            if (snap.get("serve/finish_cancelled", 0) >= 1
+                    and eng.pool.n_active == 0):
+                break
+            time.sleep(0.02)
+        snap = eng.metrics.snapshot()
+        assert snap.get("serve/finish_cancelled", 0) == 1, snap
+        assert snap["serve/tokens_out"] < 100, "cancel missed the stream"
+        assert eng.pool.n_active == 0
+        # no leaked pages: free count back to the full budget and the
+        # only live refcount is the permanently-held trash page
+        assert eng.pool.pages_free == eng.pool.page_budget
+        assert int(eng.pool.refcount.sum()) == 1
+        assert snap["serve/http_disconnects"] >= 1
+    finally:
+        srv.close()
+
+
+def test_timeout_s_maps_to_deadline(server):
+    srv, _ = server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(6)), "max_tokens": 100, "temperature": 0,
+        "timeout_s": 0.001,
+    })
+    assert st == 200
+    assert doc["choices"][0]["finish_reason"] == "timeout"
+
+
+# ------------------------------------------------------------------ close
+
+
+def test_close_is_ordered_and_idempotent(gpt_tiny):
+    """Double-close regression: close() drains, closes the engine, and
+    a second close is a no-op — no exception, no double shutdown."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=128, decode_block=4, bucket=8, api_port=0,
+        drain_timeout_s=5.0,
+    ))
+    srv = ApiServer(eng, decode=_decode)
+    h = srv.loop.submit(np.arange(4, dtype=np.int32), max_new_tokens=8)
+    srv.close()
+    assert h.done  # drained, not abandoned
+    assert not srv.loop._thread.is_alive()
+    srv.close()  # idempotent
+    # the port is actually released: a fresh connect fails
+    with pytest.raises(OSError):
+        socket.create_connection((srv.host, srv.port), timeout=1)

@@ -1,0 +1,242 @@
+"""Grammar-constrained JSON decoding (serve/grammar.py + the engine's
+allow-mask plumbing).
+
+Contracts under test:
+* the stepper's allowed set is NEVER empty before the document
+  completes (for any legal token walk, under any budget the engine
+  would admit) and is empty exactly at `done`;
+* budget-aware closing: a constrained stream always completes a
+  `json.loads`-parseable document within its token budget;
+* EOS has no place mid-document — `submit` rejects grammar + eos_id;
+* through the engine, constrained and unconstrained slots share the
+  ONE compiled decode program (jit cache pinned) and constrained
+  greedy streams are deterministic, on both pool layouts.
+"""
+
+import json
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve import JsonStepper, ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.engine import _decode_program, _prefill_program
+from solvingpapers_tpu.serve.grammar import encode_allow
+
+# 64-char table covering the JSON alphabet (ids beyond stay letters)
+ALPHABET = '{}[]":,-.0123456789 \nabcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOP\\'
+TABLE = list(ALPHABET[:64])
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    model = GPT(GPT_TINY)
+    rng = jax.random.key(0)
+    params = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+# ------------------------------------------------------------- stepper unit
+
+
+def test_ctor_rejects_vocab_without_braces():
+    with pytest.raises(ValueError, match="cannot express"):
+        JsonStepper(list("abc"))
+
+
+def test_min_close_at_start_is_two():
+    st = JsonStepper(TABLE)
+    assert st.min_close == 2  # '{' '}'
+
+
+def test_known_document_feeds_to_done():
+    st = JsonStepper(TABLE)
+    doc = '{"a": [1, 2.5e-3, true, null, "x\\n"], "b": {"": false}}'
+    for ch in doc:
+        st.feed(ch)
+    assert st.done
+    assert st.allowed() == []  # EOS territory: nothing legal after done
+
+
+def test_illegal_char_raises():
+    st = JsonStepper(TABLE)
+    st.feed("{")
+    with pytest.raises(ValueError, match="not legal"):
+        st.feed(":")  # a colon cannot follow '{'
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_walk_mask_never_empty_and_parses(seed):
+    """Any walk that always picks from `allowed(budget)` completes a
+    valid document within the budget — the mask is never empty before
+    `done`, and `done` arrives at or before budget exhaustion."""
+    rng = random.Random(seed)
+    st = JsonStepper(TABLE)
+    budget = rng.randint(2, 80)
+    out = []
+    b = budget
+    while not st.done:
+        ids = st.allowed(b)
+        assert ids, (seed, "".join(out), st.mode, b)
+        tid = rng.choice(ids)
+        st.advance(tid)
+        out.append(TABLE[tid])
+        b -= 1
+        assert b >= 0, (seed, "".join(out))
+    json.loads("".join(out))
+
+
+def test_tight_budget_forces_minimal_document():
+    st = JsonStepper(TABLE)
+    ids = st.allowed(2)
+    assert [TABLE[t] for t in ids] == ["{"]
+    st.advance(ids[0])
+    ids = st.allowed(1)
+    assert [TABLE[t] for t in ids] == ["}"]
+    st.advance(ids[0])
+    assert st.done
+
+
+def test_allowed_is_deterministic_and_closing_first():
+    st = JsonStepper(TABLE)
+    st.advance(TABLE.index("{"))
+    a, b = st.allowed(50), st.allowed(50)
+    assert a == b
+    # most-closing-first ordering: '}' (completes the doc) leads, so a
+    # sample_cap truncation can never strand the stream
+    assert TABLE[a[0]] == "}"
+
+
+def test_multichar_tokens_simulated_whole():
+    table = ["{", "}", '"ab"', ":", "7", '"}', "}{"]
+    st = JsonStepper(table)
+    st.advance(0)  # {
+    ids = st.allowed(10)
+    # '}{' is illegal (document completes mid-token then continues)
+    assert 6 not in ids
+    assert 2 in ids  # a whole quoted key is one legal token
+    st.advance(2)
+    assert st.allowed(8) == [3]  # only ':' after a key
+    st.advance(3)
+    st.advance(4)
+    st.advance(1)
+    assert st.done
+
+
+def test_string_budget_closes_before_exhaustion():
+    """Inside a string with the budget running out, the mask narrows to
+    the closing quote and then the container closers."""
+    st = JsonStepper(TABLE)
+    for ch in '{"k':
+        st.feed(ch)
+    # min_close: '"' + ':' + value + '}' = 4
+    assert st.min_close == 4
+    ids = st.allowed(4)
+    assert [TABLE[t] for t in ids] == ['"']
+
+
+def test_encode_allow_truncates_head():
+    row = encode_allow([5, 9, 2], cap=2)
+    assert row.tolist() == [5, 9]
+    row = encode_allow([5], cap=4)
+    assert row.tolist() == [5, -1, -1, -1]
+
+
+# ------------------------------------------------------------ engine level
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_engine_json_mode_emits_valid_json(gpt_tiny, paged):
+    """A constrained greedy stream through the engine parses, finishes
+    "stop" at the complete document, and is deterministic — while an
+    unconstrained request shares the same batch untouched."""
+    model, params = gpt_tiny
+    streams = []
+    for _ in range(2):
+        eng = ServeEngine(model, params, ServeConfig(
+            n_slots=4, max_len=64, decode_block=4, bucket=8,
+            paged=paged, page_size=8 if paged else None,
+        ))
+        h = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=24,
+                       grammar=JsonStepper(TABLE))
+        plain = eng.submit(np.arange(3, dtype=np.int32), max_new_tokens=12)
+        eng.run()
+        text = "".join(TABLE[t] for t in h.tokens)
+        json.loads(text)
+        assert h.finish_reason == "stop"
+        assert len(h.tokens) <= 24
+        assert plain.finish_reason == "length" and len(plain.tokens) == 12
+        streams.append(h.tokens)
+    assert streams[0] == streams[1], "constrained greedy stream not pinned"
+
+
+def test_engine_json_mode_compile_count_unchanged(gpt_tiny):
+    """The allow-mask is a traced operand: admitting a constrained
+    request compiles ZERO new programs beyond the plain engine's."""
+    model, params = gpt_tiny
+    cfg = ServeConfig(n_slots=2, max_len=64, decode_block=4, bucket=8)
+    eng = ServeEngine(model, params, cfg)
+    eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=8)
+    eng.run()
+    decode_progs = _decode_program._cache_size()
+    prefill_progs = _prefill_program._cache_size()
+    h = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=16,
+                   grammar=JsonStepper(TABLE))
+    eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=8)
+    eng.run()
+    json.loads("".join(TABLE[t] for t in h.tokens))
+    assert _decode_program._cache_size() == decode_progs
+    assert _prefill_program._cache_size() == prefill_progs
+
+
+def test_submit_rejects_grammar_with_eos(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8))
+    with pytest.raises(ValueError, match="complete document"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=16,
+                   eos_id=3, grammar=JsonStepper(TABLE))
+
+
+def test_submit_rejects_budget_below_min_close(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8))
+    with pytest.raises(ValueError, match="shortest document"):
+        eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=1,
+                   grammar=JsonStepper(TABLE))
+
+
+def test_engine_grammar_default_eos_ignored(gpt_tiny):
+    """An engine-wide default eos_id must not leak into a grammar
+    request (EOS only legal at a complete document)."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=64, decode_block=4, bucket=8, eos_id=0))
+    h = eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=24,
+                   grammar=JsonStepper(TABLE))
+    eng.run()
+    assert h.eos_id is None
+    assert h.finish_reason == "stop"
+    json.loads("".join(TABLE[t] for t in h.tokens))
+
+
+def test_engine_grammar_one_token_per_block_budget_exact(gpt_tiny):
+    """A constrained slot advances one token per decode block; even so
+    the budget-aware mask closes the document at or before
+    max_new_tokens — never a truncated stream."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=8, bucket=8))
+    h = eng.submit(np.arange(4, dtype=np.int32), max_new_tokens=10,
+                   grammar=JsonStepper(TABLE))
+    eng.run()
+    assert h.finish_reason == "stop"
+    assert len(h.tokens) <= 10
+    json.loads("".join(TABLE[t] for t in h.tokens))

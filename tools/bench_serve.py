@@ -19,7 +19,10 @@ overwrites it with the Poisson entry; re-run with
 temperature/top-p/top-k/min-p vs all-greedy on the same trace), and
 with `--paged --append` for the paged-KV-pool workload (ABBA-paired
 paged vs lane throughput, equal-HBM capacity arm, zero-copy
-shared-prefix TTFT).
+shared-prefix TTFT), and with `--http --append` for the HTTP soak
+(the Poisson trace as N concurrent SSE clients through the OpenAI
+front door, ABBA-paired against direct engine.submit: req/s,
+client-side TTFT/p99 ITL, http_overhead_pct, stream_token_exact).
 
 Add `--trace` to any workload to run one extra flight-recorded arm: the
 entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
