@@ -312,9 +312,11 @@ def cmd_sample(args) -> int:
     init_toks = prompt[:, : min(prompt.shape[1], 128)]
     init_kwargs = {}
     if getattr(args, "speculative", False):
-        if getattr(cfg.model, "mtp_heads", 0) < 1:
+        n_drafts = getattr(args, "spec_drafts", 1)
+        if getattr(cfg.model, "mtp_heads", 0) < n_drafts:
             print(
-                "--speculative needs a model with mtp_heads >= 1 "
+                f"--speculative with --spec-drafts {n_drafts} needs a model "
+                f"with mtp_heads >= {n_drafts} "
                 f"(config {cfg.name!r} has {getattr(cfg.model, 'mtp_heads', 0)})",
                 file=sys.stderr,
             )
@@ -371,6 +373,7 @@ def cmd_sample(args) -> int:
         out, stats = generate_speculative(
             model, params, prompt, max_new_tokens=args.max_new_tokens,
             extra_variables=extra or None, prefill_chunk=chunk,
+            n_drafts=getattr(args, "spec_drafts", 1),
         )
         f, a = int(stats["forwards"]), int(stats["accepted"])
         print(
@@ -475,6 +478,9 @@ def cmd_serve(args) -> int:
         bucket=min(args.bucket, max_len),
         sample_cap=args.sample_cap,
         paged=args.paged,
+        speculative=args.speculative,
+        spec_k=args.spec_k,
+        spec_rounds=args.spec_rounds,
         api_port=args.port,
         api_host=args.host,
         json_mode=not args.no_json_mode,
@@ -520,9 +526,11 @@ def cmd_serve_bench(args) -> int:
             file=sys.stderr,
         )
         return 2
-    if sum((args.shared_prefix, args.sampling, args.paged, args.http)) > 1:
-        print("--shared-prefix, --sampling, --paged and --http are "
-              "separate workloads; pick one per run", file=sys.stderr)
+    if sum((args.shared_prefix, args.sampling, args.paged, args.http,
+            args.speculative)) > 1:
+        print("--shared-prefix, --sampling, --paged, --http and "
+              "--speculative are separate workloads; pick one per run",
+              file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
         run_http_bench,
@@ -530,6 +538,7 @@ def cmd_serve_bench(args) -> int:
         run_prefix_bench,
         run_sampling_bench,
         run_serve_bench,
+        run_spec_bench,
     )
 
     max_new = args.max_new_tokens
@@ -541,6 +550,12 @@ def cmd_serve_bench(args) -> int:
     n_requests = args.requests
     if n_requests is None:
         n_requests = 48 if args.shared_prefix else 32
+    prompt_lens = args.prompt_lens
+    if prompt_lens is None:
+        # --speculative defaults to gpt_tiny_long (256 positions):
+        # streams must be long enough for drafts to find history
+        prompt_lens = [24, 32, 40, 48] if args.speculative \
+            else [16, 32, 48, 64]
     trace_kwargs = dict(
         trace=args.trace,
         trace_out=args.trace_out if args.trace else None,
@@ -549,14 +564,30 @@ def cmd_serve_bench(args) -> int:
         status_port=args.status_port,
         status_hold_s=args.status_hold_s,
     )
-    if args.http:
+    if args.speculative:
+        result = run_spec_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=args.max_new_tokens or 160,
+            decode_block=args.decode_block or 8,
+            spec_k=args.spec_k,
+            spec_rounds=args.spec_rounds,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            train_steps=args.spec_train_steps,
+            seed=args.seed,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.http:
         result = run_http_bench(
             config=args.config,
             n_requests=n_requests,
             n_slots=args.slots,
             max_new=max_new,
             decode_block=decode_block,
-            prompt_lens=tuple(args.prompt_lens),
+            prompt_lens=tuple(prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
         )
@@ -567,7 +598,7 @@ def cmd_serve_bench(args) -> int:
             n_slots=args.slots,
             max_new=max_new,
             decode_block=decode_block,
-            prompt_lens=tuple(args.prompt_lens),
+            prompt_lens=tuple(prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             n_prefixes=args.n_prefixes,
             prefix_requests=args.prefix_requests,
@@ -584,7 +615,7 @@ def cmd_serve_bench(args) -> int:
             n_slots=args.slots,
             max_new=max_new,
             decode_block=decode_block,
-            prompt_lens=tuple(args.prompt_lens),
+            prompt_lens=tuple(prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
             **trace_kwargs,
@@ -611,7 +642,7 @@ def cmd_serve_bench(args) -> int:
             n_slots=args.slots,
             max_new=max_new,
             decode_block=decode_block,
-            prompt_lens=tuple(args.prompt_lens),
+            prompt_lens=tuple(prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
             skip_sequential=args.skip_sequential,
@@ -834,6 +865,11 @@ def main(argv=None) -> int:
              ">= 1): identical output to --greedy in fewer forwards; "
              "prints acceptance stats to stderr",
     )
+    p_sample.add_argument(
+        "--spec-drafts", type=int, default=1, choices=(1, 2),
+        help="[--speculative] chained MTP heads to draft with (2 needs "
+             "mtp_heads >= 2; commits up to 3 tokens per forward)",
+    )
     p_sample.add_argument("--seed", type=int, default=0)
 
     p_serve = sub.add_parser("serve-bench")
@@ -847,9 +883,11 @@ def main(argv=None) -> int:
     p_serve.add_argument("--decode-block", type=int, default=None,
                          help="default 16 (4 with --shared-prefix)")
     p_serve.add_argument("--prompt-lens", type=int, nargs="+",
-                         default=[16, 32, 48, 64],
+                         default=None,
                          help="prompt-length cycle (bounded set => bounded "
-                              "compiles in both arms)")
+                              "compiles in both arms); default "
+                              "16 32 48 64 (24 32 40 48 with "
+                              "--speculative)")
     p_serve.add_argument("--mean-interarrival", type=float, default=0.001,
                          help="Poisson arrival mean gap in seconds")
     p_serve.add_argument("--seed", type=int, default=0)
@@ -879,6 +917,27 @@ def main(argv=None) -> int:
                               "lane-equivalent page budget), and a "
                               "shared-prefix arm with zero-copy page "
                               "sharing (serve/bench.py run_paged_bench)")
+    p_serve.add_argument("--speculative", action="store_true",
+                         help="speculative-decoding workload instead: "
+                              "ABBA-paired spec-on (n-gram drafter) vs "
+                              "spec-off delivered tokens/sec on a "
+                              "briefly-trained model, with a greedy "
+                              "token-exactness check and a temperature-"
+                              "2.0 zero-acceptance adversarial arm "
+                              "(serve/bench.py run_spec_bench; defaults "
+                              "max-new-tokens 160, decode-block 8)")
+    p_serve.add_argument("--spec-k", type=int, default=16,
+                         help="[--speculative] draft tokens per round "
+                              "(ServeConfig.spec_k)")
+    p_serve.add_argument("--spec-rounds", type=int, default=6,
+                         help="[--speculative] draft-verify rounds per "
+                              "decode call (ServeConfig.spec_rounds)")
+    p_serve.add_argument("--spec-train-steps", type=int, default=300,
+                         help="[--speculative] brief training steps on "
+                              "the synthetic corpus before benching "
+                              "(draft quality is the mechanism under "
+                              "test; 0 = random init, all-reject "
+                              "regime)")
     p_serve.add_argument("--page-size", type=int, default=16,
                          help="[--paged] tokens per KV page "
                               "(ServeConfig.page_size)")
@@ -953,6 +1012,18 @@ def main(argv=None) -> int:
     p_srv.add_argument("--max-waiting", type=int, default=256)
     p_srv.add_argument("--paged", action="store_true",
                        help="serve over the paged KV pool")
+    p_srv.add_argument("--speculative", default=None,
+                       choices=["ngram", "mtp"],
+                       help="speculative decoding: n-gram prompt-lookup "
+                            "self-drafting (any family) or MTP heads "
+                            "(deepseekv3 with mtp_heads >= 1, lane "
+                            "pool); greedy streams stay token-exact, "
+                            "stochastic distributions unchanged")
+    p_srv.add_argument("--spec-k", type=int, default=4,
+                       help="[--speculative] draft tokens per round")
+    p_srv.add_argument("--spec-rounds", type=int, default=None,
+                       help="[--speculative] draft-verify rounds per "
+                            "decode call (default: decode-block)")
     p_srv.add_argument("--no-json-mode", action="store_true",
                        help="reject response_format json_object instead "
                             "of grammar-constraining the decode")

@@ -84,6 +84,32 @@ def _gpt_tiny() -> RunConfig:
     )
 
 
+@register("gpt_tiny_long")
+def _gpt_tiny_long() -> RunConfig:
+    """gpt_tiny with a 256-position budget: the serving benches' long-
+    stream smoke config (CPU-runnable; speculative decoding needs
+    streams long enough for drafts to find history, which gpt_tiny's 64
+    positions cannot hold). Train at the full block_size — the learned
+    position table has no values beyond the trained length."""
+    from solvingpapers_tpu.models.gpt import GPTConfig
+
+    return RunConfig(
+        name="gpt_tiny_long",
+        model_family="gpt",
+        model=GPTConfig(vocab_size=64, block_size=256, dim=64, n_layers=2,
+                        n_heads=2, dropout=0.0),
+        train=TrainConfig(
+            steps=300, batch_size=16, log_every=50, eval_every=0,
+            optimizer=OptimizerConfig(max_lr=3e-3, warmup_steps=10,
+                                      total_steps=300),
+            tokens_per_step=16 * 256,
+        ),
+        data={"kind": "char", "path": None, "block_size": 256},
+        notes="smoke/bench config for long serve streams, not a "
+              "reference workload",
+    )
+
+
 @register("gpt_shakespeare")
 def _gpt_shakespeare() -> RunConfig:
     """The reference's gpt/gpt-jax.ipynb cell 8 hyperparameters."""

@@ -22,9 +22,15 @@ Mechanics worth noting:
     position-based masking never exposes slots beyond the current token.
   * Greedy only: exact-match verification is lossless for argmax; the
     stochastic variant needs rejection-sampling corrections and is out of
-    scope. Batch 1 only: rows would otherwise advance at different rates
-    and the contiguous cache write (one position per step) no longer
-    holds.
+    scope HERE. Batch 1 only: rows would otherwise advance at different
+    rates and the contiguous cache write (one position per step) no
+    longer holds. The SERVING engine lifts both limits:
+    `serve/spec.py` + `ServeConfig(speculative="mtp")` run this module's
+    head mechanics per slot under vmap inside the continuous-batching
+    decode block (per-slot positions, traced accept counts) and verify
+    stochastic slots with modified rejection sampling against the
+    per-request truncated distributions. This module remains the one-shot
+    batch-1 path (`cli sample --speculative [--spec-drafts 2]`).
   * Equality caveat (measured, not hypothetical): verification computes
     logits over a 2-3-token chunk while plain generate uses 1-token steps;
     XLA may re-associate the reductions differently, so bf16 argmax TIES

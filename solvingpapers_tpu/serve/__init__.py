@@ -1,9 +1,10 @@
 """Continuous-batching serving: slot/paged KV pools + FIFO scheduler +
 mixed prefill/decode engine + radix-tree prefix cache (zero-copy
 refcounted page sharing on the paged pool) + per-request sampling
-(SamplingParams / fused_sample) + grammar-constrained JSON decoding
-(JsonStepper) + OpenAI-compatible HTTP front door (ApiServer) + latency
-metrics."""
+(SamplingParams / fused_sample) + speculative decoding (serve/spec.py:
+n-gram/MTP draft-and-verify with lossless rejection sampling) +
+grammar-constrained JSON decoding (JsonStepper) + OpenAI-compatible
+HTTP front door (ApiServer) + latency metrics."""
 
 from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
@@ -18,6 +19,7 @@ from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
 from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request
+from solvingpapers_tpu.serve.spec import SpecController
 
 __all__ = [
     "ApiServer",
@@ -37,4 +39,5 @@ __all__ = [
     "fused_sample",
     "FIFOScheduler",
     "Request",
+    "SpecController",
 ]

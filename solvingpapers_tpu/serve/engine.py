@@ -54,6 +54,21 @@ prefix back to the radix tree (snapshot copy vs page-id reference,
 respectively). Cached KV at position p depends only on tokens <= p, so
 greedy streams are token-exact with the cache on or off.
 
+Speculative decoding (`serve/spec.py`, opt-in via
+`ServeConfig.speculative`): the decode block becomes per-slot
+draft-and-verify rounds — a drafter (n-gram prompt-lookup over a
+history buffer riding the packed control transfer, or the DeepSeek-V3
+MTP heads) proposes up to `spec_k` tokens per slot, one chunked
+forward evaluates the whole `1 + spec_k` window, and verification
+commits a variable number of tokens per round. Greedy slots verify by
+exact argmax match (streams stay byte-identical to spec-off serving
+and one-shot `generate`); stochastic slots use lossless rejection
+sampling against `fused_sample`'s truncated distributions; grammar
+slots ride along draft-free. Draft length is traced per slot — mixed
+spec/non-spec batches share one compiled decode program — and a
+host-side adaptive controller falls back to the plain block while
+drafts keep rejecting.
+
 Per-request sampling (`serve/sampling.py`): every request carries
 `SamplingParams` (temperature / top-k / top-p / min-p / seed / stop sets /
 logprobs). The knobs live in slot-major struct-of-arrays mirrors packed
@@ -108,9 +123,12 @@ from solvingpapers_tpu.serve.kv_pool import (
     extract_lane,
     gather_lane,
     gather_lanes,
+    pad_time,
     scatter_lane_pages,
+    scatter_window_pages,
     scatter_written_pages,
     store_lane,
+    strip_time,
 )
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache
@@ -129,6 +147,13 @@ from solvingpapers_tpu.serve.scheduler import (
     WAITING,
     FIFOScheduler,
     Request,
+)
+from solvingpapers_tpu.serve.spec import (
+    DRAFTERS,
+    SpecController,
+    ngram_drafts,
+    round_keys,
+    spec_verify,
 )
 
 
@@ -212,6 +237,52 @@ class ServeConfig:
     paged: bool = False
     page_size: int | None = None
     page_budget: int | None = None
+    # Speculative decoding (serve/spec.py): per-slot draft-and-verify
+    # inside the decode program. Each decode step runs `spec_rounds`
+    # draft-verify rounds: a drafter proposes up to `spec_k` tokens per
+    # slot, ONE chunked forward computes the model's distributions over
+    # the 1+k-token window, and verification commits 1..k+1 tokens per
+    # round — greedy slots by exact argmax match (streams stay
+    # byte-identical to spec-off serving and one-shot generate),
+    # stochastic slots by rejection sampling against fused_sample's
+    # truncated distributions (per-request output distributions provably
+    # unchanged), grammar-constrained slots ride along draft-free (one
+    # token per step, the stale-mask contract). Draft length is traced
+    # per slot, so mixed spec/non-spec batches share ONE compiled decode
+    # program.
+    #   speculative  None = off; "ngram" = model-free prompt-lookup
+    #                self-drafter (device-side lookup over a history
+    #                buffer riding the packed control transfer — any
+    #                family, either pool); "mtp" = DeepSeek-V3
+    #                multi-token-prediction heads (infer/speculative.py
+    #                mechanics vmapped over slots; deepseekv3 family,
+    #                lane pool, no prefix cache — the head cache has no
+    #                hidden states for spliced prefixes)
+    #   spec_k       draft tokens per round (chunk width 1 + spec_k);
+    #                "mtp" clamps to the model's trained head count
+    #   spec_rounds  draft-verify rounds per decode call (None =
+    #                decode_block); each call commits between
+    #                spec_rounds and spec_rounds * (1 + spec_k) tokens
+    #                per slot
+    #   spec_ngram   longest tail n-gram the lookup drafter tries
+    #                (falls back n, n-1, ..., 1)
+    #   spec_min_rate / spec_probe_every  the adaptive controller
+    #                (serve/spec.py SpecController): acceptance below
+    #                spec_min_rate ACCEPTED DRAFTS PER ROUND drops the
+    #                engine to plain blocks for spec_probe_every steps
+    #                (doubling on every failed cheap probe, capped), so
+    #                zero-acceptance adversarial traffic pays a few
+    #                short probes instead of chunked blocks every step.
+    #                None scales the threshold with the chunk width
+    #                (max(1, spec_k / 4)): each round forwards 1+k
+    #                positions, so the acceptance worth paying for
+    #                grows with k
+    speculative: str | None = None
+    spec_k: int = 4
+    spec_rounds: int | None = None
+    spec_ngram: int = 3
+    spec_min_rate: float | None = None
+    spec_probe_every: int = 8
     # static support bound for stochastic sampling (clamped to the vocab):
     # fused_sample draws inside the top `sample_cap` logits per step —
     # bounded-support sampling keeps the per-step cost at one top-k
@@ -583,6 +654,355 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     return phys, out
 
 
+def _spec_rounds_scan(model, k, rounds, cap, max_len, nmax, variables,
+                      lanes, state, samp, rng, hist=None, hlen=None,
+                      mtp_lanes=None, drafts0=None):
+    """Shared draft-verify scan of the speculative decode programs (all
+    three call it, so the commit semantics cannot drift between pools or
+    drafters). `lanes` is the PADDED (S, max_len + k + 1, ...) lane view
+    (`kv_pool.pad_time` — a chunk write can then never clamp-shift onto
+    committed KV); `hist`/`hlen` arm the in-program n-gram drafter,
+    `mtp_lanes`/`drafts0` the MTP head chain (exactly one pair is set).
+
+    Each round: draft up to `k` tokens per slot, ONE chunked forward over
+    the ``1 + k`` window (the models' cached per-query position masking
+    makes the chunk causal, and garbage KV written for rejected drafts is
+    overwritten by the next round's chunk before anything attends it —
+    the `infer/speculative.py` argument, per slot under vmap), verify
+    with `spec_verify`, advance the carry by the committed count. The
+    per-slot position freezes at ``max_len - 1`` once a stream overshoots
+    its lane (overshoot rounds rewrite slack/garbage only; the host has
+    already finished such a stream when it truncates the call's output).
+
+    Returns ``(lanes, mtp_lanes, out (rounds, S, k+1) i32,
+    commits (rounds, S), proposed (rounds, S), lps (rounds, S, k+1),
+    next_drafts (S, k))`` — the host keeps ``out[r, s, :commits[r, s]]``
+    round by round.
+    """
+    toks, pos = state[0], state[1]
+    active = state[2].astype(bool)
+    step_tag, seeds, samp0 = state[4, 0], state[6], state[7]
+    allow = state[9:9 + cap].T
+    spec_ok = state[9 + cap].astype(bool)
+    packed = PackedSampling(
+        temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
+        need_lp=state[8],
+    )
+    mtp = mtp_lanes is not None
+    arange_k1 = jnp.arange(k + 1)
+    if mtp:
+        from solvingpapers_tpu.models.deepseekv3 import mtp_head_apply
+
+        mcfg = model.cfg
+        params = variables["params"]
+        moe_state = variables.get("moe_state", {})
+
+    def fwd(tok, ds, p, slot_caches):
+        lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
+        chunk = jnp.concatenate([tok[None], ds])[None, :].astype(jnp.int32)
+        poss = jnp.minimum(p + arange_k1, max_len - 1)[None, :]
+        if mtp:
+            (logits, h), lane = model.apply(
+                variables, chunk, positions=poss, caches=lane,
+                deterministic=True, return_hidden=True,
+            )
+            out = (logits[0], h[0])
+        else:
+            logits, lane = model.apply(
+                variables, chunk, positions=poss, caches=lane,
+                deterministic=True,
+            )
+            out = logits[0]
+        return out, jax.tree_util.tree_map(
+            lambda a: jnp.squeeze(a, axis=0), lane
+        )
+
+    def rnd(carry, _):
+        toks, pos, cnt, hist, hlen, drafts, lanes, mlanes = carry
+        if hist is not None:
+            ds, avail = jax.vmap(
+                lambda h, m: ngram_drafts(h, m, k=k, nmax=nmax)
+            )(hist, hlen)
+        else:
+            ds, avail = drafts, jnp.full(toks.shape, k, jnp.int32)
+        avail = jnp.where(spec_ok & active, avail, 0)
+        if mtp:
+            (logits, hs), lanes = jax.vmap(fwd)(toks, ds, pos, lanes)
+        else:
+            logits, lanes = jax.vmap(fwd)(toks, ds, pos, lanes)
+        keys = round_keys(rng, step_tag, seeds, cnt, k + 1)
+        out, commits, lps = spec_verify(
+            logits, ds, avail, packed, keys, cap=cap, allow=allow
+        )
+        commits = jnp.where(active, commits, 0)
+        nxt = jnp.take_along_axis(
+            out, jnp.maximum(commits - 1, 0)[:, None], axis=1
+        )[:, 0]
+        toks = jnp.where(active, nxt.astype(toks.dtype), toks)
+        if mtp:
+            a_cut = jnp.maximum(commits - 1, 0)
+
+            def adv(h_s, out_s, p, a_s, *slot_mtp):
+                # the head's next-token stream is the COMMITTED matrix
+                # row (garbage columns beyond the cut are overwritten by
+                # the next round's advance before they are attended) and
+                # the fresh draft reads the newest surviving column —
+                # infer/speculative.py's loop body, per slot under vmap
+                poss = jnp.minimum(p + arange_k1, max_len - 1)[None, :]
+                c1 = jax.tree_util.tree_map(lambda a: a[None], slot_mtp[0])
+                g1, y1, c1, _ = mtp_head_apply(
+                    mcfg, params, moe_state, h_s[None], out_s[None, :],
+                    poss, cache=c1,
+                )
+                d1 = jnp.argmax(jnp.take(g1[0], a_s, axis=0)).astype(
+                    jnp.int32)
+                new = [jax.tree_util.tree_map(
+                    lambda a: jnp.squeeze(a, axis=0), c1)]
+                if k == 2:
+                    next2 = jnp.concatenate([out_s[1:], out_s[-1:]])
+                    next2 = next2.at[a_s].set(d1)
+                    c2 = jax.tree_util.tree_map(
+                        lambda a: a[None], slot_mtp[1])
+                    g2, _, c2, _ = mtp_head_apply(
+                        mcfg, params, moe_state, y1, next2[None, :], poss,
+                        cache=c2, head=2,
+                    )
+                    d2 = jnp.argmax(jnp.take(g2[0], a_s, axis=0)).astype(
+                        jnp.int32)
+                    new.append(jax.tree_util.tree_map(
+                        lambda a: jnp.squeeze(a, axis=0), c2))
+                    return (jnp.stack([d1, d2]), *new)
+                return (d1[None], *new)
+
+            adv_out = jax.vmap(adv)(hs, out, pos, a_cut, *mlanes)
+            drafts, mlanes = adv_out[0], tuple(adv_out[1:])
+        if hist is not None:
+            hist = jax.vmap(
+                lambda h, o, m: jax.lax.dynamic_update_slice(h, o, (m,))
+            )(hist, out, hlen)
+            hlen = jnp.minimum(hlen + commits, max_len)
+        pos = jnp.minimum(pos + commits, max_len - 1)
+        cnt = cnt + commits
+        carry = (toks, pos, cnt, hist, hlen, drafts, lanes, mlanes)
+        return carry, (out, commits, avail, lps)
+
+    if hist is not None:
+        # pad so the (k+1)-wide write at hlen <= max_len never shifts
+        hist = jnp.concatenate(
+            [hist, jnp.zeros((hist.shape[0], k + 1), hist.dtype)], axis=1
+        )
+    carry0 = (toks, pos, samp0, hist, hlen, drafts0, lanes, mtp_lanes)
+    carry, (out, commits, proposed, lps) = jax.lax.scan(
+        rnd, carry0, None, length=rounds
+    )
+    next_drafts = (carry[5] if drafts0 is not None
+                   else jnp.zeros((toks.shape[0], k), jnp.int32))
+    return carry[6], carry[7], out, commits, proposed, lps, next_drafts
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "k", "rounds", "cap", "max_len", "nmax"),
+    donate_argnames=("caches",),
+)
+def _spec_decode_program(model, k, rounds, cap, max_len, nmax, variables,
+                         caches, state, samp, rng):
+    """Lane-pool speculative decode block: `rounds` n-gram draft-verify
+    rounds per call. `state` extends the plain decode layout: rows
+    [0, 9 + cap) are `_decode_program`'s control rows, row ``9 + cap`` is
+    the per-slot spec gate (0 = never draft: grammar-constrained slots
+    and free lanes), rows [10 + cap, 10 + cap + max_len) carry each
+    slot's token HISTORY transposed (prompt + committed tokens — the
+    n-gram drafter's corpus) and the final row its live length. The
+    history rides the same packed int transfer, so a speculative decode
+    call is still two host->device control arrays."""
+    lanes = pad_time(caches, k + 1)
+    hist = state[10 + cap:10 + cap + max_len].T
+    hlen = state[10 + cap + max_len]
+    lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
+        model, k, rounds, cap, max_len, nmax, variables, lanes, state,
+        samp, rng, hist=hist, hlen=hlen,
+    )
+    return strip_time(lanes, k + 1), (out, commits, proposed, lps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "k", "rounds", "cap", "max_len", "nmax"),
+    donate_argnames=("phys",),
+)
+def _paged_spec_decode_program(model, k, rounds, cap, max_len, nmax,
+                               variables, phys, state, samp, rng):
+    """Paged-pool speculative decode block: `_spec_decode_program`'s
+    semantics over the physical page pool. The page tables ride the
+    packed transfer after the history rows; the gathered lane view is
+    padded (`pad_time`) so chunk writes never clamp-shift, and only the
+    DEVICE-committed window scatters back (`scatter_window_pages`):
+    rejected-draft garbage past that window never reaches the physical
+    pool, so shared prefix pages and the immutability argument are
+    untouched by speculation. NOTE the window is bounded by the device
+    commit count, which can exceed what the host keeps (grammar slots
+    keep round 0 only; EOS/stop truncate): those tail pages hold
+    stale-draw KV that is only sound because it lands strictly after the
+    slot's attend window and is rewritten before it is ever attended —
+    do NOT share or snapshot pages past a slot's host-accepted length."""
+    base = 11 + cap + max_len
+    table = state[base:].T  # (S, pages_per_lane)
+    hist = state[10 + cap:10 + cap + max_len].T
+    hlen = state[10 + cap + max_len]
+    pos0 = state[1]
+    lanes = pad_time(gather_lanes(phys, table), k + 1)
+    lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
+        model, k, rounds, cap, max_len, nmax, variables, lanes, state,
+        samp, rng, hist=hist, hlen=hlen,
+    )
+    lanes = strip_time(lanes, k + 1)
+    total = commits.sum(axis=0)
+    last = jnp.minimum(pos0 + jnp.maximum(total, 1) - 1, max_len - 1)
+    phys = scatter_window_pages(phys, lanes, table, pos0, last,
+                                rounds * (k + 1))
+    return phys, (out, commits, proposed, lps)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "k", "rounds", "cap", "max_len"),
+    donate_argnames=("caches", "mtp"),
+)
+def _mtp_spec_decode_program(model, k, rounds, cap, max_len, variables,
+                             caches, mtp, state, samp, rng):
+    """MTP speculative decode block (deepseekv3, lane pool): the chunk
+    forward returns hidden states and the trained MTP head(s) — their
+    per-slot latent-cache lanes ride in `mtp`, allocated with the same
+    ``k + 1`` slack — redraft the next round's tokens in-program
+    (`infer/speculative.py` head chaining, vmapped over slots). Rows
+    [10 + cap, 10 + cap + k) of `state` carry the FIRST round's drafts
+    (the bootstrap from `_mtp_prefill_program`, or the previous call's
+    returned `next_drafts`)."""
+    lanes = pad_time(caches, k + 1)
+    drafts0 = state[10 + cap:10 + cap + k].T.astype(jnp.int32)
+    lanes, mtp, out, commits, proposed, lps, nxt = _spec_rounds_scan(
+        model, k, rounds, cap, max_len, 0, variables, lanes, state, samp,
+        rng, mtp_lanes=mtp, drafts0=drafts0,
+    )
+    return strip_time(lanes, k + 1), mtp, (out, commits, proposed, lps), nxt
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "padded", "chunk", "cap", "k"),
+    donate_argnames=("caches", "mtp"),
+)
+def _mtp_prefill_program(model, padded, chunk, cap, k, variables, caches,
+                         mtp, prompt, ctl, samp, rng):
+    """MTP-engine admission: `_prefill_program`'s contract (lane pool,
+    full prefill — the MTP engine excludes the prefix cache: a spliced
+    prefix has no hidden states for the head cache) plus the MTP head
+    prefill and bootstrap drafts, mirroring `infer/speculative.py`'s
+    prefill on a padded prompt: the head's cache is filled over columns
+    [0, padded - 1) (columns past ``length - 1`` hold pad garbage that
+    the decode rounds overwrite before any real query attends them), and
+    the bootstrap advances it at column ``length - 1`` with the first
+    sampled token to draft the token after it. Returns ``(caches, mtp,
+    first, logprob, drafts (k,))``."""
+    from solvingpapers_tpu.models.deepseekv3 import mtp_head_apply
+
+    mcfg = model.cfg
+    params = variables["params"]
+    moe_state = variables.get("moe_state", {})
+    slot, length = ctl[0], ctl[1]
+    lane = extract_lane(caches, slot)
+    toks = prompt[None, :]
+    step = chunk or padded
+    hs = []
+    last = None
+    for cs in range(0, padded, step):
+        ce = min(cs + step, padded)
+        tok_chunk = jax.lax.slice_in_dim(toks, cs, ce, axis=1)
+        positions = jnp.broadcast_to(
+            jnp.arange(cs, ce), (1, ce - cs)
+        )
+        (logits, h), lane = model.apply(
+            variables, tok_chunk, positions=positions, caches=lane,
+            deterministic=True, attend_len=ce, return_hidden=True,
+        )
+        hs.append(h)
+        idx = jnp.clip(length - 1 - cs, 0, ce - cs - 1)
+        row = jax.lax.dynamic_index_in_dim(logits[0], idx, axis=0,
+                                           keepdims=False)
+        sel = (length - 1 >= cs) & (length - 1 < ce)
+        last = row if last is None else jnp.where(sel, row, last)
+    h_all = jnp.concatenate(hs, axis=1)  # (1, padded, D)
+    caches = store_lane(caches, lane, slot)
+    packed = PackedSampling(
+        temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
+        top_k=ctl[3:4], need_lp=ctl[5:6],
+    )
+    key = request_key(rng, step_tag=ctl[2], slot=slot, seed=ctl[4],
+                      samp_idx=jnp.int32(0))
+    first, logprob = fused_sample(last[None], packed, key[None], cap=cap,
+                                  allow=ctl[6:6 + cap][None, :])
+    first32 = first[0].astype(jnp.int32)
+    # ---- head 1 prefill over columns [0, padded - 1): the next-token
+    # stream there is the prompt itself (pad columns hold garbage the
+    # decode rounds overwrite before any real attend — same contract as
+    # the main lane's pad region)
+    m1 = extract_lane(mtp[0], slot)
+    y1s = []
+    head_end = max(padded - 1, 1)
+    for cs in range(0, head_end, step):
+        ce = min(cs + step, head_end)
+        nxt = jax.lax.slice_in_dim(toks, cs + 1, ce + 1, axis=1)
+        g, y1, m1, _ = mtp_head_apply(
+            mcfg, params, moe_state, h_all[:, cs:ce], nxt,
+            jnp.broadcast_to(jnp.arange(cs, ce), (1, ce - cs)),
+            cache=m1, attend_len=ce,
+        )
+        y1s.append(y1)
+    # bootstrap at column length - 1: h of the last real prompt token +
+    # the embedding of the just-sampled first token -> drafts position
+    # length + 1
+    pos_last = jnp.clip(length - 1, 0, padded - 1)
+    h_last = jax.lax.dynamic_slice(
+        h_all, (0, pos_last, 0), (1, 1, h_all.shape[2])
+    )
+    g, y1_last, m1, _ = mtp_head_apply(
+        mcfg, params, moe_state, h_last, first32[None, None],
+        jnp.reshape(pos_last, (1, 1)), cache=m1,
+    )
+    d1 = jnp.argmax(g[0, -1]).astype(jnp.int32)
+    out_mtp = [store_lane(mtp[0], m1, slot)]
+    if k == 2:
+        y1_all = jnp.concatenate(y1s, axis=1)  # (1, padded - 1, D)
+        m2 = extract_lane(mtp[1], slot)
+        head2_end = max(padded - 2, 1)
+        for cs in range(0, head2_end, step):
+            ce = min(cs + step, head2_end)
+            nxt = jax.lax.slice_in_dim(toks, cs + 2, ce + 2, axis=1)
+            _, _, m2, _ = mtp_head_apply(
+                mcfg, params, moe_state, y1_all[:, cs:ce], nxt,
+                jnp.broadcast_to(jnp.arange(cs, ce), (1, ce - cs)),
+                cache=m2, attend_len=ce, head=2,
+            )
+        pos_a = jnp.clip(length - 2, 0, padded - 2)
+        y_a = jax.lax.dynamic_slice(
+            y1_all, (0, pos_a, 0), (1, 1, y1_all.shape[2])
+        )
+        y_pair = jnp.concatenate([y_a, y1_last], axis=1)
+        nxt_pair = jnp.stack([first32, d1])[None, :]
+        poss = jnp.stack([pos_a, pos_a + 1])[None, :]
+        g2, _, m2, _ = mtp_head_apply(
+            mcfg, params, moe_state, y_pair, nxt_pair, poss, cache=m2,
+            head=2,
+        )
+        d2 = jnp.argmax(g2[0, -1]).astype(jnp.int32)
+        out_mtp.append(store_lane(mtp[1], m2, slot))
+        drafts = jnp.stack([d1, d2])
+    else:
+        drafts = d1[None]
+    return caches, tuple(out_mtp), first[0], logprob[0], drafts
+
+
 class ServeEngine:
     """Long-lived continuous-batching engine over one decoder model.
 
@@ -689,6 +1109,94 @@ class ServeEngine:
                     "silently do nothing"
                 )
             self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
+        # speculative decoding (serve/spec.py; see the ServeConfig knob
+        # block): per-slot draft-and-verify rounds inside the decode
+        # program, with a host-side adaptive controller that falls back
+        # to the plain block while drafts keep rejecting
+        self._spec = cfg.speculative
+        self._spec_ctl = None
+        self._mtp_pool = None
+        if cfg.speculative is None:
+            if cfg.spec_rounds is not None:
+                raise ValueError(
+                    "spec_rounds configures the speculative decode block "
+                    "and needs speculative set — without a drafter it "
+                    "would silently do nothing"
+                )
+        else:
+            if cfg.speculative not in DRAFTERS:
+                raise ValueError(
+                    f"speculative must be one of {DRAFTERS} (or None), "
+                    f"got {cfg.speculative!r}"
+                )
+            if cfg.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {cfg.spec_k}")
+            if cfg.spec_rounds is not None and cfg.spec_rounds < 1:
+                raise ValueError(
+                    f"spec_rounds must be >= 1, got {cfg.spec_rounds}"
+                )
+            self._spec_rounds = cfg.spec_rounds or cfg.decode_block
+            if cfg.speculative == "mtp":
+                heads = getattr(model.cfg, "mtp_heads", 0)
+                if heads < 1:
+                    raise ValueError(
+                        "speculative='mtp' drafts with the model's "
+                        "trained multi-token-prediction heads, which "
+                        "this model does not have (mtp_heads == 0) — "
+                        "use speculative='ngram' for model-free drafting"
+                    )
+                if cfg.paged:
+                    raise ValueError(
+                        "speculative='mtp' serves over the lane pool: "
+                        "the MTP head cache is a per-slot lane pool of "
+                        "its own (paged main-pool support is a "
+                        "follow-on) — drop paged or use 'ngram'"
+                    )
+                if cfg.prefix_cache:
+                    raise ValueError(
+                        "speculative='mtp' cannot reuse cached prefixes: "
+                        "a spliced prefix carries no hidden states for "
+                        "the MTP head cache — drop prefix_cache or use "
+                        "'ngram'"
+                    )
+                self._spec_k = min(cfg.spec_k, heads, 2)
+                from solvingpapers_tpu.infer.cache import LatentCache
+
+                dim = model.cfg.latent_dim + model.cfg.rope_dim
+                # head lanes carry the same k+1 slack the decode
+                # programs pad the main lanes with, so chunked head
+                # advances never clamp-shift either
+                self._mtp_pool = tuple(
+                    LatentCache.init(
+                        cfg.n_slots, cfg.max_len + self._spec_k + 1, dim,
+                        model.cfg.compute_dtype,
+                    )
+                    for _ in range(self._spec_k)
+                )
+                self._next_drafts = np.zeros(
+                    (cfg.n_slots, self._spec_k), np.int32
+                )
+            else:
+                self._spec_k = cfg.spec_k
+                if cfg.spec_ngram < 1:
+                    raise ValueError(
+                        f"spec_ngram must be >= 1, got {cfg.spec_ngram}"
+                    )
+            min_rate = cfg.spec_min_rate
+            if min_rate is None:
+                min_rate = max(1.0, self._spec_k / 4)
+            self._spec_ctl = SpecController(
+                min_rate=min_rate,
+                probe_every=cfg.spec_probe_every,
+            )
+            self.metrics.add_gauge_provider(self._spec_gauges)
+        # delivered-token tick weight for the scheduler's anti-starvation
+        # clock: a speculative step can deliver many tokens per slot, so
+        # ticking 1 per iteration would make a waiting request's budget
+        # worth MORE delivered work under high acceptance — the weight
+        # normalizes the wait clock to block-equivalents of delivered
+        # tokens (serve/scheduler.py tick)
+        self._tick_weight = 1.0
         self.prefix_cache = (
             PrefixCache(page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes,
                         trace=self.trace,
@@ -729,6 +1237,12 @@ class ServeEngine:
             # params are fixed for the engine's lifetime: account once
             self.ledger.register("params", pytree_bytes(self.variables))
             self.ledger.register("kv_pool", lambda: self.pool.nbytes)
+            if self._mtp_pool is not None:
+                # the MTP drafter's head-cache lanes are a real pool of
+                # their own (latent_dim+rope_dim per position per head)
+                self.ledger.register(
+                    "mtp_cache", pytree_bytes(self._mtp_pool)
+                )
             if self.prefix_cache is not None and not cfg.paged:
                 # paged trees hold REFERENCES into the fixed pool — their
                 # bytes are already inside kv_pool; a separate ledger
@@ -967,7 +1481,13 @@ class ServeEngine:
         decode_slots = self.pool.n_active
         if decode_slots > 0:
             finished.extend(self._decode_block())
-        self.scheduler.tick()
+        # anti-starvation clock in DELIVERED-TOKEN units: a speculative
+        # step that committed several blocks' worth of tokens ages the
+        # waiting queue proportionally (weight = max per-slot delivered /
+        # decode_block, floored at 1), so a high-acceptance batch cannot
+        # starve the wait budget — plain blocks keep weight 1 exactly
+        self.scheduler.tick(self._tick_weight)
+        self._tick_weight = 1.0
         self.metrics.record_step(self.pool.occupancy)
         # only steps that did work are traced/monitored: an external
         # serving loop may poll step() while idle, and feeding those
@@ -1069,6 +1589,23 @@ class ServeEngine:
                 "pages_active": self.pool.pages_active,
                 "fragmentation": self.pool.fragmentation,
                 "per_slot_pages": self.pool.n_alloc.tolist(),
+            }
+        if self._spec is not None:
+            m = self.metrics
+            d["spec"] = {
+                "drafter": self._spec,
+                "k": self._spec_k,
+                "rounds": self._spec_rounds,
+                "steps": m.spec_steps,
+                "drafts_proposed": m.spec_proposed,
+                "drafts_accepted": m.spec_accepted,
+                "acceptance_rate": round(
+                    m.spec_accepted / m.spec_proposed, 4
+                ) if m.spec_proposed else 0.0,
+                "tokens_per_step": round(
+                    m.spec_tokens / m.spec_steps, 2
+                ) if m.spec_steps else 0.0,
+                **self._spec_ctl.stats(),
             }
         if self.prefix_cache is not None:
             d["prefix_cache"] = self.prefix_cache.stats()
@@ -1427,27 +1964,55 @@ class ServeEngine:
         )
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
-        prog = _paged_prefill_program if self._paged else _prefill_program
-        pool_tree = self.pool.phys if self._paged else self.pool.caches
-        pf_args = (
-            self.model, padded, chunk, matched, self.config.sample_cap,
-            self.variables, pool_tree, jnp.asarray(prompt_padded),
-            jnp.asarray(ctl), jnp.asarray(samp_row, np.float32), self._rng,
-        )
-        with self._scope("serve/prefill"):
-            if self.registry is not None:
-                # signature = the static shape triple; everything else
-                # (params, caches, control arrays) is fixed per engine
-                pool_tree, first, logprob = self.registry.call(
-                    "prefill_program", (padded, chunk, matched),
-                    prog, pf_args, static_argnums=(0, 1, 2, 3, 4),
-                )
-            else:
-                pool_tree, first, logprob = prog(*pf_args)
-        if self._paged:
-            self.pool.phys = pool_tree
-        else:
+        if self._spec == "mtp":
+            # admission doubles as the MTP bootstrap: the head cache is
+            # prefilled alongside the main lane and the first round's
+            # drafts come back with the first token (matched is always 0
+            # — the MTP engine excludes the prefix cache)
+            pf_args = (
+                self.model, padded, chunk, self.config.sample_cap,
+                self._spec_k, self.variables, self.pool.caches,
+                self._mtp_pool, jnp.asarray(prompt_padded),
+                jnp.asarray(ctl), jnp.asarray(samp_row, np.float32),
+                self._rng,
+            )
+            with self._scope("serve/prefill"):
+                if self.registry is not None:
+                    pool_tree, self._mtp_pool, first, logprob, drafts = (
+                        self.registry.call(
+                            "mtp_prefill_program", (padded, chunk),
+                            _mtp_prefill_program, pf_args,
+                            static_argnums=(0, 1, 2, 3, 4),
+                        ))
+                else:
+                    pool_tree, self._mtp_pool, first, logprob, drafts = (
+                        _mtp_prefill_program(*pf_args))
             self.pool.caches = pool_tree
+            self._next_drafts[slot] = np.asarray(drafts)
+        else:
+            prog = (_paged_prefill_program if self._paged
+                    else _prefill_program)
+            pool_tree = self.pool.phys if self._paged else self.pool.caches
+            pf_args = (
+                self.model, padded, chunk, matched, self.config.sample_cap,
+                self.variables, pool_tree, jnp.asarray(prompt_padded),
+                jnp.asarray(ctl), jnp.asarray(samp_row, np.float32),
+                self._rng,
+            )
+            with self._scope("serve/prefill"):
+                if self.registry is not None:
+                    # signature = the static shape triple; everything else
+                    # (params, caches, control arrays) is fixed per engine
+                    pool_tree, first, logprob = self.registry.call(
+                        "prefill_program", (padded, chunk, matched),
+                        prog, pf_args, static_argnums=(0, 1, 2, 3, 4),
+                    )
+                else:
+                    pool_tree, first, logprob = prog(*pf_args)
+            if self._paged:
+                self.pool.phys = pool_tree
+            else:
+                self.pool.caches = pool_tree
         first = int(first)  # blocks on the program — t_pf1 is device-true
         if tr is not None:
             t_pf1 = smetrics.now()
@@ -1580,7 +2145,223 @@ class ServeEngine:
         return len(req.tokens) - 1  # decode-boundary quirk: match only
         # materializes with the full stream; attribute it to the last token
 
+    def _spec_gauges(self) -> dict[str, float]:
+        """Speculation gauges riding every metrics snapshot (registered
+        iff `speculative` — the present-iff-enabled key-surface contract
+        of the paged/observatory gauges)."""
+        m = self.metrics
+        rate = (m.spec_accepted / m.spec_proposed) if m.spec_proposed else 0.0
+        per_step = (m.spec_tokens / m.spec_steps) if m.spec_steps else 0.0
+        return {
+            "serve/spec_acceptance_rate": rate,
+            "serve/spec_tokens_per_step": per_step,
+            "serve/spec_drafts_rejected": float(
+                m.spec_proposed - m.spec_accepted
+            ),
+        }
+
+    def _spec_block(self, probe: bool = False) -> list[Request]:
+        """One speculative decode step: `spec_rounds` draft-verify rounds
+        in ONE program call, committing a variable number of tokens per
+        slot. The host walk mirrors `_decode_block`'s exactly — per
+        committed token: append, grammar advance, logprobs, stop checks —
+        so every lifecycle behavior (EOS/budget/stop-string/cancel/
+        timeout, overshoot discard) is identical; only the token source
+        changed. Grammar-constrained slots keep ONE token per step (their
+        allow-mask is stale after the first draw): they ride the same
+        program draft-free and the host takes round 0's first commit.
+
+        `probe` runs the controller's short measurement block (a couple
+        of rounds) instead of the full one — cheap acceptance evidence
+        after a hold, so adversarial traffic pays a fraction of a block,
+        not a full chunked block, per probe."""
+        cfg = self.config
+        k = self._spec_k
+        rounds = min(2, self._spec_rounds) if probe else self._spec_rounds
+        mtp = self._spec == "mtp"
+        if self._paged:
+            # cover the worst-case committed window (every round sweeps);
+            # reclaim preempts youngest-first under pressure as usual
+            self._cover_decode(min(rounds * (k + 1), cfg.max_len))
+            if self.pool.n_active == 0:
+                return []
+        acap = cfg.sample_cap
+        if mtp:
+            rows = 10 + acap + k
+        else:
+            rows = (11 + acap + cfg.max_len
+                    + (self.pool.pages_per_lane if self._paged else 0))
+        state = np.zeros((rows, cfg.n_slots), np.int32)
+        state[0] = self._toks
+        state[1] = self._pos
+        state[3] = -1
+        for slot, r in enumerate(self._slot_req):
+            if r is None:
+                continue
+            state[2, slot] = 1
+            if r.eos_id is not None:
+                state[3, slot] = r.eos_id
+            state[7, slot] = len(r.tokens)
+            if r.grammar is not None:
+                # constrained slots never draft (spec gate stays 0) and
+                # refresh their allow row exactly like the plain block
+                self._allow[slot] = self._grammar_allow(r)
+            else:
+                state[9 + acap, slot] = 1
+            if not mtp:
+                # the slot's token history — the n-gram drafter's corpus
+                # — rides the packed transfer, one column per slot
+                seq = np.concatenate(
+                    [r.prompt, np.asarray(r.tokens, np.int32)]
+                )
+                m = min(int(seq.size), cfg.max_len)
+                state[10 + acap:10 + acap + m, slot] = seq[:m]
+                state[10 + acap + cfg.max_len, slot] = m
+        state[4] = self._rng_step
+        state[5] = self._top_k
+        state[6] = self._seed
+        state[8] = self._need_lp
+        state[9:9 + acap] = self._allow.T
+        if mtp:
+            state[10 + acap:10 + acap + k] = self._next_drafts.T
+        elif self._paged:
+            state[11 + acap + cfg.max_len:] = self.pool.table.T
+        self._rng_step += 1
+        tr = self.trace
+        t_dec = smetrics.now() if tr is not None else 0.0
+        if mtp:
+            prog = _mtp_spec_decode_program
+            args = (self.model, k, rounds, acap, cfg.max_len,
+                    self.variables, self.pool.caches, self._mtp_pool,
+                    jnp.asarray(state), jnp.asarray(self._samp_f),
+                    self._rng)
+            statics = (0, 1, 2, 3, 4)
+        else:
+            prog = (_paged_spec_decode_program if self._paged
+                    else _spec_decode_program)
+            args = (self.model, k, rounds, acap, cfg.max_len,
+                    cfg.spec_ngram, self.variables,
+                    self.pool.phys if self._paged else self.pool.caches,
+                    jnp.asarray(state), jnp.asarray(self._samp_f),
+                    self._rng)
+            statics = (0, 1, 2, 3, 4, 5)
+        with self._scope("serve/spec_block"):
+            if self.registry is not None:
+                # one speculative decode shape per engine, exactly like
+                # decode_block — a second signature IS the anomaly
+                res = self.registry.call(
+                    "spec_block", (rounds, k), prog, args,
+                    static_argnums=statics,
+                )
+            else:
+                res = prog(*args)
+        if mtp:
+            self.pool.caches, self._mtp_pool, outs, nxt = res
+            # np.array, not asarray: the device view is read-only and
+            # the next admission writes its bootstrap drafts in place
+            self._next_drafts = np.array(nxt)
+        elif self._paged:
+            self.pool.phys, outs = res
+        else:
+            self.pool.caches, outs = res
+        out, commits, proposed, lps = outs
+        t_dev = 0.0
+        if tr is not None:
+            jax.block_until_ready(out)
+            t_dev = smetrics.now()
+            self._dev_s += t_dev - t_dec
+        out = np.asarray(out)          # (rounds, S, k+1)
+        commits = np.asarray(commits)  # (rounds, S)
+        proposed = np.asarray(proposed)
+        lps = np.asarray(lps)
+        now = smetrics.now()
+        finished: list[Request] = []
+        tot_prop = tot_acc = tot_rounds = 0
+        delivered = 0
+        max_appended = 0
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            if tr is not None:
+                tr.complete("spec_block", "engine", f"slot{slot}",
+                            ts=t_dec, dur=t_dev - t_dec, req=req.id,
+                            rounds=rounds, k=k)
+            if req.cancelled:
+                self._finish(req, "cancelled", now)
+                finished.append(req)
+                self._notify(req, 0)
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._finish(req, "timeout", now)
+                finished.append(req)
+                self._notify(req, 0)
+                continue
+            appended = 0
+            reason = None
+            base = len(req.tokens)
+            grammar1 = req.grammar is not None
+            for r in range(rounds):
+                n = int(commits[r, slot])
+                if not grammar1:
+                    tot_prop += int(proposed[r, slot])
+                    tot_acc += max(n - 1, 0)
+                    tot_rounds += 1
+                # a grammar slot accepts only round 0's first commit —
+                # later rounds drew through a stale mask (overshoot,
+                # discarded exactly like the plain block's tail)
+                take = n if not grammar1 else (1 if r == 0 else 0)
+                for j in range(take):
+                    t = int(out[r, slot, j])
+                    req.tokens.append(t)
+                    if grammar1:
+                        req.grammar.advance(t)
+                    if req.params.logprobs:
+                        req.logprobs.append(float(lps[r, slot, j]))
+                    appended += 1
+                    reason = self._stop_reason(req, t)
+                    if grammar1 and req.grammar.done:
+                        reason = "stop"
+                    if reason is not None:
+                        break
+                if reason is not None:
+                    break
+            kk = self._stop_string_at(req, base)
+            if kk is not None:
+                last = len(req.tokens) - 1
+                if reason is None or kk < last or reason == "length":
+                    del req.tokens[kk + 1:]
+                    if req.params.logprobs:
+                        del req.logprobs[kk + 1:]
+                    appended -= last - kk
+                    reason = "stop"
+            self.metrics.record_tokens(
+                req, appended, now - self._last_emit[slot], now
+            )
+            self._last_emit[slot] = now
+            self.pool.positions[slot] += appended
+            delivered += appended
+            max_appended = max(max_appended, appended)
+            if reason is not None:
+                self._finish(req, reason, now)
+                finished.append(req)
+            else:
+                # an unfinished slot kept every commit, so the host
+                # mirrors track the device carry exactly (the device's
+                # internal position is rebuilt from these next call)
+                self._toks[slot] = req.tokens[-1]
+                self._pos[slot] += appended
+            self._notify(req, appended)
+        self.metrics.record_spec_step(tot_prop, tot_acc, delivered)
+        if tot_rounds:
+            self._spec_ctl.observe(tot_acc, tot_rounds)
+        self._tick_weight = max(1.0, max_appended / cfg.decode_block)
+        return finished
+
     def _decode_block(self) -> list[Request]:
+        if self._spec is not None:
+            decision = self._spec_ctl.decide()
+            if decision != "off":
+                return self._spec_block(probe=decision == "probe")
         cfg = self.config
         block = cfg.decode_block
         if self._paged:

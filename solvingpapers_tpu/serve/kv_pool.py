@@ -350,6 +350,55 @@ def scatter_written_pages(phys, lanes, table, pos):
     return jax.tree_util.tree_map(sc, phys, lanes)
 
 
+def pad_time(tree, extra: int):
+    """Append `extra` zeroed slots along the TIME axis (axis 1) of every
+    cache leaf (traced). The speculative decode programs (serve/engine.py)
+    pad each lane with ``spec_k + 1`` scratch slots before their
+    draft-verify rounds: a chunk write at time offset p spans
+    ``[p, p + k]``, and XLA's `dynamic_update_slice` CLAMPS an
+    out-of-range start — which would SHIFT the whole chunk left and
+    silently overwrite committed KV. With the scratch tail, every chunk
+    whose start is inside the real lane fits, and overshoot (post-EOS /
+    post-budget rounds, frozen at the lane end) lands in slack that
+    `strip_time` drops before the lanes go back to the pool."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.concatenate(
+            [a, jnp.zeros((a.shape[0], extra) + a.shape[2:], a.dtype)],
+            axis=1,
+        ),
+        tree,
+    )
+
+
+def strip_time(tree, extra: int):
+    """Drop the trailing `extra` time slots `pad_time` appended (traced)."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.slice_in_dim(a, 0, a.shape[1] - extra, axis=1),
+        tree,
+    )
+
+
+def scatter_window_pages(phys, lanes, table, start, last, span: int):
+    """Scatter each slot's written page window back to the pool (traced):
+    slot s wrote positions ``[start[s], last[s]]`` of its gathered lane
+    view — the speculative decode block's ACCEPTED window (``last`` is
+    the final committed position; rejected-draft garbage beyond it never
+    reaches the physical pool, so a paged spec engine's pool holds only
+    committed KV). `span` is the static per-slot window bound in tokens
+    (rounds x chunk width for the spec block); the page walk advances in
+    page-size steps clamped to ``last``, so trailing windows re-write the
+    last committed page with its own final content — idempotent. Slots
+    with nothing committed (``last < start``, inactive lanes) clamp to
+    `start`, whose table entry rests at the trash page."""
+    page = jax.tree_util.tree_leaves(phys)[0].shape[1]
+    limit = table.shape[1] * page - 1
+    last = jnp.maximum(last, start)
+    for w in range((span - 1) // page + 2):
+        pos_w = jnp.clip(jnp.minimum(start + w * page, last), 0, limit)
+        phys = scatter_written_pages(phys, lanes, table, pos_w)
+    return phys
+
+
 TRASH_PAGE = 0  # physical page 0: reserved write sink, never allocated
 
 

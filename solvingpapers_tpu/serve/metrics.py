@@ -29,6 +29,18 @@ a gauge provider, same mechanism as the observatory below):
                                present iff any occurred, with
     serve/recompute_tokens     the tokens re-prefilled by those resumes
 
+Speculative-decoding gauges (serve/spec.py; present iff
+`ServeConfig.speculative` — the engine registers a gauge provider, the
+same mechanism as the paged-pool and observatory gauges):
+
+    serve/spec_acceptance_rate   drafts accepted / drafts proposed
+                                 (lifetime; 0 before any proposal)
+    serve/spec_tokens_per_step   tokens committed per speculative decode
+                                 step (1 per round = speculation idle;
+                                 up to rounds x (1 + spec_k) per slot)
+    serve/spec_drafts_rejected   drafts that failed verification
+                                 (cumulative)
+
 Prefix-cache counters (serve/prefix_cache.py; present when the engine's
 prefix cache is on):
 
@@ -95,6 +107,10 @@ class ServeMetrics:
         self.prefix_bytes_held = 0
         self.preemptions = 0
         self.recompute_tokens = 0
+        self.spec_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_tokens = 0
         self._t_first: float | None = None
         self._t_last: float | None = None
         # zero-arg dict providers merged into every snapshot — how the
@@ -177,6 +193,18 @@ class ServeMetrics:
         """A paged-pool request lost its slot to page exhaustion (it will
         recompute on re-admission)."""
         self.preemptions += 1
+
+    def record_spec_step(self, proposed: int, accepted: int,
+                         delivered: int) -> None:
+        """One speculative decode step: `proposed` drafts went into the
+        draft-verify rounds, `accepted` of them survived verification,
+        and `delivered` tokens were committed to streams (the engine's
+        gauge provider derives serve/spec_* from these — present iff
+        speculation is enabled)."""
+        self.spec_steps += 1
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self.spec_tokens += delivered
 
     def record_recompute_tokens(self, n: int) -> None:
         """Prompt+stream tokens re-prefilled by a preempted request's

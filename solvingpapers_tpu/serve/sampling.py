@@ -236,6 +236,33 @@ def slot_keys(base, step_tag, seeds, samp_idx):
     )(slots, seeds, samp_idx)
 
 
+def capped_support(logits32, packed: PackedSampling, *, cap: int,
+                   allow=None):
+    """The bounded-support truncation pipeline shared by `fused_sample`
+    and the speculative verifier (`serve.spec.spec_verify`): one
+    `lax.top_k` into the `cap`-token domain, the optional per-row grammar
+    allow-swap (`ops.allowed_logits`; a row whose first entry is >= 0 is
+    constrained), temperature scaling (greedy rows scale by 1 — their
+    token comes from argmax, the scale only keeps the masked row finite),
+    then the shared top-k/top-p/min-p masks. Returns ``(masked, top_idx)``
+    — the -inf-masked scaled support values and their vocab ids. ONE
+    implementation, so the speculative path's per-position distributions
+    cannot drift from what the plain path samples."""
+    top_vals, top_idx = jax.lax.top_k(logits32, cap)
+    if allow is not None:
+        constrained = allow[..., 0] >= 0
+        a_vals, a_idx = ops.allowed_logits(logits32, allow)
+        top_vals = jnp.where(constrained[..., None], a_vals, top_vals)
+        top_idx = jnp.where(constrained[..., None], a_idx, top_idx)
+    greedy = packed.temperature <= 0.0
+    temp = jnp.where(greedy, 1.0, packed.temperature)[:, None]
+    scaled = top_vals / temp
+    masked = ops.top_k_mask(scaled, packed.top_k[:, None])
+    masked = ops.top_p_mask(masked, packed.top_p[:, None])
+    masked = ops.min_p_mask(masked, packed.min_p[:, None])
+    return masked, top_idx
+
+
 def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64,
                  allow=None):
     """Sample one token per slot under per-slot params; returns
@@ -294,22 +321,17 @@ def fused_sample(logits, packed: PackedSampling, rngs, *, cap: int = 64,
         return jnp.argmax(logits32, axis=-1).astype(jnp.int32)
 
     def _mixed():
-        top_vals, top_idx = jax.lax.top_k(logits32, cap)  # sorted desc
+        masked, top_idx = capped_support(logits32, packed, cap=cap,
+                                         allow=allow)
         greedy_tok = _all_greedy()
         if allow is not None:
-            a_vals, a_idx = ops.allowed_logits(logits32, allow)
-            top_vals = jnp.where(constrained[:, None], a_vals, top_vals)
-            top_idx = jnp.where(constrained[:, None], a_idx, top_idx)
             # greedy under a constraint = argmax over the allowed domain
+            # (the masks never drop a row's argmax, so the masked argmax
+            # is the domain argmax)
             dom = jnp.take_along_axis(
-                top_idx, jnp.argmax(top_vals, axis=-1)[:, None], axis=-1
+                top_idx, jnp.argmax(masked, axis=-1)[:, None], axis=-1
             )[:, 0]
             greedy_tok = jnp.where(constrained, dom, greedy_tok)
-        temp = jnp.where(greedy, 1.0, packed.temperature)[:, None]
-        scaled = top_vals / temp
-        masked = ops.top_k_mask(scaled, packed.top_k[:, None])
-        masked = ops.top_p_mask(masked, packed.top_p[:, None])
-        masked = ops.min_p_mask(masked, packed.min_p[:, None])
         sel = jax.vmap(
             lambda row, key: jax.random.categorical(key, row)
         )(masked, rngs)

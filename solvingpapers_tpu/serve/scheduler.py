@@ -86,7 +86,10 @@ class Request:
     logprobs: list[float] = dataclasses.field(default_factory=list)
     finish_reason: str | None = None
     slot: int | None = None
-    waited_steps: int = 0
+    # accumulated wait, in BLOCK-EQUIVALENTS of delivered tokens (see
+    # tick): a plain engine iteration ages it by 1; a speculative step
+    # that committed w blocks' worth of tokens ages it by w
+    waited_steps: float = 0.0
     # absolute deadline on the engine clock (serve.metrics.now), or None
     deadline: float | None = None
     # set by engine.cancel on an ACTIVE request; the lane is freed (and
@@ -245,7 +248,22 @@ class FIFOScheduler:
         except ValueError:
             return False
 
-    def tick(self) -> None:
-        """One engine iteration elapsed for everything still queued."""
+    def tick(self, weight: float = 1.0) -> None:
+        """One engine iteration elapsed for everything still queued.
+
+        `weight` is the iteration's age in BLOCK-EQUIVALENTS of delivered
+        tokens (the engine passes ``max per-slot delivered tokens /
+        decode_block``, floored at 1). Plain decode blocks deliver at
+        most one block per slot per iteration, so their weight is exactly
+        1 and the historical steps == iterations semantics is unchanged.
+        A SPECULATIVE step can deliver several blocks' worth of tokens in
+        one iteration; without the weight, a high-acceptance batch would
+        age the waiting queue one tick per many-block steps — the
+        anti-starvation budget would be worth MORE delivered work the
+        better speculation goes, starving the queue head exactly when the
+        engine is at its fastest (regression-pinned in
+        tests/test_spec.py)."""
+        if weight < 1.0:
+            weight = 1.0
         for req in self.queue:
-            req.waited_steps += 1
+            req.waited_steps += weight

@@ -157,6 +157,47 @@ def test_page_gauges_present_iff_paged_engine():
         assert PrometheusTextWriter.sanitize(k).startswith("serve_")
 
 
+def test_spec_gauges_present_iff_speculation_enabled():
+    """serve/spec_* appear exactly when the engine speculates (gauge
+    provider registered iff ServeConfig.speculative) and track the
+    acceptance accounting."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    plain = ServeEngine(model, params, ServeConfig(n_slots=2, max_len=32))
+    assert not any(k.startswith("serve/spec_")
+                   for k in plain.metrics.snapshot())
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        speculative="ngram", spec_k=2, spec_rounds=2,
+    ))
+    snap = eng.metrics.snapshot()
+    assert snap["serve/spec_acceptance_rate"] == 0.0
+    assert snap["serve/spec_tokens_per_step"] == 0.0
+    h = eng.submit(np.tile(np.asarray([3, 9], np.int32), 5),
+                   max_new_tokens=12)
+    eng.run()
+    assert h.done
+    end = eng.metrics.snapshot()
+    assert end["serve/spec_tokens_per_step"] > 0
+    assert 0.0 <= end["serve/spec_acceptance_rate"] <= 1.0
+    assert end["serve/spec_drafts_rejected"] >= 0.0
+    # the /statusz spec section mirrors the same accounting
+    spec = eng.statusz()["spec"]
+    assert spec["drafter"] == "ngram" and spec["steps"] > 0
+    for k in ("serve/spec_acceptance_rate", "serve/spec_tokens_per_step",
+              "serve/spec_drafts_rejected"):
+        assert PrometheusTextWriter.sanitize(k).startswith("serve_")
+
+
 # ------------------------------------- observatory gauges (mem/compile)
 
 

@@ -19,10 +19,14 @@ overwrites it with the Poisson entry; re-run with
 temperature/top-p/top-k/min-p vs all-greedy on the same trace), and
 with `--paged --append` for the paged-KV-pool workload (ABBA-paired
 paged vs lane throughput, equal-HBM capacity arm, zero-copy
-shared-prefix TTFT), and with `--http --append` for the HTTP soak
+shared-prefix TTFT), with `--http --append` for the HTTP soak
 (the Poisson trace as N concurrent SSE clients through the OpenAI
 front door, ABBA-paired against direct engine.submit: req/s,
-client-side TTFT/p99 ITL, http_overhead_pct, stream_token_exact).
+client-side TTFT/p99 ITL, http_overhead_pct, stream_token_exact), and
+with `--speculative --append` for the speculative-decoding workload
+(spec-on vs spec-off delivered tokens/sec on a briefly-trained model,
+greedy token-exactness, acceptance rate, and the temperature-2.0
+zero-acceptance adversarial overhead).
 
 Add `--trace` to any workload to run one extra flight-recorded arm: the
 entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
@@ -50,9 +54,15 @@ def main() -> int:
         # --paged shares --shared-prefix's reasoning for its prefix
         # sub-arm: the 256-position config's long stems are the regime
         # where the hit-TTFT claim is measured
-        default = ("gpt_shakespeare"
-                   if ("--shared-prefix" in argv or "--paged" in argv)
-                   else "llama3_shakespeare")
+        # --speculative trains the model briefly before benching (draft
+        # quality is the mechanism) — gpt_tiny fits a few hundred steps
+        # in seconds
+        if "--speculative" in argv:
+            default = "gpt_tiny_long"
+        elif "--shared-prefix" in argv or "--paged" in argv:
+            default = "gpt_shakespeare"
+        else:
+            default = "llama3_shakespeare"
         argv += ["--config", default]
     if not any(a == "--out" or a.startswith("--out=") for a in argv):
         argv += ["--out", "BENCH_serve.json"]
