@@ -478,6 +478,9 @@ def cmd_serve(args) -> int:
         bucket=min(args.bucket, max_len),
         sample_cap=args.sample_cap,
         paged=args.paged,
+        kv_quant=args.kv_quant,
+        kv_quant_block=args.kv_quant_block,
+        kv_exact_lanes=args.kv_exact_lanes,
         speculative=args.speculative,
         spec_k=args.spec_k,
         spec_rounds=args.spec_rounds,
@@ -527,15 +530,17 @@ def cmd_serve_bench(args) -> int:
         )
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
-            args.speculative)) > 1:
-        print("--shared-prefix, --sampling, --paged, --http and "
-              "--speculative are separate workloads; pick one per run",
+            args.speculative, args.kv_quant is not None)) > 1:
+        print("--shared-prefix, --sampling, --paged, --http, "
+              "--speculative and --kv-quant are separate workloads; "
+              "pick one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
         run_http_bench,
         run_paged_bench,
         run_prefix_bench,
+        run_quant_bench,
         run_sampling_bench,
         run_serve_bench,
         run_spec_bench,
@@ -564,7 +569,23 @@ def cmd_serve_bench(args) -> int:
         status_port=args.status_port,
         status_hold_s=args.status_hold_s,
     )
-    if args.speculative:
+    if args.kv_quant:
+        result = run_quant_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
+            page_size=args.page_size,
+            kv_quant_block=args.kv_quant_block,
+            train_steps=args.quant_train_steps,
+            seed=args.seed,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.speculative:
         result = run_spec_bench(
             config=args.config,
             n_requests=n_requests,
@@ -926,6 +947,27 @@ def main(argv=None) -> int:
                               "2.0 zero-acceptance adversarial arm "
                               "(serve/bench.py run_spec_bench; defaults "
                               "max-new-tokens 160, decode-block 8)")
+    p_serve.add_argument("--kv-quant", default=None, choices=["int8"],
+                         help="quantized-KV workload instead: int8 cache "
+                              "storage vs exact on a briefly-trained "
+                              "model — greedy-token agreement (teacher-"
+                              "forced, the >= 0.99 CI gate), ABBA-paired "
+                              "like-for-like Poisson overhead, and a "
+                              "capacity arm booking slots at the f32 "
+                              "paged pool's resident byte budget "
+                              "(serve/bench.py run_quant_bench; defaults "
+                              "config gpt_tiny_long)")
+    p_serve.add_argument("--kv-quant-block", type=int, default=16,
+                         help="[--kv-quant] lane-pool absmax-scale block "
+                              "length in tokens "
+                              "(ServeConfig.kv_quant_block; the paged "
+                              "pool always scales per page)")
+    p_serve.add_argument("--quant-train-steps", type=int, default=200,
+                         help="[--kv-quant] brief training steps before "
+                              "benching (agreement on a random-init "
+                              "model measures argmax tie-breaking over "
+                              "near-uniform logits, not quantization "
+                              "quality; 0 = random init)")
     p_serve.add_argument("--spec-k", type=int, default=16,
                          help="[--speculative] draft tokens per round "
                               "(ServeConfig.spec_k)")
@@ -1012,6 +1054,22 @@ def main(argv=None) -> int:
     p_srv.add_argument("--max-waiting", type=int, default=256)
     p_srv.add_argument("--paged", action="store_true",
                        help="serve over the paged KV pool")
+    p_srv.add_argument("--kv-quant", default=None, choices=["int8"],
+                       help="hold the KV pool as symmetric int8 with "
+                            "per-block absmax scales (~half the resident "
+                            "KV bytes vs bf16, a quarter vs f32; output "
+                            "quality gated by the bench's measured "
+                            "greedy-agreement rate, not exactness)")
+    p_srv.add_argument("--kv-quant-block", type=int, default=16,
+                       help="[--kv-quant] lane-pool scale block length "
+                            "in tokens (must divide max-len; the paged "
+                            "pool scales per page)")
+    p_srv.add_argument("--kv-exact-lanes", type=int, default=0,
+                       help="[--kv-quant] full-precision sidecar lanes "
+                            "for SamplingParams.kv_exact requests "
+                            "(byte-identical streams inside the "
+                            "quantized engine; 0 rejects kv_exact "
+                            "submissions)")
     p_srv.add_argument("--speculative", default=None,
                        choices=["ngram", "mtp"],
                        help="speculative decoding: n-gram prompt-lookup "

@@ -36,6 +36,13 @@ from solvingpapers_tpu.ops.losses import (
     vae_loss,
     mtp_loss,
 )
+from solvingpapers_tpu.ops.quant import (
+    quantize,
+    dequantize,
+    quantize_tree,
+    dequantize_tree,
+    scale_shape,
+)
 from solvingpapers_tpu.ops.sampling import (
     sample_greedy,
     sample_categorical,

@@ -120,10 +120,22 @@ from solvingpapers_tpu.serve.grammar import encode_allow
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
     PagedKVPool,
+    QuantStore,
     extract_lane,
     gather_lane,
     gather_lanes,
     pad_time,
+    quant_gather_lane,
+    quant_gather_lanes,
+    quant_lane_view,
+    quant_lanes_view,
+    quant_pool_bytes,
+    quant_scatter_lane_pages,
+    quant_scatter_window_pages,
+    quant_scatter_written_pages,
+    quant_store_exact_lanes,
+    quant_store_lane,
+    quant_store_written,
     scatter_lane_pages,
     scatter_window_pages,
     scatter_written_pages,
@@ -237,6 +249,45 @@ class ServeConfig:
     paged: bool = False
     page_size: int | None = None
     page_budget: int | None = None
+    # Quantized KV storage (ops/quant.py + serve/kv_pool.py QuantStore):
+    # the pool holds symmetric int8 payload + per-block f32 absmax
+    # scales instead of the compute dtype — roughly HALF the resident KV
+    # bytes (vs bf16; a quarter vs f32), i.e. ~2x the servable slots or
+    # context at the same HBM budget (the serve-bench --kv-quant
+    # capacity arm measures it). The jitted programs dequantize on read
+    # (gather/extract sites materialize the familiar compute-dtype lane
+    # view — models serve unmodified) and quantize on write (store/
+    # scatter sites requantize only the blocks/pages the step wrote).
+    # Output quality is gated on MEASUREMENT, not exactness: the bench
+    # records a greedy-token agreement rate vs the full-precision pool
+    # per BENCH_serve.json entry (>= 0.99 is the CI gate).
+    #   kv_quant        None = exact storage (today's pools, untouched
+    #                   code paths); "int8" = quantized payload + scale
+    #                   sidecar in BOTH pool layouts. The prefix cache
+    #                   stores int8 pages/segments + scales (sharing
+    #                   stays zero-copy on the paged pool — scales ride
+    #                   the page ids). Excludes speculative="mtp" (its
+    #                   head-cache lanes are a separate follow-on).
+    #   kv_quant_block  lane-pool scale granularity: one f32 absmax
+    #                   scale per (slot, kv_quant_block tokens, head)
+    #                   — must divide max_len (and prefix_page when the
+    #                   lane-pool prefix cache is on). The paged pool
+    #                   always scales per (page, head) so scales ride
+    #                   the page tables.
+    #   kv_exact_lanes  per-request escape hatch capacity: a request
+    #                   with SamplingParams.kv_exact serves from one of
+    #                   this many full-precision sidecar lanes (plus a
+    #                   trash lane), byte-identical to the unquantized
+    #                   engine, INSIDE the same compiled programs as
+    #                   quantized traffic (the lane index rides the
+    #                   packed control rows). 0 (default) books no
+    #                   sidecar — pure capacity win — and kv_exact
+    #                   submissions are rejected. Exact requests bypass
+    #                   the (quantized) prefix cache and never consume
+    #                   pages.
+    kv_quant: str | None = None
+    kv_quant_block: int = 16
+    kv_exact_lanes: int = 0
     # Speculative decoding (serve/spec.py): per-slot draft-and-verify
     # inside the decode program. Each decode step runs `spec_rounds`
     # draft-verify rounds: a drafter proposes up to `spec_k` tokens per
@@ -443,9 +494,20 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
     slot [0, start + end_i). `start=0` is a full prefill. Static because
     `attend_len` drives a static slice; start values are page multiples,
     keeping the compiled inventory bounded.
+
+    Quantized pools (`caches` a `QuantStore` — a TRACE-TIME branch, so
+    the unquantized program graph is untouched): the lane view is
+    dequantized out of the slot's int8 + scale rows (or substituted from
+    the exact sidecar for a kv_exact slot — ``ctl[-1]`` carries the
+    exact-lane index), and the store requantizes exactly the written
+    span [start, start + padded) — spliced prefix blocks below `start`
+    keep their producer's bytes.
     """
     slot, length = ctl[0], ctl[1]
-    lane = extract_lane(caches, slot)
+    quant = isinstance(caches, QuantStore)
+    eidx = ctl[-1] if quant else None
+    lane = (quant_lane_view(caches, slot, eidx) if quant
+            else extract_lane(caches, slot))
     lane, last = _prefill_lane(model, padded, chunk, start, variables,
                                lane, prompt, length)
     packed = PackedSampling(
@@ -456,7 +518,12 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
                       samp_idx=jnp.int32(0))
     first, logprob = fused_sample(last[None], packed, key[None], cap=cap,
                                   allow=ctl[6:6 + cap][None, :])
-    return store_lane(caches, lane, slot), first[0], logprob[0]
+    if quant:
+        caches = quant_store_lane(caches, lane, slot, eidx, start,
+                                  start + padded, hi=start + length)
+    else:
+        caches = store_lane(caches, lane, slot)
+    return caches, first[0], logprob[0]
 
 
 @functools.partial(
@@ -480,10 +547,22 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     [0, start // page) hold SHARED prefix KV the gather materializes
     into the lane view; the scatter starts at `start // page` (static),
     so shared pages are read, never written — the zero-device-copy hit
-    the refcount design exists for."""
+    the refcount design exists for.
+
+    Quantized pools: the gather dequantizes int8 pages through their
+    per-(page, head) scale rows (both ride the same page-table
+    translation), a kv_exact slot's view comes whole from the exact
+    sidecar (its table rests at trash — exact streams never own pages),
+    and the scatter re-quantizes only the written pages."""
     slot, length = ctl[0], ctl[1]
-    row = ctl[6 + cap:]
-    lane = gather_lane(phys, row)
+    quant = isinstance(phys, QuantStore)
+    if quant:
+        eidx = ctl[-1]
+        row = ctl[6 + cap:-1]
+        lane = quant_gather_lane(phys, row, eidx)
+    else:
+        row = ctl[6 + cap:]
+        lane = gather_lane(phys, row)
     lane, last = _prefill_lane(model, padded, chunk, start, variables,
                                lane, prompt, length)
     packed = PackedSampling(
@@ -494,8 +573,13 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
                       samp_idx=jnp.int32(0))
     first, logprob = fused_sample(last[None], packed, key[None], cap=cap,
                                   allow=ctl[6:6 + cap][None, :])
-    page = jax.tree_util.tree_leaves(phys)[0].shape[1]
-    phys = scatter_lane_pages(phys, lane, row, start // page)
+    if quant:
+        page = jax.tree_util.tree_leaves(phys.q)[0].shape[1]
+        phys = quant_scatter_lane_pages(phys, lane, row, start // page,
+                                        eidx, hi=start + length)
+    else:
+        page = jax.tree_util.tree_leaves(phys)[0].shape[1]
+        phys = scatter_lane_pages(phys, lane, row, start // page)
     return phys, first[0], logprob[0]
 
 
@@ -541,6 +625,19 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
         need_lp=state[8],
     )
+    # quantized pools (trace-time branch; the plain graph is untouched):
+    # the scan carries the DEQUANTIZED (S, max_len, ...) lane view —
+    # within-block reads are full precision, quantization happens at the
+    # block boundary — and the store requantizes only the blocks each
+    # slot's write window [pos0, pos0 + block) touched. state[-1] is the
+    # per-slot exact-lane index row.
+    quant = isinstance(caches, QuantStore)
+    if quant:
+        eidx = state[-1]
+        pos0 = pos
+        lanes = quant_lanes_view(caches, eidx)
+    else:
+        lanes = caches
 
     def one(tok, p, slot_caches):
         lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
@@ -553,8 +650,8 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         )
 
     def step(carry, _):
-        toks, pos, samp_idx, caches = carry
-        logits, caches = jax.vmap(one)(toks, pos, caches)
+        toks, pos, samp_idx, lanes = carry
+        logits, lanes = jax.vmap(one)(toks, pos, lanes)
         keys = slot_keys(rng, step_tag, seeds, samp_idx)
         nxt, logprob = fused_sample(logits, packed, keys, cap=cap,
                                     allow=allow)
@@ -563,11 +660,15 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
         nxt = jnp.where(active, nxt, toks)
         pos = jnp.where(active, pos + 1, pos)
-        return (nxt, pos, samp_idx + 1, caches), (nxt, logprob)
+        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob)
 
-    (toks, pos, _, caches), out = jax.lax.scan(
-        step, (toks, pos, state[7], caches), None, length=block
+    (toks, pos, _, lanes), out = jax.lax.scan(
+        step, (toks, pos, state[7], lanes), None, length=block
     )
+    if quant:
+        caches = quant_store_written(caches, lanes, pos0, block, eidx)
+    else:
+        caches = lanes
     return caches, out
 
 
@@ -609,13 +710,20 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     active, eos = state[2].astype(bool), state[3]
     step_tag, seeds = state[4, 0], state[6]
     allow = state[9:9 + cap].T  # (S, cap)
-    table = state[9 + cap:].T  # (S, pages_per_lane)
+    quant = isinstance(phys, QuantStore)
+    if quant:
+        # the exact-lane index row rides after the page tables
+        table = state[9 + cap:-1].T  # (S, pages_per_lane)
+        eidx = state[-1]
+        lanes = quant_gather_lanes(phys, table, eidx)
+    else:
+        table = state[9 + cap:].T  # (S, pages_per_lane)
+        lanes = gather_lanes(phys, table)
     pos0 = pos
     packed = PackedSampling(
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
         need_lp=state[8],
     )
-    lanes = gather_lanes(phys, table)
 
     def one(tok, p, slot_caches):
         lane = jax.tree_util.tree_map(lambda a: a[None], slot_caches)
@@ -643,14 +751,22 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     (toks, pos, _, lanes), out = jax.lax.scan(
         step, (toks, pos, state[7], lanes), None, length=block
     )
-    page = jax.tree_util.tree_leaves(phys)[0].shape[1]
+    page = jax.tree_util.tree_leaves(phys.q if quant else phys)[0].shape[1]
     # static window bound: positions [p, p + block) touch at most this
     # many pages; windows clipped past the lane end rewrite the last
     # page with its own (final) content — idempotent by construction
     for w in range((block - 1) // page + 2):
-        phys = scatter_written_pages(phys, lanes, table,
-                                     jnp.clip(pos0 + w * page, 0,
-                                              table.shape[1] * page - 1))
+        pos_w = jnp.clip(pos0 + w * page, 0, table.shape[1] * page - 1)
+        if quant:
+            # only [pos0, pos0 + block) came from this block's writes;
+            # the rest of each touched page re-encodes from its own f32
+            # codes (bf16 lane round-trips would drift committed entries)
+            phys = quant_scatter_written_pages(phys, lanes, table, pos_w,
+                                               lo=pos0, hi=pos0 + block)
+        else:
+            phys = scatter_written_pages(phys, lanes, table, pos_w)
+    if quant:
+        phys = quant_store_exact_lanes(phys, lanes, eidx)
     return phys, out
 
 
@@ -815,15 +931,40 @@ def _spec_decode_program(model, k, rounds, cap, max_len, nmax, variables,
     slot's token HISTORY transposed (prompt + committed tokens — the
     n-gram drafter's corpus) and the final row its live length. The
     history rides the same packed int transfer, so a speculative decode
-    call is still two host->device control arrays."""
-    lanes = pad_time(caches, k + 1)
+    call is still two host->device control arrays. Quantized pools add
+    the exact-lane index row LAST: the rounds run over the dequantized
+    (padded) lane view and the store requantizes each slot's written
+    window — rejected-draft garbage past the committed tail lands in
+    blocks that are overwritten before they are ever attended, the same
+    stale-lane contract as the plain program."""
+    quant = isinstance(caches, QuantStore)
+    if quant:
+        eidx = state[-1]
+        pos0 = state[1]
+        views = quant_lanes_view(caches, eidx)
+    else:
+        views = caches
+    lanes = pad_time(views, k + 1)
     hist = state[10 + cap:10 + cap + max_len].T
     hlen = state[10 + cap + max_len]
     lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
         model, k, rounds, cap, max_len, nmax, variables, lanes, state,
         samp, rng, hist=hist, hlen=hlen,
     )
-    return strip_time(lanes, k + 1), (out, commits, proposed, lps)
+    views = strip_time(lanes, k + 1)
+    if quant:
+        # bound the requantized window by the DEVICE-committed count
+        # (mirrors the paged path's `last`): draft positions past it
+        # hold rejected draws whose outliers would coarsen the whole
+        # block's scale for the committed tokens sharing it
+        total = commits.sum(axis=0)
+        caches = quant_store_written(caches, views, pos0,
+                                     rounds * (k + 1), eidx,
+                                     hi=pos0 + jnp.maximum(total, 1),
+                                     tail_garbage=True)
+    else:
+        caches = views
+    return caches, (out, commits, proposed, lps)
 
 
 @functools.partial(
@@ -847,11 +988,18 @@ def _paged_spec_decode_program(model, k, rounds, cap, max_len, nmax,
     slot's attend window and is rewritten before it is ever attended —
     do NOT share or snapshot pages past a slot's host-accepted length."""
     base = 11 + cap + max_len
-    table = state[base:].T  # (S, pages_per_lane)
+    quant = isinstance(phys, QuantStore)
+    if quant:
+        table = state[base:-1].T  # (S, pages_per_lane)
+        eidx = state[-1]
+        gathered = quant_gather_lanes(phys, table, eidx)
+    else:
+        table = state[base:].T  # (S, pages_per_lane)
+        gathered = gather_lanes(phys, table)
     hist = state[10 + cap:10 + cap + max_len].T
     hlen = state[10 + cap + max_len]
     pos0 = state[1]
-    lanes = pad_time(gather_lanes(phys, table), k + 1)
+    lanes = pad_time(gathered, k + 1)
     lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
         model, k, rounds, cap, max_len, nmax, variables, lanes, state,
         samp, rng, hist=hist, hlen=hlen,
@@ -859,8 +1007,13 @@ def _paged_spec_decode_program(model, k, rounds, cap, max_len, nmax,
     lanes = strip_time(lanes, k + 1)
     total = commits.sum(axis=0)
     last = jnp.minimum(pos0 + jnp.maximum(total, 1) - 1, max_len - 1)
-    phys = scatter_window_pages(phys, lanes, table, pos0, last,
-                                rounds * (k + 1))
+    if quant:
+        phys = quant_scatter_window_pages(phys, lanes, table, pos0, last,
+                                          rounds * (k + 1))
+        phys = quant_store_exact_lanes(phys, lanes, eidx)
+    else:
+        phys = scatter_window_pages(phys, lanes, table, pos0, last,
+                                    rounds * (k + 1))
     return phys, (out, commits, proposed, lps)
 
 
@@ -1087,6 +1240,51 @@ class ServeEngine:
         self._profiling = False
         self._profile_done = cfg.profile_dir is None
         self._paged = cfg.paged
+        # quantized KV storage (ops/quant.py; see the ServeConfig knob
+        # block): the pool payload becomes int8 + per-block scales, the
+        # jitted programs dequantize on read / quantize on write, and
+        # kv_exact requests ride full-precision sidecar lanes inside the
+        # same compiled programs
+        self._quant = cfg.kv_quant is not None
+        if cfg.kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be 'int8' or None, got {cfg.kv_quant!r}"
+            )
+        if cfg.kv_quant_block < 1:
+            raise ValueError(
+                f"kv_quant_block must be >= 1, got {cfg.kv_quant_block}"
+            )
+        if cfg.kv_exact_lanes < 0:
+            raise ValueError(
+                f"kv_exact_lanes must be >= 0, got {cfg.kv_exact_lanes}"
+            )
+        if cfg.kv_exact_lanes and not self._quant:
+            raise ValueError(
+                "kv_exact_lanes books full-precision sidecar lanes for "
+                "kv_exact requests inside a QUANTIZED pool, which needs "
+                "kv_quant set — an unquantized pool is exact everywhere "
+                "already, so the knob would silently do nothing"
+            )
+        if self._quant and cfg.speculative == "mtp":
+            raise ValueError(
+                "kv_quant with speculative='mtp' is unsupported: the MTP "
+                "drafter's head-cache lanes are a latent pool of their "
+                "own that the quantized store does not cover yet — use "
+                "speculative='ngram' (either pool) or drop kv_quant"
+            )
+        if (self._quant and cfg.prefix_cache and not cfg.paged
+                and cfg.prefix_page % cfg.kv_quant_block):
+            raise ValueError(
+                f"prefix_page {cfg.prefix_page} is not a multiple of "
+                f"kv_quant_block {cfg.kv_quant_block}: quantized lane "
+                "segments carry whole scale rows, so splice offsets "
+                "(page multiples) must be block-aligned"
+            )
+        # exact-lane sidecar bookkeeping (kv_exact requests): LIFO free
+        # list of lane ids [1, kv_exact_lanes]; 0 is the trash lane a
+        # quantized slot's exact-side writes fall into
+        self._eidx = np.zeros(cfg.n_slots, np.int32)
+        self._exact_free = list(range(cfg.kv_exact_lanes, 0, -1))
         if cfg.paged:
             page = cfg.page_size or cfg.prefix_page
             if cfg.prefix_cache and page != cfg.prefix_page:
@@ -1099,7 +1297,8 @@ class ServeEngine:
                 )
             self.pool = PagedKVPool(
                 model, cfg.n_slots, cfg.max_len, page,
-                page_budget=cfg.page_budget,
+                page_budget=cfg.page_budget, quant=cfg.kv_quant,
+                exact_lanes=cfg.kv_exact_lanes,
             )
         else:
             if cfg.page_size is not None or cfg.page_budget is not None:
@@ -1108,7 +1307,16 @@ class ServeEngine:
                     "need paged=True — on the lane pool they would "
                     "silently do nothing"
                 )
-            self.pool = KVSlotPool(model, cfg.n_slots, cfg.max_len)
+            self.pool = KVSlotPool(
+                model, cfg.n_slots, cfg.max_len, quant=cfg.kv_quant,
+                quant_block=cfg.kv_quant_block,
+                exact_lanes=cfg.kv_exact_lanes,
+            )
+        if self._quant:
+            # kv-quant byte gauges ride every snapshot via the provider
+            # mechanism — present iff the pool is quantized, the same
+            # key-surface discipline as the paged/spec/observatory gauges
+            self.metrics.add_gauge_provider(self._kv_quant_gauges)
         # speculative decoding (serve/spec.py; see the ServeConfig knob
         # block): per-slot draft-and-verify rounds inside the decode
         # program, with a host-side adaptive controller that falls back
@@ -1261,7 +1469,8 @@ class ServeEngine:
             max_wait_steps=cfg.max_wait_steps,
             prefer_cached=cfg.prefix_sched,
             prefix_lookup=self._match_len if self.prefix_cache else None,
-            can_admit=self._can_admit if cfg.paged else None,
+            can_admit=(self._can_admit
+                       if cfg.paged or self._exact_free else None),
             trace=self.trace,
         )
         self._slot_req: list[Request | None] = [None] * cfg.n_slots
@@ -1379,6 +1588,14 @@ class ServeEngine:
                 f"{self.config.sample_cap} — the engine samples inside the "
                 "top sample_cap logits; raise the cap (costlier decode "
                 "steps) or lower top_k"
+            )
+        if (params.kv_exact and self._quant
+                and not self.config.kv_exact_lanes):
+            raise ValueError(
+                "kv_exact requests need full-precision sidecar lanes on a "
+                "quantized pool — construct the engine with "
+                "ServeConfig.kv_exact_lanes >= 1 (on an unquantized "
+                "engine kv_exact is a no-op and always accepted)"
             )
         total = prompt.size + max_new_tokens
         limit = getattr(self.model, "max_positions", None)
@@ -1590,6 +1807,26 @@ class ServeEngine:
                 "fragmentation": self.pool.fragmentation,
                 "per_slot_pages": self.pool.n_alloc.tolist(),
             }
+        if self._quant:
+            pool = self.pool
+            store = pool.phys if self._paged else pool.caches
+            pool_bytes, scale_bytes, exact_bytes, base_bytes = \
+                quant_pool_bytes(store)
+            d["kv_quant"] = {
+                "mode": self.config.kv_quant,
+                "quant_block": pool.quant_block,
+                "kv_pool_bytes": pool_bytes + exact_bytes,
+                "quant_bytes": pool_bytes,
+                "scale_bytes": scale_bytes,
+                "exact_bytes": exact_bytes,
+                "baseline_bytes": base_bytes,
+                "bytes_ratio": round(pool_bytes / base_bytes, 4),
+                "exact_lanes": pool.exact_lanes,
+                "exact_lanes_free": len(self._exact_free),
+                "exact_slots": [
+                    i for i, e in enumerate(self._eidx) if e
+                ],
+            }
         if self._spec is not None:
             m = self.metrics
             d["spec"] = {
@@ -1687,6 +1924,36 @@ class ServeEngine:
             "serve/page_fragmentation": float(pool.fragmentation),
         }
 
+    def _kv_quant_gauges(self) -> dict[str, float]:
+        """Quantized-pool byte gauges riding every metrics snapshot
+        (registered iff `kv_quant` — the present-iff-enabled key-surface
+        contract of the paged/spec/observatory gauges). Byte math is
+        analytic (host-side shape sums), never a device read."""
+        pool = self.pool
+        store = pool.caches if not self._paged else pool.phys
+        pool_bytes, scale_bytes, exact_bytes, base_bytes = \
+            quant_pool_bytes(store)
+        out = {
+            # resident KV bytes per bookable token slot: the capacity
+            # price of one context token under this pool (int8 payload
+            # + scale sidecar; exact lanes are a fixed surcharge the
+            # *_exact_* gauges expose separately)
+            "serve/kv_bytes_per_token": pool_bytes / pool.token_capacity,
+            "serve/kv_quant_scale_bytes": float(scale_bytes),
+            # what the same payload would hold at the compute dtype,
+            # minus int8 + scales — the ledger-visible capacity win (the
+            # exact-lane sidecar is a separately-disclosed surcharge)
+            "serve/kv_quant_bytes_saved": float(base_bytes - pool_bytes),
+        }
+        if pool.exact_lanes:
+            out["serve/kv_quant_exact_lanes_free"] = float(
+                len(self._exact_free)
+            )
+            out["serve/kv_quant_exact_active"] = float(
+                pool.exact_lanes - len(self._exact_free)
+            )
+        return out
+
     def _page_need(self, req: Request) -> int:
         """Pages a waiting request needs to start: prefill coverage of
         its (resume-aware) sequence net of the cached-prefix hint, plus
@@ -1711,11 +1978,18 @@ class ServeEngine:
         return pool.pages_for(need) - matched // pool.page_size
 
     def _can_admit(self, req: Request) -> bool:
-        """The scheduler's page-budget admission gate (paged pools):
+        """The scheduler's capacity gate beyond free slots: paged pools
         admit while free pages cover the request's prompt + a decode
-        reservation. Free SLOTS alone no longer imply capacity — that is
-        what decouples slot count from max_seq."""
-        return self.pool.pages_free >= self._page_need(req)
+        reservation (free SLOTS alone no longer imply capacity — that is
+        what decouples slot count from max_seq); kv_exact requests on a
+        quantized pool instead need a free full-precision sidecar lane
+        (they never consume pages). Estimates can go stale across one
+        iteration's picks — `_admit`'s bail paths absorb over-admission."""
+        if self._quant and req.params.kv_exact:
+            return bool(self._exact_free)
+        if self._paged:
+            return self.pool.pages_free >= self._page_need(req)
+        return True
 
     def _unblock_head(self) -> None:
         """Shed prefix-tree page references for a page-starved queue
@@ -1736,6 +2010,8 @@ class ServeEngine:
                 or self.prefix_cache is None):
             return
         head = self.scheduler.queue[0]
+        if self._quant and head.params.kv_exact:
+            return  # blocked on exact lanes, not pages: the tree can't help
         shed = False
         while (not self._can_admit(head)
                and self.prefix_cache.evict_one()):
@@ -1773,6 +2049,8 @@ class ServeEngine:
         for r in self._slot_req:
             if r is None or r.slot in protect:
                 continue
+            if self._quant and r.params.kv_exact:
+                continue  # exact streams hold no pages: nothing to free
             if victim is None or r.admit_time > victim.admit_time:
                 victim = r
         if victim is None:
@@ -1800,6 +2078,9 @@ class ServeEngine:
         self._top_k[slot] = 0
         self._seed[slot] = -1
         self._need_lp[slot] = 0
+        if self._eidx[slot]:
+            self._exact_free.append(int(self._eidx[slot]))
+            self._eidx[slot] = 0
         self.pool.release(slot)
         req.slot = None
         self.scheduler.requeue_front(req)
@@ -1822,6 +2103,8 @@ class ServeEngine:
                 continue  # preempted by an earlier slot's reclaim
             slot = req.slot
             covered.add(slot)
+            if self._quant and req.params.kv_exact:
+                continue  # exact streams write sidecar lanes, not pages
             target = min(int(self._pos[slot]) + block, self.config.max_len)
             ok = self.pool.ensure(slot, target)
             while not ok:
@@ -1871,7 +2154,12 @@ class ServeEngine:
             seq = req.prompt
         length = int(seq.size)
         matched = 0
-        if self.prefix_cache is not None and length > 1:
+        # kv_exact streams bypass the (quantized) prefix cache entirely:
+        # a spliced int8 prefix would break their byte-exactness, and
+        # their sidecar lanes own no pages/segments the tree could share
+        exact = self._quant and req.params.kv_exact
+        use_pc = self.prefix_cache is not None and not exact
+        if use_pc and length > 1:
             match = self.prefix_cache.match(seq[: length - 1])
             matched = match.length
             if matched:
@@ -1917,7 +2205,8 @@ class ServeEngine:
 
         suffix = length - matched
         padded = self._bucketed(suffix, start=matched)
-        if self._paged and not self._ensure_pages(slot, matched + padded):
+        if (self._paged and not exact
+                and not self._ensure_pages(slot, matched + padded)):
             # pathological: even after shedding the whole tree and every
             # other stream the pool cannot cover this prefill. Hand the
             # pages and slot back and retry next iteration.
@@ -1927,12 +2216,26 @@ class ServeEngine:
             if req.deadline is not None:
                 self._waiting_deadlines += 1
             return False
-        # admission metrics AFTER the bail point above: a requeued-and-
+        eidx = 0
+        if exact:
+            if not self._exact_free:
+                # the admission gate's estimate went stale (several exact
+                # picks in one iteration): requeue and retry when a
+                # sidecar lane frees — the paged bail path's discipline
+                self.pool.release(slot)
+                req.slot = None
+                self.scheduler.requeue_front(req)
+                if req.deadline is not None:
+                    self._waiting_deadlines += 1
+                return False
+            eidx = self._exact_free.pop()
+            self._eidx[slot] = eidx
+        # admission metrics AFTER the bail points above: a requeued-and-
         # retried admission must not add a second queue-wait sample or
         # count its prefix lookup twice
         if not resumed:
             self.metrics.record_admit(req, now)
-        if self.prefix_cache is not None and length > 1:
+        if use_pc and length > 1:
             self.metrics.record_prefix_lookup(matched)
         chunk = self.config.prefill_chunk
         if chunk is None and padded > 4096:
@@ -1961,6 +2264,7 @@ class ServeEngine:
         ctl = np.concatenate(
             [head, self._allow[slot]]
             + ([self.pool.table[slot]] if self._paged else [])
+            + ([np.asarray([eidx], np.int32)] if self._quant else [])
         )
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
@@ -2020,7 +2324,7 @@ class ServeEngine:
             tr.complete("prefill_program", "engine", f"slot{slot}", ts=t_pf,
                         dur=t_pf1 - t_pf, req=req.id, padded=padded,
                         suffix=suffix, chunk=chunk or 0)
-        if self.prefix_cache is not None:
+        if use_pc:
             # hand the prefilled span to the tree while [0, length) is
             # pristine (an active lane's decode writes land at positions
             # >= length, and dummy writes only hit FREED lanes' slot 0 /
@@ -2190,7 +2494,8 @@ class ServeEngine:
             rows = 10 + acap + k
         else:
             rows = (11 + acap + cfg.max_len
-                    + (self.pool.pages_per_lane if self._paged else 0))
+                    + (self.pool.pages_per_lane if self._paged else 0)
+                    + (1 if self._quant else 0))
         state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
@@ -2225,7 +2530,10 @@ class ServeEngine:
         if mtp:
             state[10 + acap:10 + acap + k] = self._next_drafts.T
         elif self._paged:
-            state[11 + acap + cfg.max_len:] = self.pool.table.T
+            base = 11 + acap + cfg.max_len
+            state[base:base + self.pool.pages_per_lane] = self.pool.table.T
+        if self._quant:
+            state[-1] = self._eidx
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
@@ -2369,7 +2677,8 @@ class ServeEngine:
             if self.pool.n_active == 0:
                 return []  # exhaustion preempted every stream this block
         acap = cfg.sample_cap
-        rows = 9 + acap + (self.pool.pages_per_lane if self._paged else 0)
+        rows = (9 + acap + (self.pool.pages_per_lane if self._paged else 0)
+                + (1 if self._quant else 0))
         state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
@@ -2395,7 +2704,11 @@ class ServeEngine:
         if self._paged:
             # the page tables ride the SAME packed transfer: still two
             # host->device control arrays per decode call
-            state[9 + acap:] = self.pool.table.T
+            state[9 + acap:9 + acap + self.pool.pages_per_lane] = \
+                self.pool.table.T
+        if self._quant:
+            # exact-lane indices ride last (0 = quantized/trash)
+            state[-1] = self._eidx
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
@@ -2540,6 +2853,11 @@ class ServeEngine:
         self._top_k[slot] = 0
         self._seed[slot] = -1
         self._need_lp[slot] = 0
+        if self._eidx[slot]:
+            # hand the exact sidecar lane back (stale data contract as
+            # the pools': the next exact prefill overwrites before read)
+            self._exact_free.append(int(self._eidx[slot]))
+            self._eidx[slot] = 0
         self.pool.release(slot)
 
     def _finish_unadmitted(self, req: Request, reason: str,

@@ -39,6 +39,481 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import struct
+
+from solvingpapers_tpu.ops.quant import (
+    dequantize,
+    dequantize_tree,
+    quantize,
+    quantize_tree,
+    scale_shape,
+)
+
+
+# ======================================================================
+# Quantized storage (`ServeConfig.kv_quant`, ops/quant.py)
+# ======================================================================
+#
+# Both pools can hold their cache bytes as symmetric int8 with per-block
+# absmax scales instead of the model's compute dtype: `QuantStore`
+# replaces the plain cache pytree as the pool's device payload, and the
+# jitted serving programs DEQUANTIZE ON READ (the gather/extract sites
+# materialize the familiar compute-dtype lane view, so the models serve
+# unmodified) and QUANTIZE ON WRITE (the store/scatter sites requantize
+# exactly the blocks/pages the program wrote — untouched blocks are
+# never re-read-modify-written, and within a touched block committed
+# positions outside the written window re-encode from their own
+# f32-dequantized codes rather than the lossy compute-dtype lane view,
+# so old entries cannot drift step to step on any compute dtype; see
+# ops/quant.py's fixed-point note).
+#
+# Exact traffic shares the same store: `exact` is a small sidecar lane
+# pool in the ORIGINAL dtype ((kv_exact_lanes + 1) lanes; lane 0 is a
+# trash lane, mirroring the paged pool's trash page). A slot serving a
+# `SamplingParams.kv_exact` request carries a nonzero exact-lane index
+# on the packed control rows: reads substitute its full-precision lane
+# for the dequantized view (`jnp.where` per slot — one compiled program
+# for mixed exact/quantized batches), writes land in BOTH (the int8
+# shadow is harmless; the exact lane is authoritative), and quantized
+# slots' exact-lane writes fall into trash lane 0. Exact streams are
+# byte-identical to the unquantized engine's because the values the
+# model ever reads for them are bit-equal.
+
+
+@struct.dataclass
+class QuantStore:
+    """Quantized pool payload: int8 cache pytree + f32 scale sidecar
+    (same tree structure, `ops.quant.scale_shape` leaves) + the optional
+    exact-lane sidecar. `block`/`dtype` are static aux data (part of the
+    jit signature): the time-block length scales tile and the compute
+    dtype dequantized views materialize in."""
+
+    q: object
+    scale: object
+    exact: object
+    block: int = struct.field(pytree_node=False)
+    dtype: object = struct.field(pytree_node=False)
+
+
+@struct.dataclass
+class QuantSegment:
+    """Quantized prefix-cache segment (lane pools): the batch-1 int8 +
+    scale slices `extract_prefix` snapshots and `splice_prefix` writes
+    back. Cached prefixes stay quantized at rest — the radix tree's
+    byte budget buys ~2x the cached tokens."""
+
+    q: object
+    scale: object
+    block: int = struct.field(pytree_node=False)
+
+    @property
+    def length(self) -> int:
+        return jax.tree_util.tree_leaves(self.q)[0].shape[1]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for tree in (self.q, self.scale)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+
+    def time_slice(self, start: int, end: int) -> "QuantSegment":
+        """Token-axis sub-segment [start, end); bounds must be block
+        multiples (they are page multiples, and the engine pins
+        page % block == 0)."""
+        if start % self.block or end % self.block:
+            raise ValueError(
+                f"quantized segment slice [{start}, {end}) is not "
+                f"aligned to the quant block {self.block}"
+            )
+        b = self.block
+        return QuantSegment(
+            q=jax.tree_util.tree_map(lambda a: a[:, start:end], self.q),
+            scale=jax.tree_util.tree_map(
+                lambda a: a[:, start // b:end // b], self.scale
+            ),
+            block=b,
+        )
+
+
+def _leaf_dtype(caches):
+    """The single compute dtype of a cache pytree (quantization keys its
+    dequantized view on ONE static dtype; mixed-dtype caches would need
+    a per-leaf aux tree nothing in the repo produces)."""
+    dtypes = {leaf.dtype for leaf in jax.tree_util.tree_leaves(caches)}
+    if len(dtypes) != 1:
+        raise ValueError(
+            f"kv_quant needs a single cache dtype, got {sorted(map(str, dtypes))}"
+        )
+    return dtypes.pop()
+
+
+def make_quant_store(model, batch: int, time: int, block: int,
+                     exact_lanes: int = 0,
+                     exact_time: int | None = None) -> QuantStore:
+    """Build a pool's quantized payload: int8 zeros + zero scales shaped
+    like ``model.init_caches(batch, time)``, plus the exact-lane sidecar
+    (``exact_lanes + 1`` full-precision lanes of `exact_time`; lane 0 is
+    the trash lane). Zero scales dequantize to exact zeros, so a fresh
+    quantized pool reads back bit-identical to a fresh plain one."""
+    base = model.init_caches(batch, time)
+    dtype = _leaf_dtype(base)
+    q = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.int8), base
+    )
+    scale = jax.tree_util.tree_map(
+        lambda a: jnp.zeros(scale_shape(a.shape, block), jnp.float32), base
+    )
+    exact = None
+    if exact_lanes > 0:
+        exact = model.init_caches(exact_lanes + 1, exact_time or time)
+    return QuantStore(q=q, scale=scale, exact=exact, block=block,
+                      dtype=dtype)
+
+
+def quant_pool_bytes(store: QuantStore) -> tuple[int, int, int, int]:
+    """(payload+scale bytes, scale bytes, exact sidecar bytes, baseline
+    bytes) — the analytic byte split the HBM ledger and the kv_quant
+    gauges report. `baseline` is what the same pool would hold
+    unquantized (int8 element count x the compute dtype's width)."""
+    itemsize = np.dtype(store.dtype).itemsize
+    q_bytes = sum(leaf.size for leaf in jax.tree_util.tree_leaves(store.q))
+    s_bytes = sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(store.scale)
+    )
+    e_bytes = 0
+    if store.exact is not None:
+        e_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(store.exact)
+        )
+    return q_bytes + s_bytes, s_bytes, e_bytes, q_bytes * itemsize
+
+
+# --------------------------------------------------- traced read helpers
+
+
+def _exact_select1(lane, store: QuantStore, eidx):
+    """Batch-1 exact override: substitute the `eidx` exact lane when
+    eidx > 0 (a kv_exact slot); eidx == 0 keeps the dequantized view."""
+    if store.exact is None:
+        return lane
+    ex = extract_lane(store.exact, eidx)
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(eidx > 0, b, a), lane, ex
+    )
+
+
+def _exact_select(lanes, store: QuantStore, eidx_row):
+    """Batched exact override for the (S, ...) lane view."""
+    if store.exact is None:
+        return lanes
+
+    def sel(a, ex_pool):
+        ex = ex_pool[eidx_row]
+        mask = (eidx_row > 0).reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(mask, ex, a)
+
+    return jax.tree_util.tree_map(sel, lanes, store.exact)
+
+
+def quant_lane_view(store: QuantStore, slot, eidx):
+    """Batch-1 compute-dtype lane view of a quantized LANE pool slot
+    (traced) — `extract_lane` + dequantize + the exact override."""
+    lane = dequantize_tree(
+        extract_lane(store.q, slot), extract_lane(store.scale, slot),
+        store.dtype,
+    )
+    return _exact_select1(lane, store, eidx)
+
+
+def quant_lanes_view(store: QuantStore, eidx_row):
+    """All-slot (S, max_len, ...) view of a quantized lane pool (traced)
+    — what the decode programs carry through their scan."""
+    lanes = dequantize_tree(store.q, store.scale, store.dtype)
+    return _exact_select(lanes, store, eidx_row)
+
+
+def quant_gather_lane(store: QuantStore, row, eidx):
+    """Batch-1 lane view of a quantized PAGE pool: gather the int8
+    pages and their per-page scale rows through the same page-table row,
+    dequantize, apply the exact override (traced)."""
+
+    def g(qleaf, sleaf):
+        pages = qleaf[row].astype(jnp.float32)   # (PPL, page, ...)
+        sc = sleaf[row][..., None]               # (PPL, 1[, H], 1)
+        x = (pages * sc).astype(store.dtype)
+        ppl, page = x.shape[:2]
+        return x.reshape((1, ppl * page) + x.shape[2:])
+
+    lane = jax.tree_util.tree_map(g, store.q, store.scale)
+    return _exact_select1(lane, store, eidx)
+
+
+def quant_gather_lanes(store: QuantStore, table, eidx_row):
+    """(S, max_len, ...) view of a quantized page pool through the
+    (S, pages_per_lane) page table (traced). The int8 gather moves half
+    the bytes of the plain pool's — the paged full-lane-gather tax
+    shrinks with the payload."""
+
+    def g(qleaf, sleaf):
+        pages = qleaf[table].astype(jnp.float32)  # (S, PPL, page, ...)
+        sc = sleaf[table][..., None]              # (S, PPL, 1[, H], 1)
+        x = (pages * sc).astype(store.dtype)
+        s, ppl, page = x.shape[:3]
+        return x.reshape((s, ppl * page) + x.shape[3:])
+
+    lanes = jax.tree_util.tree_map(g, store.q, store.scale)
+    return _exact_select(lanes, store, eidx_row)
+
+
+# -------------------------------------------------- traced write helpers
+
+
+def quant_store_lane(store: QuantStore, lane, slot, eidx,
+                     t0: int, t1: int, hi=None) -> QuantStore:
+    """Quantize-on-write for a batch-1 lane (the prefill store site):
+    requantize ONLY the written span [t0, t1) (static; `t0` block-aligned
+    — prefix-hit starts are page multiples and page % block == 0, `t1`
+    rounds up to the block) into the slot's int8 + scale rows, and mirror
+    the full-precision lane into the exact sidecar at `eidx` (trash lane
+    0 for quantized slots). Blocks below t0 hold spliced prefix data the
+    prefill never touched — not rewriting them is what keeps the
+    quantized prefix cache's contents stable under reuse. `hi` (traced)
+    is the end of the REAL tokens: prompts are right-padded, and a
+    padding activation sharing the tail block would otherwise inflate
+    its absmax and coarsen the last committed tokens' codes — positions
+    past `hi` are zeroed before quantizing instead (they sit beyond
+    `attend_len`, are never attended, and decode overwrites them; zeros
+    can never widen a scale)."""
+    b = store.block
+    t_max = jax.tree_util.tree_leaves(store.q)[0].shape[1]
+    if t0 % b:
+        raise ValueError(f"write start {t0} is not a multiple of the "
+                         f"quant block {b}")
+    t1 = min(-(-t1 // b) * b, t_max)
+    span = jax.tree_util.tree_map(lambda a: a[:, t0:t1], lane)
+    if hi is not None:
+        tcol = jnp.arange(t0, t1)
+
+        def _zero_pads(a):
+            m = (tcol < hi).reshape((1, t1 - t0) + (1,) * (a.ndim - 2))
+            return jnp.where(m, a, jnp.zeros_like(a))
+
+        span = jax.tree_util.tree_map(_zero_pads, span)
+    q_span, s_span = quantize_tree(span, b)
+    q = jax.tree_util.tree_map(
+        lambda a, s: jax.lax.dynamic_update_slice(
+            a, s, (slot, t0) + (0,) * (a.ndim - 2)),
+        store.q, q_span,
+    )
+    scale = jax.tree_util.tree_map(
+        lambda a, s: jax.lax.dynamic_update_slice(
+            a, s, (slot, t0 // b) + (0,) * (a.ndim - 2)),
+        store.scale, s_span,
+    )
+    exact = store.exact
+    if exact is not None:
+        exact = store_lane(exact, lane, eidx)
+    return store.replace(q=q, scale=scale, exact=exact)
+
+
+def quant_store_written(store: QuantStore, lanes, pos0, span: int,
+                        eidx_row, hi=None,
+                        tail_garbage: bool = False) -> QuantStore:
+    """Quantize-on-write for the decode programs' (S, max_len, ...) lane
+    view: each slot wrote positions ``[pos0[s], pos0[s] + span)`` (span
+    static — `decode_block`, or rounds x chunk for speculation), which
+    touches a static number of quant blocks per slot; requantize exactly
+    those blocks and leave the rest of the pool's payload byte-identical
+    (clipped duplicate windows rewrite the same block with the same
+    content — idempotent). Within a rewritten block, only positions
+    inside the written window take the compute-dtype lane view; the rest
+    re-encode from their OWN f32-dequantized codes. That merge matters
+    twice over: (1) on bf16 pools the lane view is a lossy cast, and
+    requantizing committed entries through it would walk their codes
+    step to step (ops/quant.py's fixed-point note only holds in f32);
+    (2) when the caller knows the lane past a per-slot `hi` holds
+    REJECTED-draft garbage (`tail_garbage=True`, the speculative
+    write-back — `hi` is the device-committed end, default pos0 + span),
+    excluding it keeps a garbage outlier from inflating the block absmax
+    and permanently coarsening the committed entries that share the
+    block. On f32 pools with a trustworthy tail the merge reproduces
+    the lane bit-for-bit, so it is skipped at trace time (dtype and
+    `tail_garbage` are static) and the plain-decode f32 write site keeps
+    its pre-merge cost. The exact sidecar takes each slot's full lane at
+    its `eidx` (duplicate trash-lane writes are garbage-on-garbage)."""
+    b = store.block
+    t_max = jax.tree_util.tree_leaves(store.q)[0].shape[1]
+    nb = t_max // b
+    n_slots = pos0.shape[0]
+    rows = jnp.arange(n_slots)
+    q_tree, s_tree = store.q, store.scale
+    end = (pos0 + span) if hi is None else hi
+    merge = tail_garbage or jnp.dtype(store.dtype) != jnp.float32
+    for w in range((span - 1) // b + 2):
+        bidx = jnp.clip((pos0 + w * b) // b, 0, nb - 1)  # (S,)
+        tcol = bidx[:, None] * b + jnp.arange(b)[None, :]  # (S, b)
+
+        def one(qleaf, sleaf, lane_leaf, bidx=bidx, tcol=tcol):
+            vals = jax.vmap(
+                lambda lane, i: jax.lax.dynamic_slice_in_dim(
+                    lane, i * b, b, axis=0)
+            )(lane_leaf, bidx)                       # (S, b, ...)
+            if merge:
+                old_q = jax.vmap(
+                    lambda qrow, i: jax.lax.dynamic_slice_in_dim(
+                        qrow, i * b, b, axis=0)
+                )(qleaf, bidx)                       # (S, b, ...) int8
+                old = dequantize(old_q, sleaf[rows, bidx][:, None],
+                                 jnp.float32)
+                wr = ((tcol >= pos0[:, None])
+                      & (tcol < end[:, None]))
+                wr = wr.reshape(wr.shape + (1,) * (vals.ndim - 2))
+                vals = jnp.where(wr, vals.astype(jnp.float32), old)
+            qv, sv = quantize(vals, b)               # scale (S, 1[, H])
+            qleaf = qleaf.at[rows[:, None], tcol].set(qv)
+            sleaf = sleaf.at[rows, bidx].set(
+                jnp.squeeze(sv, axis=1))
+            return qleaf, sleaf
+
+        pairs = [one(ql, sl, ll) for ql, sl, ll in zip(
+            jax.tree_util.tree_leaves(q_tree),
+            jax.tree_util.tree_leaves(s_tree),
+            jax.tree_util.tree_leaves(lanes))]
+        treedef = jax.tree_util.tree_structure(q_tree)
+        q_tree = jax.tree_util.tree_unflatten(
+            treedef, [q for q, _ in pairs])
+        s_tree = jax.tree_util.tree_unflatten(
+            treedef, [s for _, s in pairs])
+    return quant_store_exact_lanes(
+        store.replace(q=q_tree, scale=s_tree), lanes, eidx_row)
+
+
+def quant_scatter_lane_pages(store: QuantStore, lane, row,
+                             start_page: int, eidx, hi=None) -> QuantStore:
+    """`scatter_lane_pages` for a quantized page pool (the paged prefill
+    write site): quantize the batch-1 lane's pages [start_page:] —
+    one absmax scale row per (page, head) — and scatter payload + scales
+    to the physical ids; mirror the lane into the exact sidecar. `hi`
+    (traced) zeroes right-padding positions before quantizing, exactly
+    as `quant_store_lane` documents — a pad activation must not widen
+    the scale of the page holding the last real tokens."""
+    ids = row[start_page:]
+
+    def sc(qleaf, sleaf, lane_leaf):
+        page = qleaf.shape[1]
+        ppl = row.shape[0]
+        pages = lane_leaf.reshape((ppl, page) + lane_leaf.shape[2:])
+        pages = pages[start_page:]
+        if hi is not None:
+            tcol = (start_page * page
+                    + jnp.arange((ppl - start_page) * page)).reshape(
+                        (ppl - start_page, page))
+            m = (tcol < hi).reshape(tcol.shape + (1,) * (pages.ndim - 2))
+            pages = jnp.where(m, pages, jnp.zeros_like(pages))
+        qv, sv = quantize(pages, page)
+        return qleaf.at[ids].set(qv), sleaf.at[ids].set(sv)
+
+    pairs = [sc(ql, sl, ll) for ql, sl, ll in zip(
+        jax.tree_util.tree_leaves(store.q),
+        jax.tree_util.tree_leaves(store.scale),
+        jax.tree_util.tree_leaves(lane))]
+    treedef = jax.tree_util.tree_structure(store.q)
+    q = jax.tree_util.tree_unflatten(treedef, [a for a, _ in pairs])
+    scale = jax.tree_util.tree_unflatten(treedef, [b for _, b in pairs])
+    exact = store.exact
+    if exact is not None:
+        exact = store_lane(exact, lane, eidx)
+    return store.replace(q=q, scale=scale, exact=exact)
+
+
+def quant_scatter_written_pages(store: QuantStore, lanes, table,
+                                pos, lo=None, hi=None,
+                                tail_garbage: bool = False) -> QuantStore:
+    """`scatter_written_pages` for a quantized page pool: gather each
+    slot's written page out of the compute-dtype lane view, quantize it
+    (fresh per-(page, head) scales), scatter payload + scale rows to the
+    physical ids. `lo`/`hi` (per-slot logical positions, hi exclusive)
+    bound the window the program actually wrote: positions outside it
+    re-encode from their OWN f32-dequantized physical codes — needed on
+    lossy compute dtypes (the bf16 drift `quant_store_written`
+    documents) and, with `tail_garbage=True` (the speculative
+    write-back), on EVERY dtype: there the lane past `hi` holds
+    rejected-draft values whose outliers would otherwise inflate the
+    page absmax and permanently coarsen the committed entries sharing
+    the page. An f32 pool with a trustworthy tail skips the merge at
+    trace time (the lane view is bit-for-bit the dequantized codes).
+    Exact lanes are written separately, once per program
+    (`quant_store_exact_lanes`) — this runs in a loop over page
+    windows."""
+    ppl = table.shape[1]
+    merge = (lo is not None
+             and (tail_garbage or jnp.dtype(store.dtype) != jnp.float32))
+
+    def sc(qleaf, sleaf, lane_leaf):
+        page = qleaf.shape[1]
+        pg = jnp.clip(pos.astype(jnp.int32) // page, 0, ppl - 1)
+        ids = jnp.take_along_axis(table, pg[:, None], axis=1)[:, 0]
+        pages = jax.vmap(
+            lambda lane, i: jax.lax.dynamic_slice_in_dim(
+                lane, i * page, page, axis=0
+            )
+        )(lane_leaf, pg)
+        if merge:
+            tcol = pg[:, None] * page + jnp.arange(page)[None, :]
+            old = dequantize(qleaf[ids], sleaf[ids], jnp.float32)
+            wr = (tcol >= lo[:, None]) & (tcol < hi[:, None])
+            wr = wr.reshape(wr.shape + (1,) * (pages.ndim - 2))
+            pages = jnp.where(wr, pages.astype(jnp.float32), old)
+        qv, sv = quantize(pages, page)  # (S, page, ...), (S, 1[, H])
+        return qleaf.at[ids].set(qv), sleaf.at[ids].set(sv)
+
+    pairs = [sc(ql, sl, ll) for ql, sl, ll in zip(
+        jax.tree_util.tree_leaves(store.q),
+        jax.tree_util.tree_leaves(store.scale),
+        jax.tree_util.tree_leaves(lanes))]
+    treedef = jax.tree_util.tree_structure(store.q)
+    q = jax.tree_util.tree_unflatten(treedef, [a for a, _ in pairs])
+    scale = jax.tree_util.tree_unflatten(treedef, [b for _, b in pairs])
+    return store.replace(q=q, scale=scale)
+
+
+def quant_scatter_window_pages(store: QuantStore, lanes, table, start,
+                               last, span: int) -> QuantStore:
+    """`scatter_window_pages` for a quantized page pool — the
+    speculative decode write-back (same clamped page walk, quantized
+    payload). [start, last] is the device-committed window: those
+    positions take the lane's draws; committed pages below `start` keep
+    their own codes, and the stale tail past `last` keeps old codes
+    instead of rejected draws on EVERY dtype (`tail_garbage` — a
+    rejected outlier would otherwise coarsen the whole page's scale;
+    the tail itself stays overwrite-before-attend garbage either
+    way)."""
+    page = jax.tree_util.tree_leaves(store.q)[0].shape[1]
+    limit = table.shape[1] * page - 1
+    last = jnp.maximum(last, start)
+    for w in range((span - 1) // page + 2):
+        pos_w = jnp.clip(jnp.minimum(start + w * page, last), 0, limit)
+        store = quant_scatter_written_pages(store, lanes, table, pos_w,
+                                            lo=start, hi=last + 1,
+                                            tail_garbage=True)
+    return store
+
+
+def quant_store_exact_lanes(store: QuantStore, lanes,
+                            eidx_row) -> QuantStore:
+    """Write every slot's full-precision lane view into its exact lane
+    (paged decode/spec programs; trash-lane duplicates are benign)."""
+    if store.exact is None:
+        return store
+    exact = jax.tree_util.tree_map(
+        lambda ex, ln: ex.at[eidx_row].set(ln.astype(ex.dtype)),
+        store.exact, lanes,
+    )
+    return store.replace(exact=exact)
 
 
 def _require_same_dtype(pool_leaf, seg_leaf, op: str) -> None:
@@ -106,6 +581,51 @@ def _extract_program(caches, ctl, length):
     return jax.tree_util.tree_map(ext, caches)
 
 
+@functools.partial(jax.jit, donate_argnames=("caches",))
+def _quant_splice_program(caches, segment, ctl):
+    """Quantized splice: the segment's int8 payload lands at
+    ``(ctl[0], ctl[1])`` and its scale rows at ``offset // block`` —
+    cached prefixes stay quantized end to end (no dequant/requant on the
+    reuse path, so the spliced bytes are bitwise the producer's)."""
+    slot, offset = ctl[0], ctl[1]
+    b = caches.block
+
+    def upd(a, s, off):
+        _require_same_dtype(a, s, "splice_prefix")
+        starts = (slot, off) + (0,) * (a.ndim - 2)
+        return jax.lax.dynamic_update_slice(a, s, starts)
+
+    return caches.replace(
+        q=jax.tree_util.tree_map(
+            lambda a, s: upd(a, s, offset), caches.q, segment.q),
+        scale=jax.tree_util.tree_map(
+            lambda a, s: upd(a, s, offset // b), caches.scale,
+            segment.scale),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("length",))
+def _quant_extract_program(caches, ctl, length):
+    """Quantized snapshot: slice lane `ctl[0]`'s int8 span plus the
+    matching scale rows into an independent `QuantSegment` — the
+    prefix-cache insert path at HALF the copy (and tree budget) bytes."""
+    slot, offset = ctl[0], ctl[1]
+    b = caches.block
+
+    def ext(a, off, ln):
+        starts = (slot, off) + (0,) * (a.ndim - 2)
+        sizes = (1, ln) + a.shape[2:]
+        return jax.lax.dynamic_slice(a, starts, sizes)
+
+    return QuantSegment(
+        q=jax.tree_util.tree_map(
+            lambda a: ext(a, offset, length), caches.q),
+        scale=jax.tree_util.tree_map(
+            lambda a: ext(a, offset // b, length // b), caches.scale),
+        block=b,
+    )
+
+
 class _SlotBook:
     """Shared slot bookkeeping for both pool layouts: a LIFO free list
     (the freshest slot is reused while its buffers / table row are
@@ -170,10 +690,26 @@ class KVSlotPool(_SlotBook):
     to 0.
     """
 
-    def __init__(self, model, n_slots: int, max_len: int):
+    def __init__(self, model, n_slots: int, max_len: int,
+                 quant: str | None = None, quant_block: int = 16,
+                 exact_lanes: int = 0):
         self._init_slots(n_slots)
         self.max_len = max_len
-        self.caches = model.init_caches(n_slots, max_len)
+        self.quant = quant
+        self.quant_block = quant_block
+        self.exact_lanes = exact_lanes if quant else 0
+        if quant:
+            if max_len % quant_block:
+                raise ValueError(
+                    f"max_len {max_len} is not a multiple of the quant "
+                    f"block {quant_block} — scale rows must tile the lane"
+                )
+            self.caches = make_quant_store(
+                model, n_slots, max_len, quant_block,
+                exact_lanes=exact_lanes,
+            )
+        else:
+            self.caches = model.init_caches(n_slots, max_len)
         # optional metrics.xla_obs.CompileRegistry (set by the engine
         # when the observatory is on): splice/extract program calls are
         # routed through it so their compilations and run seconds are
@@ -182,11 +718,18 @@ class KVSlotPool(_SlotBook):
 
     @property
     def nbytes(self) -> int:
-        """Device bytes the pooled cache pytree holds (all lanes) — the
-        HBM ledger's kv_pool gauge."""
+        """Device bytes the pooled cache pytree holds (all lanes; for a
+        quantized pool: int8 payload + scale sidecar + exact lanes) —
+        the HBM ledger's kv_pool gauge."""
         from solvingpapers_tpu.metrics.xla_obs import pytree_bytes
 
         return pytree_bytes(self.caches)
+
+    @property
+    def token_capacity(self) -> int:
+        """Cache slots the pool books (the kv_bytes_per_token gauge's
+        denominator): every lane's full length."""
+        return self.n_slots * self.max_len
 
     def release(self, slot: int) -> None:
         """Return a lane to the pool; it is immediately reusable."""
@@ -194,6 +737,15 @@ class KVSlotPool(_SlotBook):
         self._finish_release(slot)
 
     # --------------------------------------------------- prefix segments
+
+    def _check_quant_span(self, offset: int, length: int, op: str) -> None:
+        b = self.quant_block
+        if offset % b or length % b:
+            raise ValueError(
+                f"{op} span [{offset}, {offset + length}) is not aligned "
+                f"to the quant block {b} — quantized segments carry "
+                "whole scale rows (prefix pages must be block multiples)"
+            )
 
     def splice_prefix(self, slot: int, segment, offset: int = 0) -> None:
         """Copy-on-acquire: splice a cached batch-1 prefix `segment` into
@@ -203,22 +755,34 @@ class KVSlotPool(_SlotBook):
         `offset + segment length`."""
         if not 0 <= slot < self.n_slots:
             raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
-        length = jax.tree_util.tree_leaves(segment)[0].shape[1]
+        if self.quant:
+            if not isinstance(segment, QuantSegment):
+                raise TypeError(
+                    "a quantized pool splices QuantSegment payloads "
+                    f"(int8 + scales), got {type(segment).__name__} — "
+                    "the prefix cache and the pool must agree on kv_quant"
+                )
+            length = segment.length
+        else:
+            length = jax.tree_util.tree_leaves(segment)[0].shape[1]
         if offset < 0 or offset + length > self.max_len:
             raise ValueError(
                 f"segment span [{offset}, {offset + length}) exceeds the "
                 f"lane capacity {self.max_len}"
             )
+        if self.quant:
+            self._check_quant_span(offset, length, "splice_prefix")
+        prog = _quant_splice_program if self.quant else _splice_program
         ctl = jnp.asarray([slot, offset], jnp.int32)
         if self.registry is not None:
             # segment layout is fixed per model (one pool, one model), so
             # the static time length is the whole varying signature
             self.caches = self.registry.call(
-                "splice_program", (length,), _splice_program,
+                "splice_program", (length,), prog,
                 (self.caches, segment, ctl),
             )
         else:
-            self.caches = _splice_program(self.caches, segment, ctl)
+            self.caches = prog(self.caches, segment, ctl)
 
     def extract_prefix(self, slot: int, offset: int, length: int):
         """Snapshot lane `slot`'s KV span [offset, offset+length) as an
@@ -230,13 +794,16 @@ class KVSlotPool(_SlotBook):
                 f"extract span [{offset}, {offset + length}) exceeds the "
                 f"lane capacity {self.max_len}"
             )
+        if self.quant:
+            self._check_quant_span(offset, length, "extract_prefix")
+        prog = _quant_extract_program if self.quant else _extract_program
         ctl = jnp.asarray([slot, offset], jnp.int32)
         if self.registry is not None:
             return self.registry.call(
-                "extract_program", (length,), _extract_program,
+                "extract_program", (length,), prog,
                 (self.caches, ctl, length), static_argnums=(2,),
             )
-        return _extract_program(self.caches, ctl, length)
+        return prog(self.caches, ctl, length)
 
 
 # ======================================================================
@@ -428,7 +995,8 @@ class PagedKVPool(_SlotBook):
     """
 
     def __init__(self, model, n_slots: int, max_len: int, page_size: int,
-                 page_budget: int | None = None):
+                 page_budget: int | None = None, quant: str | None = None,
+                 exact_lanes: int = 0):
         self._init_slots(n_slots)
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
@@ -440,6 +1008,9 @@ class PagedKVPool(_SlotBook):
         self.max_len = max_len
         self.page_size = page_size
         self.pages_per_lane = max_len // page_size
+        self.quant = quant
+        self.quant_block = page_size  # one scale row per (page, head)
+        self.exact_lanes = exact_lanes if quant else 0
         if page_budget is None:
             # lane-pool-equivalent capacity: every slot can hold a full
             # lane at once (callers shrink it to trade worst-case room
@@ -453,7 +1024,16 @@ class PagedKVPool(_SlotBook):
             )
         self.page_budget = page_budget
         self.n_pages = page_budget + 1  # + the trash page
-        self.phys = model.init_caches(self.n_pages, page_size)
+        if quant:
+            # exact lanes are LANE-shaped (max_len): a kv_exact stream
+            # never allocates pages at all — its table rests at trash
+            # and its KV lives wholly in the full-precision sidecar
+            self.phys = make_quant_store(
+                model, self.n_pages, page_size, page_size,
+                exact_lanes=exact_lanes, exact_time=max_len,
+            )
+        else:
+            self.phys = model.init_caches(self.n_pages, page_size)
         self.table = np.full((n_slots, self.pages_per_lane), TRASH_PAGE,
                              np.int32)
         self.n_alloc = np.zeros(n_slots, np.int32)
@@ -475,9 +1055,20 @@ class PagedKVPool(_SlotBook):
 
     @property
     def page_nbytes(self) -> int:
-        """Device bytes one page holds across every cache leaf — what a
+        """Device bytes one page holds across every cache leaf (for a
+        quantized pool: int8 payload + its scale rows, excluding the
+        exact-lane sidecar, which no page reference pins) — what a
         radix-tree page reference costs in the prefix cache's budget."""
+        if self.quant:
+            pool_bytes, _, _, _ = quant_pool_bytes(self.phys)
+            return pool_bytes // self.n_pages
         return self.nbytes // self.n_pages
+
+    @property
+    def token_capacity(self) -> int:
+        """Allocatable cache slots (the kv_bytes_per_token gauge's
+        denominator): every budgeted page, trash excluded."""
+        return self.page_budget * self.page_size
 
     @property
     def pages_free(self) -> int:

@@ -29,6 +29,22 @@ a gauge provider, same mechanism as the observatory below):
                                present iff any occurred, with
     serve/recompute_tokens     the tokens re-prefilled by those resumes
 
+Quantized-KV gauges (present iff `ServeConfig.kv_quant`; the engine
+registers a gauge provider, same mechanism as the paged-pool gauges —
+byte values are analytic shape sums, never device reads):
+
+    serve/kv_bytes_per_token         resident KV bytes (int8 payload +
+                                     scale sidecar) per bookable cache
+                                     slot — the capacity price of one
+                                     context token under this pool
+    serve/kv_quant_scale_bytes       f32 absmax-scale sidecar bytes
+    serve/kv_quant_bytes_saved       compute-dtype baseline minus the
+                                     quantized payload — the ledger-
+                                     visible capacity win
+    serve/kv_quant_exact_lanes_free  full-precision sidecar lanes free /
+    serve/kv_quant_exact_active      serving kv_exact requests (present
+                                     iff kv_exact_lanes > 0)
+
 Speculative-decoding gauges (serve/spec.py; present iff
 `ServeConfig.speculative` — the engine registers a gauge provider, the
 same mechanism as the paged-pool and observatory gauges):

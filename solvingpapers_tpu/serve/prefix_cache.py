@@ -52,9 +52,15 @@ import dataclasses
 import jax
 import numpy as np
 
+from solvingpapers_tpu.serve.kv_pool import QuantSegment
+
 
 def segment_bytes(segment) -> int:
-    """Device bytes held by a batch-1 segment pytree."""
+    """Device bytes held by a batch-1 segment pytree (a quantized
+    segment counts its int8 payload + scale rows — roughly half a
+    bf16 segment's budget charge for the same tokens)."""
+    if isinstance(segment, QuantSegment):
+        return segment.nbytes
     return sum(
         leaf.size * leaf.dtype.itemsize
         for leaf in jax.tree_util.tree_leaves(segment)
@@ -64,11 +70,17 @@ def segment_bytes(segment) -> int:
 def segment_length(segment) -> int:
     """Time-axis length of a batch-1 segment pytree (axis 1 by the
     KVCache/LatentCache layout contract)."""
+    if isinstance(segment, QuantSegment):
+        return segment.length
     return jax.tree_util.tree_leaves(segment)[0].shape[1]
 
 
 def slice_segment(segment, start: int, end: int):
-    """Time-axis sub-segment [start, end) — static bounds, eager ops."""
+    """Time-axis sub-segment [start, end) — static bounds, eager ops.
+    Quantized segments slice payload and scale rows together (bounds
+    are page multiples, pages are quant-block multiples)."""
+    if isinstance(segment, QuantSegment):
+        return segment.time_slice(start, end)
     return jax.tree_util.tree_map(lambda a: a[:, start:end], segment)
 
 

@@ -110,6 +110,14 @@ class SamplingParams:
     logprobs      when True, the chosen token's log-softmax under the
                   model's raw logits is streamed into
                   `Request.logprobs`, one entry per generated token.
+    kv_exact      escape hatch from `ServeConfig.kv_quant`: the request
+                  serves from a full-precision sidecar lane inside the
+                  quantized engine's compiled programs (its exact-lane
+                  index rides the packed control rows), byte-identical
+                  to unquantized serving; needs
+                  `ServeConfig.kv_exact_lanes` >= 1 (submit validates)
+                  and bypasses the quantized prefix cache. A no-op on
+                  an unquantized engine (everything is exact there).
     """
 
     temperature: float = 0.0
@@ -121,6 +129,7 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     stop: tuple[str, ...] = ()
     logprobs: bool = False
+    kv_exact: bool = False
 
     def __post_init__(self):
         if self.temperature < 0:

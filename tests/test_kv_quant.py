@@ -1,0 +1,596 @@
+"""Quantized KV serving (`ServeConfig.kv_quant`) acceptance tests.
+
+The contract: int8 cache storage is a POOL property, invisible to the
+model and to every engine behavior except output numerics — and those
+are gated by measurement (the bench's greedy-agreement rate), not
+exactness, EXCEPT for `kv_exact` traffic, which must stay byte-identical
+to the unquantized engine while sharing its compiled programs with
+quantized slots. Byte accounting is pinned analytically: the claim the
+whole feature exists for is `int8 + scales ~= half the bf16 bytes`, and
+the ledger must say so exactly.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.ops.quant import scale_shape
+from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.kv_pool import (
+    KVSlotPool,
+    PagedKVPool,
+    QuantSegment,
+    quant_pool_bytes,
+)
+from solvingpapers_tpu.serve.sampling import SamplingParams
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=96, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    model = GPT(GPT_TINY)
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _prompts(n, seed=0, lo=5, hi=20):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(0, GPT_TINY.vocab_size,
+                     size=int(rng.integers(lo, hi))).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+BASE = dict(n_slots=3, max_len=64, decode_block=4, bucket=16)
+
+
+def _run(model, params, scfg, prompts, max_new=10, params_for=None):
+    eng = ServeEngine(model, params, scfg)
+    handles = [
+        eng.submit(p, max_new_tokens=max_new,
+                   params=params_for(i) if params_for else None)
+        for i, p in enumerate(prompts)
+    ]
+    eng.run()
+    assert all(h.done for h in handles)
+    return eng, [h.tokens for h in handles]
+
+
+def _agreement(ref, got):
+    total = sum(len(r) for r in ref)
+    same = sum(int(a == b) for r, g in zip(ref, got) for a, b in zip(r, g))
+    return same / total
+
+
+# ------------------------------------------------------------- quality
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_quant_greedy_streams_track_full_precision(gpt_tiny, paged):
+    """int8 storage under greedy decode: high token agreement with the
+    full-precision pool (the bench gates >= 0.99 on the trained corpus
+    model; the random-init tiny model here is the harsher case)."""
+    model, params = gpt_tiny
+    prompts = _prompts(6, seed=1)
+    _, ref = _run(model, params, ServeConfig(**BASE), prompts)
+    extra = dict(paged=True, page_size=16) if paged else {}
+    _, got = _run(model, params,
+                  ServeConfig(**BASE, kv_quant="int8", **extra), prompts)
+    assert _agreement(ref, got) >= 0.95
+
+
+def test_kv_exact_streams_byte_identical_in_mixed_batch(gpt_tiny):
+    """The escape hatch: kv_exact rows of a MIXED exact/quantized batch
+    are byte-identical to the unquantized engine, quantized rows to the
+    all-quantized engine — one engine, both service levels."""
+    model, params = gpt_tiny
+    prompts = _prompts(6, seed=2)
+    _, ref = _run(model, params, ServeConfig(**BASE), prompts)
+    _, quant = _run(model, params, ServeConfig(**BASE, kv_quant="int8"),
+                    prompts)
+    _, mixed = _run(
+        model, params,
+        ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=2), prompts,
+        params_for=lambda i: SamplingParams(kv_exact=(i % 2 == 0)),
+    )
+    for i in range(len(prompts)):
+        if i % 2 == 0:
+            assert mixed[i] == ref[i], f"exact row {i} diverged"
+        else:
+            assert mixed[i] == quant[i], f"quantized row {i} diverged"
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_kv_exact_byte_identical_on_both_pools(gpt_tiny, paged):
+    model, params = gpt_tiny
+    prompts = _prompts(4, seed=3)
+    extra = dict(paged=True, page_size=16) if paged else {}
+    _, ref = _run(model, params, ServeConfig(**BASE, **extra), prompts)
+    _, got = _run(
+        model, params,
+        ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=3, **extra),
+        prompts, params_for=lambda i: SamplingParams(kv_exact=True),
+    )
+    assert got == ref
+
+
+# ------------------------------------------------- prefix cache + spec
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_quant_prefix_cache_streams_exact_vs_cache_off(gpt_tiny, paged):
+    """Quantized prefix reuse: cached int8 pages/segments splice back
+    bitwise (full blocks of real tokens quantize identically for the
+    producer and a re-prefilling consumer), so quantized greedy streams
+    are token-exact cache on vs off."""
+    model, params = gpt_tiny
+    rng = np.random.default_rng(4)
+    stem = rng.integers(0, 64, size=32).astype(np.int32)
+    prompts = [
+        np.concatenate([stem, rng.integers(0, 64, size=5).astype(np.int32)])
+        for _ in range(6)
+    ]
+    extra = dict(paged=True, page_size=16) if paged else {}
+    qcfg = ServeConfig(**BASE, kv_quant="int8", **extra)
+    _, off = _run(model, params, qcfg, prompts, max_new=6)
+    eng, on = _run(
+        model, params,
+        dataclasses.replace(qcfg, prefix_cache=True, prefix_page=16),
+        prompts, max_new=6,
+    )
+    assert on == off
+    assert eng.metrics.snapshot()["serve/prefix_hit_rate"] > 0.5
+
+
+def test_kv_exact_bypasses_quantized_prefix_cache(gpt_tiny):
+    """A kv_exact request must neither consume nor feed the quantized
+    radix tree (a spliced int8 prefix would break its byte-exactness)."""
+    model, params = gpt_tiny
+    rng = np.random.default_rng(5)
+    stem = rng.integers(0, 64, size=32).astype(np.int32)
+    prompts = [
+        np.concatenate([stem, rng.integers(0, 64, size=4).astype(np.int32)])
+        for _ in range(4)
+    ]
+    _, ref = _run(model, params, ServeConfig(**BASE), prompts, max_new=6)
+    eng, got = _run(
+        model, params,
+        ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=3,
+                    prefix_cache=True, prefix_page=16),
+        prompts, max_new=6,
+        params_for=lambda i: SamplingParams(kv_exact=True),
+    )
+    assert got == ref
+    snap = eng.metrics.snapshot()
+    # exact admissions never touched the tree: no lookups recorded
+    assert "serve/prefix_lookups" not in snap
+    assert eng.prefix_cache.n_nodes == 0
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_quant_speculative_ngram_matches_plain_quant(gpt_tiny, paged):
+    """Speculation is lossless RELATIVE TO ITS ENGINE's sampler+storage:
+    spec-on quantized greedy streams equal spec-off quantized ones."""
+    model, params = gpt_tiny
+    prompts = _prompts(4, seed=6, lo=8, hi=20)
+    extra = dict(paged=True, page_size=16) if paged else {}
+    qcfg = ServeConfig(**BASE, kv_quant="int8", **extra)
+    _, plain = _run(model, params, qcfg, prompts)
+    _, spec = _run(
+        model, params,
+        dataclasses.replace(qcfg, speculative="ngram", spec_k=3,
+                            spec_rounds=2),
+        prompts,
+    )
+    assert spec == plain
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_deepseekv3_latent_lanes_quantize(paged):
+    """The flagship's MLA LatentCache quantizes the same way: 3-D
+    (B, T, C) leaves take one absmax scale per (slot, time-block) —
+    ops.quant's per-block-scalar granularity for latents — and serve
+    through both pools with high agreement and byte-exact kv_exact."""
+    import dataclasses as dc
+
+    from solvingpapers_tpu.models.deepseekv3 import (
+        DeepSeekV3,
+        DeepSeekV3Config,
+    )
+
+    cfg = DeepSeekV3Config(
+        vocab_size=64, block_size=64, dim=32, n_layers=2, n_heads=4,
+        latent_dim=8, rope_dim=8, n_experts=4, top_experts=2,
+        dropout=0.0, attn_dropout=0.0,
+    )
+    model = DeepSeekV3(cfg)
+    prompts = _prompts(3, seed=4, lo=5, hi=14)
+    variables = model.init({"params": jax.random.key(3)},
+                           jnp.asarray(prompts[0])[None, :])
+    params = variables["params"]
+    extra = {"moe_state": variables["moe_state"]}
+    base = dict(n_slots=2, max_len=32, decode_block=2, bucket=8)
+    pool = dict(paged=True, page_size=16) if paged else {}
+
+    def run(scfg, params_for=None):
+        eng = ServeEngine(model, params, scfg, extra_variables=extra)
+        hs = [eng.submit(p, max_new_tokens=6,
+                         params=params_for(i) if params_for else None)
+              for i, p in enumerate(prompts)]
+        eng.run()
+        return [h.tokens for h in hs]
+
+    ref = run(ServeConfig(**base, **pool))
+    got = run(ServeConfig(**base, kv_quant="int8", kv_quant_block=16,
+                          **pool))
+    assert _agreement(ref, got) >= 0.95
+    exact = run(
+        ServeConfig(**base, kv_quant="int8", kv_quant_block=16,
+                    kv_exact_lanes=2, **pool),
+        params_for=lambda i: SamplingParams(kv_exact=True),
+    )
+    assert exact == ref
+
+
+# ------------------------------------------------------ byte accounting
+
+
+def test_quant_pool_bytes_pinned_analytically(gpt_tiny):
+    """Ledger honesty: the quantized pools' nbytes decompose EXACTLY
+    into int8 payload + f32 scale rows (+ exact sidecar) computed from
+    shapes alone, and land under 0.6x of the same pool unquantized."""
+    model, params = gpt_tiny
+    cfg = GPT_TINY
+    head_dim = cfg.dim // cfg.n_heads
+    n_slots, max_len, qb = 4, 64, 16
+
+    plain = KVSlotPool(model, n_slots, max_len)
+    pool = KVSlotPool(model, n_slots, max_len, quant="int8",
+                      quant_block=qb, exact_lanes=2)
+    # per layer: k and v leaves (S, T, H, D) int8 + (S, T/qb, H) f32
+    leaf_elems = n_slots * max_len * cfg.n_heads * head_dim
+    scale_elems = np.prod(
+        scale_shape((n_slots, max_len, cfg.n_heads, head_dim), qb))
+    expect_q = 2 * cfg.n_layers * leaf_elems
+    expect_s = 2 * cfg.n_layers * scale_elems * 4
+    base_itemsize = jnp.zeros((), GPT_TINY.compute_dtype).dtype.itemsize
+    expect_exact = 2 * cfg.n_layers * (3 * max_len * cfg.n_heads
+                                       * head_dim) * base_itemsize
+    got_pool, got_s, got_e, got_base = quant_pool_bytes(pool.caches)
+    assert got_pool == expect_q + expect_s
+    assert got_s == expect_s
+    assert got_e == expect_exact
+    assert got_base == expect_q * base_itemsize
+    assert pool.nbytes == got_pool + got_e
+    assert got_base == plain.nbytes
+    # the capacity claim, pinned at the ledger: payload+scales <= 0.6x
+    assert got_pool <= 0.6 * plain.nbytes
+
+    pplain = PagedKVPool(model, n_slots, max_len, 16)
+    ppool = PagedKVPool(model, n_slots, max_len, 16, quant="int8")
+    qp, sp, ep, basep = quant_pool_bytes(ppool.phys)
+    n_pages = pplain.n_pages
+    assert qp == (2 * cfg.n_layers * n_pages * 16 * cfg.n_heads * head_dim
+                  + 2 * cfg.n_layers * n_pages * cfg.n_heads * 4)
+    assert ep == 0 and basep == pplain.nbytes
+    assert ppool.nbytes == qp
+    assert ppool.page_nbytes == qp // n_pages
+    assert qp <= 0.6 * pplain.nbytes
+
+
+def test_quant_gauges_and_statusz(gpt_tiny):
+    model, params = gpt_tiny
+    eng, _ = _run(model, params,
+                  ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=1),
+                  _prompts(2, seed=7))
+    snap = eng.metrics.snapshot()
+    pool_bytes, scale_bytes, exact_bytes, base_bytes = \
+        quant_pool_bytes(eng.pool.caches)
+    assert snap["serve/kv_bytes_per_token"] == pytest.approx(
+        pool_bytes / (BASE["n_slots"] * BASE["max_len"]))
+    assert snap["serve/kv_quant_scale_bytes"] == scale_bytes
+    assert snap["serve/kv_quant_bytes_saved"] == base_bytes - pool_bytes
+    assert snap["serve/kv_quant_exact_lanes_free"] == 1.0
+    doc = eng.statusz()
+    kq = doc["kv_quant"]
+    assert kq["mode"] == "int8"
+    assert kq["quant_bytes"] == pool_bytes
+    assert kq["baseline_bytes"] == base_bytes
+    assert kq["bytes_ratio"] == pytest.approx(pool_bytes / base_bytes,
+                                              abs=1e-4)
+    assert kq["exact_lanes_free"] == 1
+
+
+# ------------------------------------------------- programs + lifecycle
+
+
+def test_mixed_batch_shares_compiled_programs(gpt_tiny):
+    """kv_exact rides the packed control rows: a mixed exact/quantized
+    batch adds ZERO compiled prefill/decode programs over an
+    all-quantized engine (the jit-cache pin of the one-engine claim)."""
+    from solvingpapers_tpu.serve.engine import (
+        _decode_program,
+        _prefill_program,
+    )
+
+    model, params = gpt_tiny
+    prompts = _prompts(4, seed=8, lo=8, hi=9)  # one prefill bucket
+    qcfg = ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=2)
+    _run(model, params, qcfg, prompts)
+    decode_progs = _decode_program._cache_size()
+    prefill_progs = _prefill_program._cache_size()
+    _run(model, params, qcfg, prompts,
+         params_for=lambda i: SamplingParams(kv_exact=(i % 2 == 0)))
+    assert _decode_program._cache_size() == decode_progs
+    assert _prefill_program._cache_size() == prefill_progs
+
+
+def test_exact_lane_exhaustion_serializes_and_frees(gpt_tiny):
+    """More kv_exact requests than sidecar lanes: the admission gate
+    serializes them (requeue, never a crash), every stream finishes,
+    and the lane free-list drains back to full."""
+    model, params = gpt_tiny
+    prompts = _prompts(5, seed=9)
+    eng, got = _run(
+        model, params,
+        ServeConfig(**BASE, kv_quant="int8", kv_exact_lanes=1), prompts,
+        params_for=lambda i: SamplingParams(kv_exact=True),
+    )
+    _, ref = _run(model, params, ServeConfig(**BASE), prompts)
+    assert got == ref
+    assert eng._exact_free == [1]
+    assert not any(eng._eidx)
+
+
+def test_exact_lanes_release_on_cancel(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params,
+                      ServeConfig(**BASE, kv_quant="int8",
+                                  kv_exact_lanes=1))
+    req = eng.submit(_prompts(1, seed=10)[0], max_new_tokens=32,
+                     params=SamplingParams(kv_exact=True))
+    eng.step()
+    assert len(eng._exact_free) == 0
+    eng.cancel(req)
+    eng.step()
+    assert req.finish_reason == "cancelled"
+    assert eng._exact_free == [1]
+
+
+# ------------------------------------------------------------ validation
+
+
+def test_config_validation(gpt_tiny):
+    model, params = gpt_tiny
+    with pytest.raises(ValueError, match="kv_quant must be"):
+        ServeEngine(model, params, ServeConfig(**BASE, kv_quant="fp8"))
+    with pytest.raises(ValueError, match="kv_exact_lanes"):
+        ServeEngine(model, params, ServeConfig(**BASE, kv_exact_lanes=2))
+    for bad_block in (0, -16):  # -16 divides 64, so the modulo can't catch it
+        with pytest.raises(ValueError, match="kv_quant_block must be"):
+            ServeEngine(model, params,
+                        ServeConfig(**BASE, kv_quant="int8",
+                                    kv_quant_block=bad_block))
+    with pytest.raises(ValueError, match="not a multiple of the quant"):
+        ServeEngine(model, params,
+                    ServeConfig(**{**BASE, "max_len": 60},
+                                kv_quant="int8"))
+    with pytest.raises(ValueError, match="prefix_page"):
+        ServeEngine(model, params,
+                    ServeConfig(**BASE, kv_quant="int8", prefix_cache=True,
+                                prefix_page=24, kv_quant_block=16))
+    eng = ServeEngine(model, params, ServeConfig(**BASE, kv_quant="int8"))
+    with pytest.raises(ValueError, match="kv_exact requests need"):
+        eng.submit(np.arange(4, dtype=np.int32),
+                   params=SamplingParams(kv_exact=True))
+    # kv_exact on an UNQUANTIZED engine is a documented no-op
+    eng2 = ServeEngine(model, params, ServeConfig(**BASE))
+    req = eng2.submit(np.arange(4, dtype=np.int32), max_new_tokens=2,
+                      params=SamplingParams(kv_exact=True))
+    eng2.run()
+    assert req.done
+
+
+def test_quant_pool_rejects_plain_segment_splice(gpt_tiny):
+    model, params = gpt_tiny
+    plain = KVSlotPool(model, 2, 64)
+    pool = KVSlotPool(model, 2, 64, quant="int8", quant_block=16)
+    seg = plain.extract_prefix(0, 0, 16)
+    with pytest.raises(TypeError, match="QuantSegment"):
+        pool.splice_prefix(0, seg)
+    qseg = pool.extract_prefix(0, 0, 16)
+    assert isinstance(qseg, QuantSegment)
+    with pytest.raises(ValueError, match="not aligned"):
+        pool.splice_prefix(0, qseg, offset=8)
+
+
+def test_written_stores_preserve_committed_codes_bf16():
+    """Committed positions below the write frontier keep their int8
+    codes BYTE-exact when their block/page is rewritten and the write
+    leaves the block absmax unchanged — even on bf16 pools, where the
+    lane view the programs write from is a lossy cast of the stored
+    values. The store helpers merge unwritten positions from their own
+    f32-dequantized codes (quantize(dequantize(q, s)) is only a fixed
+    point in f32: a bf16 round trip perturbs the absmax and walks
+    codes), so repeated decode steps cannot random-walk committed
+    entries on any compute dtype. (When a write DOES raise the block
+    absmax, committed codes legitimately re-encode against the new
+    scale — values stay within scale/2; that is block quantization.)"""
+    from solvingpapers_tpu.ops.quant import dequantize, quantize
+    from solvingpapers_tpu.serve.kv_pool import (
+        QuantStore,
+        quant_scatter_written_pages,
+        quant_store_written,
+    )
+
+    rng = np.random.default_rng(7)
+    # awkward magnitudes: bf16's 8-bit mantissa perturbs dequantized
+    # values enough to flip codes on a lane-view round trip
+    x = jnp.asarray(
+        rng.uniform(-93.7, 93.7, size=(2, 32, 2, 8)), jnp.float32
+    )
+    # plant each frontier block's absmax at a COMMITTED position so the
+    # write below cannot change the scale
+    x = x.at[0, 9].set(93.7).at[1, 17].set(93.7)
+    block = 8
+    q0, s0 = quantize(x, block)
+
+    # --- lane pool: rewrite the blocks around each slot's frontier
+    store = QuantStore(q=q0, scale=s0, exact=None, block=block,
+                       dtype=jnp.bfloat16)
+    lanes = dequantize(q0, s0, jnp.bfloat16)  # what the program gathers
+    pos0 = jnp.array([12, 20], jnp.int32)
+    span = 4
+    new = jnp.asarray(rng.uniform(-50, 50, size=(2, span, 2, 8)),
+                      jnp.bfloat16)
+    for s in range(2):
+        lanes = jax.lax.dynamic_update_slice(
+            lanes, new[s:s + 1], (s, int(pos0[s]), 0, 0))
+    out = quant_store_written(store, lanes, pos0,
+                              span, jnp.zeros((2,), jnp.int32))
+    for s in range(2):
+        lo = int(pos0[s])
+        np.testing.assert_array_equal(
+            np.asarray(out.q[s, :lo]), np.asarray(q0[s, :lo]),
+            err_msg=f"slot {s}: committed codes below pos0 drifted",
+        )
+    # sanity: the written span actually took the new values' codes
+    assert not np.array_equal(
+        np.asarray(out.q[0, 12:16]), np.asarray(q0[0, 12:16]))
+    # and a second identical store is idempotent
+    out2 = quant_store_written(out, lanes, pos0,
+                               span, jnp.zeros((2,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out2.q), np.asarray(out.q))
+
+    # --- paged pool: same contract through the lo/hi window merge
+    page = 8
+    px = jnp.asarray(
+        rng.uniform(-93.7, 93.7, size=(5, page, 2, 8)), jnp.float32
+    )
+    px = px.at[2, 1].set(93.7)  # committed absmax in the frontier page
+    pq0, ps0 = quantize(px, page)
+    pstore = QuantStore(q=pq0, scale=ps0, exact=None, block=page,
+                        dtype=jnp.bfloat16)
+    table = jnp.array([[1, 2, 3]], jnp.int32)  # 1 slot, 3 logical pages
+    gathered = dequantize(pq0, ps0, jnp.bfloat16)[
+        jnp.array([1, 2, 3])].reshape(1, 3 * page, 2, 8)
+    pos = jnp.array([12], jnp.int32)  # mid page 1: logical 8..15
+    lanes = jax.lax.dynamic_update_slice(
+        gathered, new[:1], (0, 12, 0, 0))
+    pout = quant_scatter_written_pages(pstore, lanes, table, pos,
+                                       lo=pos, hi=pos + span)
+    np.testing.assert_array_equal(
+        np.asarray(pout.q[2, :4]), np.asarray(pq0[2, :4]),
+        err_msg="committed codes below the page write window drifted",
+    )
+    assert not np.array_equal(
+        np.asarray(pout.q[2, 4:]), np.asarray(pq0[2, 4:]))
+    # untargeted physical pages are untouched entirely
+    np.testing.assert_array_equal(np.asarray(pout.q[1]),
+                                  np.asarray(pq0[1]))
+    np.testing.assert_array_equal(np.asarray(pout.q[3]),
+                                  np.asarray(pq0[3]))
+
+
+def test_spec_writeback_excludes_rejected_draft_tail():
+    """The speculative write-back bounds the requantized window by the
+    device-committed end on EVERY compute dtype: a rejected draft's
+    outlier activation past `hi` must neither enter the codes nor
+    inflate the block/page absmax scale that committed tokens share
+    (that coarsening would be locked in even after the garbage is
+    overwritten)."""
+    from solvingpapers_tpu.ops.quant import dequantize, quantize
+    from solvingpapers_tpu.serve.kv_pool import (
+        QuantStore,
+        quant_scatter_window_pages,
+        quant_store_written,
+    )
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.uniform(-1.0, 1.0, size=(1, 16, 2, 4)),
+                    jnp.float32)
+    # pin each block's absmax OUTSIDE the written window so the commit
+    # cannot change the scale — code equality at the tail is then exact
+    x = x.at[:, 7].set(1.0).at[:, 15].set(1.0)
+    block = 8
+    q0, s0 = quantize(x, block)
+    store = QuantStore(q=q0, scale=s0, exact=None, block=block,
+                       dtype=jnp.float32)
+    lanes = dequantize(q0, s0, jnp.float32)
+    # commit 2 tokens at [4, 6); plant a rejected-draft OUTLIER at 6
+    pos0 = jnp.array([4], jnp.int32)
+    committed = jnp.asarray(rng.uniform(-1, 1, size=(1, 2, 2, 4)),
+                            jnp.float32)
+    lanes = lanes.at[:, 4:6].set(committed)
+    lanes = lanes.at[:, 6].set(1000.0)
+    out = quant_store_written(store, lanes, pos0, 4,
+                              jnp.zeros((1,), jnp.int32),
+                              hi=pos0 + 2, tail_garbage=True)
+    # the outlier never reached the codes or the scale
+    assert float(out.scale.max()) < 1000.0 / 127.0
+    np.testing.assert_array_equal(np.asarray(out.q[0, 6]),
+                                  np.asarray(q0[0, 6]))
+    # committed values survive at fine-scale precision
+    got = dequantize(out.q, out.scale, jnp.float32)[0, 4:6]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(committed[0]),
+                               atol=float(out.scale.max()) / 2 + 1e-6)
+
+    # paged path: same contract through quant_scatter_window_pages
+    px = jnp.asarray(rng.uniform(-1.0, 1.0, size=(3, 8, 2, 4)),
+                     jnp.float32)
+    px = px.at[:, 7].set(1.0)
+    pq0, ps0 = quantize(px, 8)
+    pstore = QuantStore(q=pq0, scale=ps0, exact=None, block=8,
+                        dtype=jnp.float32)
+    table = jnp.array([[1, 2]], jnp.int32)
+    glanes = dequantize(pq0, ps0, jnp.float32)[
+        jnp.array([1, 2])].reshape(1, 16, 2, 4)
+    glanes = glanes.at[:, 4:6].set(committed)
+    glanes = glanes.at[:, 6].set(1000.0)
+    pout = quant_scatter_window_pages(pstore, glanes, table,
+                                      jnp.array([4], jnp.int32),
+                                      jnp.array([5], jnp.int32), 4)
+    assert float(pout.scale.max()) < 1000.0 / 127.0
+    np.testing.assert_array_equal(np.asarray(pout.q[1, 6]),
+                                  np.asarray(pq0[1, 6]))
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["lane", "paged"])
+def test_prefill_pads_never_reach_quant_codes(gpt_tiny, paged):
+    """The prefill write sites pass the real-token end (`hi`) through to
+    `quant_store_lane` / `quant_scatter_lane_pages`: prompts right-pad
+    to the bucket, the model computes KV for the pad positions, and
+    quantizing those activations into the tail block/page would inflate
+    its absmax scale and permanently coarsen the last committed prompt
+    tokens' codes (the scale/2 bound degrades with the scale). Pads must
+    land as ZERO codes instead — zeros can never widen a scale — and
+    the later decode rewrites of the shared block re-encode them from
+    those zero codes, so the tail past the decode frontier stays zero
+    for the stream's whole life."""
+    model, params = gpt_tiny
+    scfg = ServeConfig(n_slots=1, max_len=64, decode_block=4, bucket=16,
+                       kv_quant="int8", kv_quant_block=16, paged=paged,
+                       page_size=16 if paged else None)
+    eng = ServeEngine(model, params, scfg)
+    prompt = np.arange(1, 11, dtype=np.int32)  # length 10 -> padded 16
+    h = eng.submit(prompt, max_new_tokens=2)
+    pid = None
+    while not h.done:
+        eng.step()
+        if paged and pid is None and eng.pool.table[0, 0] != 0:
+            pid = int(eng.pool.table[0, 0])  # before release resets it
+    store = eng.pool.phys if paged else eng.pool.caches
+    row = pid if paged else 0
+    # positions [14, 16) were only ever written by the prefill (decode
+    # block 4 writes at most [10, 14)): real pads, zeroed under `hi`
+    for qleaf in jax.tree_util.tree_leaves(store.q):
+        assert not np.any(np.asarray(qleaf[row, 14:16])), \
+            "right-padding activations reached the quantized codes"

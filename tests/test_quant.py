@@ -1,0 +1,160 @@
+"""Property tests for ops/quant.py — the int8 KV-cache primitive behind
+`ServeConfig.kv_quant`.
+
+The properties the serving pools lean on: round-trip error bounded by
+half a scale step per block, block absmax mapping to +-127 exactly (the
+requantization-stability anchor), all-zero blocks round-tripping
+bit-exact (fresh pools hold zeros), and the sidecar scale shapes pinned
+for both the lane layout (time-blocked lanes) and the page layout
+(block == page_size, one scale row per physical page).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.ops.quant import (
+    dequantize,
+    dequantize_tree,
+    quantize,
+    quantize_tree,
+    scale_shape,
+)
+
+pytestmark = pytest.mark.fast
+
+
+def _lane_leaf(shape, seed=0, scale=3.0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(0.0, scale, size=shape), jnp.float32)
+
+
+@pytest.mark.parametrize(
+    "shape,block",
+    [((2, 64, 4, 8), 16), ((3, 32, 12), 8), ((1, 48, 2, 16), 48)],
+    ids=["kv-lane", "latent-lane", "kv-page"],
+)
+def test_roundtrip_error_bounded_by_half_scale(shape, block):
+    """|x - deq(q)| <= scale/2 for every entry, against the entry's OWN
+    block scale — the symmetric-absmax bound the quality gate rides on."""
+    x = _lane_leaf(shape, seed=1)
+    q, scale = quantize(x, block)
+    assert q.dtype == jnp.int8
+    deq = dequantize(q, scale, jnp.float32)
+    err = np.abs(np.asarray(x) - np.asarray(deq))
+    # broadcast each entry's block scale back over the leaf layout
+    b, t = shape[0], shape[1]
+    nb = t // block
+    s = np.asarray(scale)
+    if len(shape) == 4:
+        s_full = np.broadcast_to(
+            s[:, :, None, :, None], (b, nb, block, shape[2], shape[3])
+        ).reshape(shape)
+    else:
+        s_full = np.broadcast_to(
+            s[:, :, None, None], (b, nb, block, shape[2])
+        ).reshape(shape)
+    assert np.all(err <= s_full / 2 + 1e-6 * s_full + 1e-12)
+
+
+def test_absmax_entries_map_to_pm127_exactly():
+    """Each block's max-magnitude entry quantizes to exactly +-127 —
+    which is also why requantizing a dequantized block with an unchanged
+    absmax is a fixed point (127 * scale == absmax)."""
+    x = _lane_leaf((2, 32, 2, 8), seed=2)
+    block = 8
+    q, scale = quantize(x, block)
+    xs = np.asarray(x).reshape(2, 4, block, 2, 8)
+    qs = np.asarray(q).reshape(2, 4, block, 2, 8)
+    flat_x = np.abs(xs).transpose(0, 1, 3, 2, 4).reshape(2, 4, 2, -1)
+    flat_q = np.abs(qs).transpose(0, 1, 3, 2, 4).reshape(2, 4, 2, -1)
+    arg = np.argmax(flat_x, axis=-1)
+    picked = np.take_along_axis(flat_q, arg[..., None], axis=-1)[..., 0]
+    assert np.all(picked == 127)
+    # and the scale is absmax / 127 for every (batch, block, head) row
+    np.testing.assert_allclose(
+        np.asarray(scale), flat_x.max(axis=-1) / 127.0, rtol=1e-6
+    )
+
+
+def test_requantize_of_dequantized_block_is_fixed_point():
+    """quantize(dequantize(q, s)) == (q, s) when the block content is
+    untouched — the property that lets the serving programs requantize
+    only written windows without drifting their neighbours."""
+    x = _lane_leaf((2, 64, 4, 8), seed=3)
+    q, s = quantize(x, 16)
+    deq = dequantize(q, s, jnp.float32)
+    q2, s2 = quantize(deq, 16)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    np.testing.assert_allclose(np.asarray(s), np.asarray(s2), rtol=1e-6)
+
+
+def test_all_zero_blocks_roundtrip_bit_exact():
+    """Zero pages (fresh pools, zero-padded lanes) must survive exactly:
+    scale 0, q 0, dequant 0 — never a NaN from a 0/0."""
+    x = jnp.zeros((2, 32, 3, 4), jnp.float32)
+    q, scale = quantize(x, 16)
+    assert np.all(np.asarray(q) == 0)
+    assert np.all(np.asarray(scale) == 0.0)
+    deq = dequantize(q, scale, jnp.float32)
+    assert np.all(np.asarray(deq) == 0.0)
+    assert np.all(np.isfinite(np.asarray(deq)))
+    # mixed: one zero block next to a live one stays exact
+    x = x.at[:, 16:].set(_lane_leaf((2, 16, 3, 4), seed=4))
+    q, scale = quantize(x, 16)
+    deq = dequantize(q, scale, jnp.float32)
+    assert np.all(np.asarray(deq[:, :16]) == 0.0)
+
+
+def test_scale_shapes_pinned_for_lane_and_page_layouts():
+    """The sidecar shapes three pools depend on: lane KV leaves carry
+    (S, T/block, H) scales, latent lanes (S, T/block), and page-pool
+    leaves (pages, 1, H) / (pages, 1) — one scale row per physical page
+    (block == page_size), which is what lets scales ride the page
+    tables."""
+    assert scale_shape((8, 128, 4, 32), 16) == (8, 8, 4)
+    assert scale_shape((8, 128, 96), 16) == (8, 8)
+    # page layout: batch dim IS the page id, time dim == page_size
+    assert scale_shape((65, 16, 4, 32), 16) == (65, 1, 4)
+    assert scale_shape((65, 16, 96), 16) == (65, 1)
+    q, s = quantize(_lane_leaf((8, 128, 4, 32)), 16)
+    assert s.shape == (8, 8, 4) and s.dtype == jnp.float32
+    q, s = quantize(_lane_leaf((65, 16, 96)), 16)
+    assert s.shape == (65, 1)
+    with pytest.raises(ValueError):
+        scale_shape((8, 100, 4, 32), 16)  # block must tile time
+    with pytest.raises(ValueError):
+        scale_shape((8, 100), 16)  # not a cache-leaf layout
+
+
+def test_quantize_is_traceable_and_clip_symmetric():
+    """Traced under jit (the pools quantize inside the serving
+    programs), and the code space stays symmetric: -128 never appears."""
+    x = _lane_leaf((2, 32, 2, 8), seed=5, scale=50.0)
+    q, scale = jax.jit(lambda a: quantize(a, 8))(x)
+    assert int(np.asarray(q).min()) >= -127
+    deq = jax.jit(lambda a, b: dequantize(a, b, jnp.bfloat16))(q, scale)
+    assert deq.dtype == jnp.bfloat16
+
+
+def test_tree_helpers_preserve_structure():
+    from solvingpapers_tpu.infer.cache import KVCache, LatentCache
+
+    tree = [KVCache.init(2, 32, 2, 8, jnp.float32),
+            LatentCache.init(2, 32, 24, jnp.float32)]
+    tree = jax.tree_util.tree_map(
+        lambda a: a + _lane_leaf(a.shape, seed=6), tree
+    )
+    q_tree, s_tree = quantize_tree(tree, 16)
+    assert isinstance(q_tree[0], KVCache) and isinstance(s_tree[0], KVCache)
+    assert q_tree[0].k.dtype == jnp.int8
+    assert s_tree[0].k.shape == (2, 2, 2)
+    assert s_tree[1].c.shape == (2, 2)
+    deq = dequantize_tree(q_tree, s_tree, jnp.float32)
+    err = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), tree, deq
+    )
+    for leaf_err, leaf in zip(jax.tree_util.tree_leaves(err),
+                              jax.tree_util.tree_leaves(tree)):
+        assert leaf_err <= float(jnp.max(jnp.abs(leaf))) / 127.0

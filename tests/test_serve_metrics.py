@@ -198,6 +198,50 @@ def test_spec_gauges_present_iff_speculation_enabled():
         assert PrometheusTextWriter.sanitize(k).startswith("serve_")
 
 
+def test_kv_quant_gauges_present_iff_quantized_pool():
+    """serve/kv_bytes_per_token + serve/kv_quant_* appear exactly when
+    the engine's pool is quantized (gauge provider registered iff
+    ServeConfig.kv_quant), the exact-lane pair only with a sidecar
+    configured, and the byte gauges decompose analytically."""
+    import jax
+    import jax.numpy as jnp
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+    from solvingpapers_tpu.serve.kv_pool import quant_pool_bytes
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    plain = ServeEngine(model, params, ServeConfig(n_slots=2, max_len=32))
+    assert not any(k.startswith(("serve/kv_bytes", "serve/kv_quant"))
+                   for k in plain.metrics.snapshot())
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, kv_quant="int8", kv_quant_block=16,
+    ))
+    snap = eng.metrics.snapshot()
+    pool_bytes, scale_bytes, _, base_bytes = quant_pool_bytes(
+        eng.pool.caches)
+    assert snap["serve/kv_bytes_per_token"] == pytest.approx(
+        pool_bytes / (2 * 32))
+    assert snap["serve/kv_quant_scale_bytes"] == float(scale_bytes)
+    assert snap["serve/kv_quant_bytes_saved"] == float(
+        base_bytes - pool_bytes)
+    # no sidecar configured -> the exact-lane pair stays absent
+    assert "serve/kv_quant_exact_lanes_free" not in snap
+    ex = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, kv_quant="int8", kv_exact_lanes=2,
+    ))
+    esnap = ex.metrics.snapshot()
+    assert esnap["serve/kv_quant_exact_lanes_free"] == 2.0
+    assert esnap["serve/kv_quant_exact_active"] == 0.0
+    for k in ("serve/kv_bytes_per_token", "serve/kv_quant_scale_bytes",
+              "serve/kv_quant_bytes_saved",
+              "serve/kv_quant_exact_lanes_free"):
+        assert PrometheusTextWriter.sanitize(k).startswith("serve_")
+
+
 # ------------------------------------- observatory gauges (mem/compile)
 
 

@@ -26,7 +26,15 @@ client-side TTFT/p99 ITL, http_overhead_pct, stream_token_exact), and
 with `--speculative --append` for the speculative-decoding workload
 (spec-on vs spec-off delivered tokens/sec on a briefly-trained model,
 greedy token-exactness, acceptance rate, and the temperature-2.0
-zero-acceptance adversarial overhead).
+zero-acceptance adversarial overhead), and with `--kv-quant int8
+--append` for the quantized-KV workload (teacher-forced greedy-token
+agreement vs the exact pool on a briefly-trained model, ABBA-paired
+like-for-like Poisson overhead, and an equal-HBM capacity arm booking
+int8+scale slots at the f32 paged pool's resident byte budget).
+
+Every entry records the `kv_dtype` / `kv_pool_bytes` /
+`greedy_agreement_rate` triple (exact pools report their compute dtype
+and 1.0) so the trajectory stays comparable across quantized rounds.
 
 Add `--trace` to any workload to run one extra flight-recorded arm: the
 entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
@@ -57,7 +65,11 @@ def main() -> int:
         # --speculative trains the model briefly before benching (draft
         # quality is the mechanism) — gpt_tiny fits a few hundred steps
         # in seconds
-        if "--speculative" in argv:
+        def flagged(name):
+            # value-taking flags also spell --flag=value
+            return any(a == name or a.startswith(name + "=") for a in argv)
+
+        if flagged("--speculative") or flagged("--kv-quant"):
             default = "gpt_tiny_long"
         elif "--shared-prefix" in argv or "--paged" in argv:
             default = "gpt_shakespeare"
