@@ -469,6 +469,11 @@ def cmd_serve(args) -> int:
     def decode(ids):
         return "".join(table[int(i)] or "" for i in ids)
 
+    slo_targets = None
+    if args.slo:
+        from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
+
+        slo_targets = DEFAULT_SLO_TARGETS
     limit = getattr(model, "max_positions", None) or 512
     max_len = args.max_len or min(512, limit)
     serve_cfg = ServeConfig(
@@ -488,6 +493,8 @@ def cmd_serve(args) -> int:
         api_host=args.host,
         json_mode=not args.no_json_mode,
         max_waiting=args.max_waiting,
+        trace=args.trace,
+        slo_targets=slo_targets,
     )
     engine = ServeEngine(model, params, serve_cfg,
                          extra_variables=extra or None, detokenize=decode)
@@ -530,19 +537,21 @@ def cmd_serve_bench(args) -> int:
         )
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
-            args.speculative, args.kv_quant is not None)) > 1:
+            args.speculative, args.slo, args.kv_quant is not None)) > 1:
         print("--shared-prefix, --sampling, --paged, --http, "
-              "--speculative and --kv-quant are separate workloads; "
-              "pick one per run",
+              "--speculative, --slo and --kv-quant are separate "
+              "workloads; pick one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
+        bench_provenance,
         run_http_bench,
         run_paged_bench,
         run_prefix_bench,
         run_quant_bench,
         run_sampling_bench,
         run_serve_bench,
+        run_slo_bench,
         run_spec_bench,
     )
 
@@ -597,6 +606,19 @@ def cmd_serve_bench(args) -> int:
             prompt_lens=tuple(prompt_lens),
             mean_interarrival_s=args.mean_interarrival,
             train_steps=args.spec_train_steps,
+            seed=args.seed,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.slo:
+        result = run_slo_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=args.slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=args.mean_interarrival,
             seed=args.seed,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
@@ -669,6 +691,12 @@ def cmd_serve_bench(args) -> int:
             skip_sequential=args.skip_sequential,
             **trace_kwargs,
         )
+    # identity stamp (schema v2): ONE clock reading injected here — the
+    # single place entries are written — so every entry is attributable
+    # to a git sha / jax / host after any future rebase
+    import time as _time
+
+    result = {**bench_provenance(timestamp=_time.time()), **result}
     line = json.dumps(result)
     print(line)
     if args.out:
@@ -947,6 +975,16 @@ def main(argv=None) -> int:
                               "2.0 zero-acceptance adversarial arm "
                               "(serve/bench.py run_spec_bench; defaults "
                               "max-new-tokens 160, decode-block 8)")
+    p_serve.add_argument("--slo", action="store_true",
+                         help="SLO-observatory workload instead: the "
+                              "Poisson trace with per-request SLO "
+                              "classes (interactive/standard/batch "
+                              "cycle) through an slo_targets-enabled "
+                              "engine, ABBA-paired against the plain "
+                              "engine — slo_overhead_pct (<= 2%% "
+                              "budget), per-class attainment, burn "
+                              "rates and goodput_tokens_per_s "
+                              "(serve/bench.py run_slo_bench)")
     p_serve.add_argument("--kv-quant", default=None, choices=["int8"],
                          help="quantized-KV workload instead: int8 cache "
                               "storage vs exact on a briefly-trained "
@@ -1085,6 +1123,18 @@ def main(argv=None) -> int:
     p_srv.add_argument("--no-json-mode", action="store_true",
                        help="reject response_format json_object instead "
                             "of grammar-constraining the decode")
+    p_srv.add_argument("--slo", action="store_true",
+                       help="account every request under an SLO class "
+                            "(serve/slo.py DEFAULT_SLO_TARGETS: "
+                            "interactive/standard/batch; requests tag "
+                            "one via the 'slo' body field, default "
+                            "standard) — per-class attainment, burn "
+                            "rate and goodput ride /metrics + /statusz")
+    p_srv.add_argument("--trace", action="store_true",
+                       help="flight recorder on (ServeConfig.trace): "
+                            "HTTP accept/parse/handoff/drain spans join "
+                            "engine lifecycle spans per request; "
+                            "GET /v1/requests/<id> works either way")
     p_srv.add_argument("--seed", type=int, default=0)
 
     p_tsum = sub.add_parser("trace-summary")

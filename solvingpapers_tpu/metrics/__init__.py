@@ -1,5 +1,6 @@
 """Metrics and observability (L7)."""
 
+from solvingpapers_tpu.metrics.hist import LogHistogram
 from solvingpapers_tpu.metrics.writer import (
     MetricsWriter,
     ConsoleWriter,

@@ -78,7 +78,8 @@ class TraceEvent:
 # fixed display order for the well-known tracks; slot tracks sort by
 # index after them, then per-device stage tracks (the mesh observatory's
 # pipeline lanes), anything else alphabetically at the end
-_TRACK_ORDER = {"engine": 0, "queue": 1, "prefix": 2, "train": 3, "mesh": 4}
+_TRACK_ORDER = {"engine": 0, "queue": 1, "prefix": 2, "http": 3,
+                "train": 4, "mesh": 5}
 
 
 def _track_sort_key(track: str) -> tuple:
@@ -276,6 +277,14 @@ class AnomalyMonitor:
         rolling median of the last `step_window` step durations (armed
         after `min_steps` observations so compile-warm steps don't trip
         it).
+
+    Past `max_dumps` records the file ROTATES keep-newest: the oldest
+    record is rewritten out to make room (atomic tmp + rename, same
+    fsync discipline), and the first rotation warns once. A hard cap
+    that silently dropped every LATER incident — which is what this
+    class did before — buries exactly the dumps a live incident needs:
+    the most recent ones. `dumps` counts every dump ever taken; the
+    file holds the newest `max_dumps` of them.
     """
 
     def __init__(
@@ -303,6 +312,7 @@ class AnomalyMonitor:
         self.reject_burst = reject_burst
         self.max_dumps = max_dumps
         self.dumps = 0
+        self._rotation_warned = False
         self._steps: deque[float] = deque(maxlen=step_window)
         self._consec_rejects = 0
         parent = os.path.dirname(path)
@@ -339,9 +349,6 @@ class AnomalyMonitor:
                   new_signatures=new_signatures, window_s=window_s)
 
     def dump(self, kind: str, **detail) -> None:
-        if self.dumps >= self.max_dumps:
-            return
-        self.dumps += 1
         rec = {
             "kind": kind,
             "ts": self.recorder.clock(),
@@ -349,10 +356,43 @@ class AnomalyMonitor:
             "metrics": self.snapshot_fn() if self.snapshot_fn else None,
             "events": [e.to_dict() for e in self.recorder.last(self.last_n)],
         }
-        with open(self.path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        line = json.dumps(rec)
+        if self.dumps < self.max_dumps:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        else:
+            # keep-newest rotation: rewrite the file with the oldest
+            # record dropped (atomic tmp + replace, so a crash mid-
+            # rotation never truncates the JSONL). Anomalies are rare
+            # and the file is bounded by max_dumps, so the rewrite cost
+            # is noise next to the dump's own event serialization.
+            if not self._rotation_warned:
+                self._rotation_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"anomaly dump cap ({self.max_dumps}) reached at "
+                    f"{self.path}: rotating keep-newest from here on "
+                    "(oldest records drop out)",
+                    RuntimeWarning, stacklevel=2,
+                )
+            try:
+                with open(self.path) as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            lines = lines[-(self.max_dumps - 1):] if self.max_dumps > 1 \
+                else []
+            lines.append(line)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write("\n".join(lines) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        self.dumps += 1
 
 
 # ---------------------------------------------------------------- summary
@@ -360,6 +400,14 @@ class AnomalyMonitor:
 # lifecycle phases in timeline order; the spans partition a request's wall
 # time (queue + prefill + decode == finish - submit) by construction
 _PHASES = ("queue", "prefill", "decode")
+
+# HTTP front-door phases (serve/api.py, cat "http") in timeline order:
+# accept + parse + queue_handoff precede the engine's queue span and
+# sse_drain follows its decode span — contiguous stamps on the same
+# clock, so http phases + engine phases partition the server-observed
+# e2e wall. Joined into per-request rows when present (a PR-8-era or
+# direct-submit trace summarizes without them).
+_HTTP_PHASES = ("accept", "parse", "queue_handoff", "sse_drain")
 
 
 def load_chrome(path: str) -> list[dict]:
@@ -463,10 +511,25 @@ def summarize_trace(trace) -> dict:
         })
 
     rejected = 0
+    disconnects = 0
+    # http spans collected side-band and attached only to requests that
+    # earn a timeline row below — an in-flight request's accept span
+    # must not create a zero-phase row of its own
+    http_spans: dict[int, dict] = {}
     for e in events:
         args = e.get("args") or {}
         rid = args.get("req")
-        if rid is None or e.get("cat") != "request":
+        if rid is None:
+            continue
+        if e.get("cat") == "http":
+            if e.get("ph") == "X" and e.get("name") in _HTTP_PHASES:
+                d = http_spans.setdefault(rid, {})
+                d[e["name"]] = (d.get(e["name"], 0.0)
+                                + e.get("dur", 0.0) / 1e6)
+            elif e.get("name") == "disconnect":
+                disconnects += 1
+            continue
+        if e.get("cat") != "request":
             continue
         if e.get("name") == "reject":
             rejected += 1
@@ -503,6 +566,21 @@ def summarize_trace(trace) -> dict:
             if name and name.startswith("slot"):
                 reqs[rid]["slot"] = name
 
+    # join the http phases onto served requests: `e2e_s` is the end-to-
+    # end wall (http + engine phases — the partition extended across the
+    # HTTP boundary); engine-only rows keep total_s as their whole story
+    http_totals = dict.fromkeys(_HTTP_PHASES, 0.0)
+    any_http = False
+    for rid, hp in http_spans.items():
+        r = reqs.get(rid)
+        if r is None:
+            continue
+        any_http = True
+        r["http_phases"] = {k: hp[k] for k in _HTTP_PHASES if k in hp}
+        r["e2e_s"] = r["total_s"] + sum(hp.values())
+        for k, v in hp.items():
+            http_totals[k] += v
+
     ordered = sorted(reqs.values(), key=lambda r: -r["total_s"])
     finish_reasons: dict[str, int] = {}
     phase_totals = dict.fromkeys(_PHASES, 0.0)
@@ -521,6 +599,13 @@ def summarize_trace(trace) -> dict:
         "phase_totals_s": phase_totals,
         "programs": _program_roofline(events),
     }
+    if any_http:
+        # present IFF the trace holds front-door spans — a direct-submit
+        # or PR-8-era trace summarizes with the key ABSENT
+        summary["http"] = {
+            "phase_totals_s": http_totals,
+            "disconnects": disconnects,
+        }
     mesh = _mesh_section(events)
     if mesh is not None:
         # present IFF the trace holds mesh-observatory events — a PR-4/5
@@ -710,6 +795,14 @@ def format_summary(summary: dict, top: int = 5) -> str:
             f"{ph.get('decode', 0.0):>9.4f} {str(r['slot'] or '-'):>6}  "
             f"{r['finish_reason'] or '-'}"
         )
+    http = summary.get("http")
+    if http:
+        totals = http["phase_totals_s"]
+        parts = "  ".join(f"{k}={totals[k]:.4f}s" for k in _HTTP_PHASES)
+        lines.append("")
+        lines.append(f"http front door: {parts}")
+        if http.get("disconnects"):
+            lines.append(f"  disconnects: {http['disconnects']}")
     roofline = format_roofline(summary.get("programs") or {})
     if roofline:
         lines.append("")

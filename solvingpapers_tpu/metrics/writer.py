@@ -19,6 +19,8 @@ from typing import IO, Iterable, Mapping
 
 import numpy as np
 
+from solvingpapers_tpu.metrics.hist import LogHistogram
+
 
 def percentiles(
     values: Iterable[float], qs: tuple[float, ...] = (50, 95, 99)
@@ -79,6 +81,11 @@ class Ring:
 
 
 class MetricsWriter:
+    # sinks that can render a `metrics.hist.LogHistogram` value natively
+    # set this True; emitters (ServeMetrics.emit) feed everyone else the
+    # flat float summary instead, so JSONL/wandb/console never see one
+    accepts_histograms = False
+
     def write(self, step: int, metrics: Mapping[str, float]) -> None:
         raise NotImplementedError
 
@@ -148,7 +155,18 @@ class PrometheusTextWriter(MetricsWriter):
     No wandb/TensorBoard dependency: point node_exporter's
     ``--collector.textfile.directory`` at the parent directory and the
     serve/train metrics are scrapeable as gauges.
+
+    `metrics.hist.LogHistogram` values render as NATIVE Prometheus
+    histograms (``<name>_bucket{le="..."}`` cumulative series + the
+    ``_sum``/``_count`` pair) instead of gauges, on this textfile path
+    and the live `/metrics` pull path alike (both go through `render`).
+    Every bucket edge is emitted even at zero count: PromQL's
+    ``sum by (le)`` across replicas needs ALIGNED `le` label sets, and
+    the fixed layout is exactly what makes per-replica aggregation
+    (`histogram_quantile(0.99, sum by (le) (rate(...)))`) correct.
     """
+
+    accepts_histograms = True
 
     def __init__(self, path: str, prefix: str = ""):
         parent = os.path.dirname(path)
@@ -187,16 +205,43 @@ class PrometheusTextWriter(MetricsWriter):
         the same series twice, and the textfile collector rejects the
         ENTIRE file on a duplicate — one colliding key must not blind
         every dashboard. The `last_step` staleness rider yields to a
-        user metric of the same name for the same reason.
+        user metric of the same name for the same reason. Histogram
+        values claim their ``_bucket``/``_sum``/``_count`` derived names
+        ahead of any gauge that would collide with them.
         """
         gauges: dict[str, str] = {}
+        hists: dict[str, LogHistogram] = {}
         for k, v in metrics.items():
-            gauges[prefix + cls.sanitize(k)] = cls._fmt(float(v))
+            name = prefix + cls.sanitize(k)
+            if isinstance(v, LogHistogram):
+                hists[name] = v
+            else:
+                gauges[name] = cls._fmt(float(v))
+        reserved = {
+            f"{h}{suffix}"
+            for h in hists for suffix in ("_bucket", "_sum", "_count")
+        }
+        for name in reserved & set(gauges):
+            del gauges[name]  # the histogram's series win the collision
         gauges.setdefault(f"{prefix}last_step", str(int(step)))
         lines = []
         for name, value in gauges.items():
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {value}")
+        for name, h in hists.items():
+            lines.append(f"# TYPE {name} histogram")
+            # ONE cumulative pass feeds both the buckets and _count, so
+            # the +Inf bucket == _count invariant (which OpenMetrics
+            # parsers and histogram_quantile enforce) holds even when a
+            # serving thread records into the live histogram mid-render
+            # — a concurrently-added observation is wholly absent from
+            # this scrape rather than torn across its series
+            cums = h.cumulative_counts()
+            for le, cum in zip(h.bucket_bounds(), cums):
+                label = "+Inf" if le == float("inf") else repr(float(le))
+                lines.append(f'{name}_bucket{{le="{label}"}} {cum}')
+            lines.append(f"{name}_sum {cls._fmt(h.sum)}")
+            lines.append(f"{name}_count {cums[-1] if cums else 0}")
         return "\n".join(lines) + "\n"
 
     def write(self, step: int, metrics: Mapping[str, float]) -> None:

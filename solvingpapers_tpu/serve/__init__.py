@@ -19,6 +19,7 @@ from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
 from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request
+from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS, SloTracker
 from solvingpapers_tpu.serve.spec import SpecController
 
 __all__ = [
@@ -39,5 +40,7 @@ __all__ = [
     "fused_sample",
     "FIFOScheduler",
     "Request",
+    "DEFAULT_SLO_TARGETS",
+    "SloTracker",
     "SpecController",
 ]

@@ -32,6 +32,21 @@ queue (or the paged pool's page-budget gate rejecting) answers 503 +
 Retry-After, invalid requests answer 400 with the OpenAI error
 envelope (serve/openai.py) — never a traceback over a socket.
 
+Request tracing rides every completion: the front door honors an
+`X-Request-Id` header (minting one when absent or malformed), echoes it
+on the response, stamps it on the engine `Request`, and — when the
+engine's flight recorder is on — records HTTP-layer spans (`accept` =
+headers->body read, `parse` = body->validated, `queue_handoff` =
+validated->engine submit, `sse_drain` = engine finish->last byte
+written, `disconnect` instants) on an "http" trace track joined to the
+engine's lifecycle spans by the request id. The boundaries are
+CONTIGUOUS stamps on the engine's own clock, so accept + parse +
+queue_handoff + queue + prefill + decode + sse_drain partitions the
+server-observed wall exactly — `GET /v1/requests/<id>` assembles that
+end-to-end timeline (plus the request's speculative-acceptance,
+kv-quant and page-usage facts) from a bounded in-memory registry, with
+or without the recorder.
+
 Shutdown ordering (`ApiServer.close`, idempotent): stop accepting new
 work (503), drain active streams up to `drain_timeout_s` then cancel
 the stragglers, stop the engine loop, `engine.close()`, then tear down
@@ -42,20 +57,29 @@ from __future__ import annotations
 
 import json
 import queue
+import re
 import select
 import socket
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
+from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve import openai as oai
 from solvingpapers_tpu.serve.grammar import JsonStepper
 from solvingpapers_tpu.serve.openai import ApiError
 from solvingpapers_tpu.serve.scheduler import ACTIVE
+
+# client-supplied X-Request-Id values we honor: short, printable, safe
+# to echo into headers/JSON/trace args verbatim. Anything else gets a
+# minted id (the request still traces — a hostile header must not be
+# able to opt out of observability or smuggle bytes into the trace).
+_RID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
 
 
 class EngineLoop:
@@ -232,6 +256,12 @@ class ApiServer:
     owns one.
     """
 
+    # request timelines kept for GET /v1/requests/<id>: a debug surface,
+    # so bounded and evict-oldest (a long-lived server must not grow a
+    # dict per request served). A client re-using an id overwrites the
+    # older entry — last-wins, like the header contract implies.
+    timeline_cap = 1024
+
     def __init__(self, engine, *, encode=None, decode=None,
                  token_table=None, model_name: str = "solvingpapers",
                  loop=None):
@@ -249,6 +279,8 @@ class ApiServer:
             "rejected": 0, "client_errors": 0,
         }
         self._count_lock = threading.Lock()
+        self._timelines: OrderedDict[str, dict] = OrderedDict()
+        self._timeline_lock = threading.Lock()
         vocab = getattr(getattr(engine.model, "cfg", None), "vocab_size",
                         None) or (1 << 31)
         self.vocab_size = vocab
@@ -347,9 +379,10 @@ class ApiServer:
         self._send(h, code, json.dumps(obj) + "\n", "application/json",
                    headers)
 
-    def _send_error(self, h, err: ApiError) -> None:
+    def _send_error(self, h, err: ApiError,
+                    headers: dict | None = None) -> None:
         self._bump("rejected" if err.status == 503 else "client_errors")
-        headers = {}
+        headers = dict(headers or {})
         if err.status == 503:
             headers["Retry-After"] = "1"
         try:
@@ -366,8 +399,10 @@ class ApiServer:
                 self._send(h, 200, "ok\n", "text/plain")
             elif path == "/metrics":
                 with self.loop.lock:
+                    # prom_snapshot: latency histograms render as native
+                    # _bucket/_sum/_count series on this pull path
                     step, snap = (self.engine._step_idx,
-                                  self.engine.metrics.snapshot())
+                                  self.engine.metrics.prom_snapshot())
                 self._send(h, 200, PrometheusTextWriter.render(step, snap),
                            "text/plain; version=0.0.4")
             elif path == "/statusz":
@@ -380,6 +415,8 @@ class ApiServer:
                     "data": [{"id": self.model_name, "object": "model",
                               "owned_by": "local"}],
                 })
+            elif path.startswith("/v1/requests/"):
+                self._request_status(h, path[len("/v1/requests/"):])
             else:
                 self._send(h, 404, "not found\n", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
@@ -391,18 +428,117 @@ class ApiServer:
             except (BrokenPipeError, ConnectionResetError):
                 pass
 
+    def _request_status(self, h, rid: str) -> None:
+        """GET /v1/requests/<id>: the request's end-to-end timeline —
+        HTTP phases + engine lifecycle phases (they partition the
+        server-observed wall exactly: contiguous stamps on one clock)
+        plus its speculative/kv-quant/page facts and SLO verdict."""
+        with self._timeline_lock:
+            rec = self._timelines.get(rid)
+        if rec is None:
+            self._send_json(h, 404, {"error": {
+                "message": f"no timeline for request id {rid!r} (unknown, "
+                           f"or evicted past the last "
+                           f"{self.timeline_cap} requests)",
+                "type": "invalid_request_error", "param": None,
+                "code": "request_not_found",
+            }})
+            return
+        self._send_json(h, 200, self._assemble_timeline(rec),
+                        {"X-Request-Id": rid})
+
+    def _assemble_timeline(self, rec: dict) -> dict:
+        """One JSON timeline from the HTTP record + the engine Request's
+        own lifecycle timestamps. Phases are adjacent intervals —
+        accept -> parse -> queue_handoff -> queue -> prefill -> decode ->
+        sse_drain — so their sum equals t_done - t_accept (the server-
+        observed e2e wall) to the clock's resolution; in-flight requests
+        report the phases they have reached so far."""
+        req = rec["req"]
+        cfg = self.engine.config
+        phases: dict[str, float] = {
+            "accept": rec["t_body"] - rec["t_accept"],
+            "parse": rec["t_parsed"] - rec["t_body"],
+            "queue_handoff": max(req.submit_time - rec["t_parsed"], 0.0),
+        }
+        if req.admit_time is not None:
+            phases["queue"] = req.admit_time - req.submit_time
+            if req.first_token_time is not None:
+                phases["prefill"] = req.first_token_time - req.admit_time
+                if req.finish_time is not None:
+                    phases["decode"] = (req.finish_time
+                                        - req.first_token_time)
+        elif req.finish_time is not None:
+            # never admitted (cancel/timeout in the queue, or rejected):
+            # its whole engine life was queue time
+            phases["queue"] = req.finish_time - req.submit_time
+        if rec["t_done"] is not None and req.finish_time is not None:
+            phases["sse_drain"] = max(rec["t_done"] - req.finish_time, 0.0)
+        phases = {k: round(v, 6) for k, v in phases.items()}
+        facts: dict = {
+            "prompt_tokens": int(req.prompt.size),
+            "completion_tokens": len(req.tokens),
+            "kv_quant": cfg.kv_quant,
+            "kv_exact": bool(req.params.kv_exact),
+        }
+        if cfg.speculative is not None:
+            facts["spec"] = {
+                "drafter": cfg.speculative,
+                "proposed": req.spec_proposed,
+                "accepted": req.spec_accepted,
+                "acceptance_rate": round(
+                    req.spec_accepted / req.spec_proposed, 4
+                ) if req.spec_proposed else None,
+            }
+        if cfg.paged:
+            facts["pages_held"] = req.pages_held
+            facts["page_size"] = self.engine.pool.page_size
+        doc = {
+            "request_id": rec["trace_id"],
+            "engine_req": req.id,
+            "kind": "chat" if rec["chat"] else "completion",
+            "stream": rec["stream"],
+            "state": req.state,
+            "finish_reason": req.finish_reason,
+            "disconnected": rec["disconnected"],
+            "phases": phases,
+            "phase_sum_s": round(sum(phases.values()), 6),
+            "e2e_s": round(rec["t_done"] - rec["t_accept"], 6)
+            if rec["t_done"] is not None else None,
+            "facts": facts,
+        }
+        if req.slo_result is not None:
+            doc["slo"] = req.slo_result
+        elif cfg.slo_targets is not None:
+            # in flight (or excluded finish): class known, verdict not
+            doc["slo"] = {"class": self.engine._slo.classify(req),
+                          "attained": None}
+        return doc
+
     def _post(self, h) -> None:
+        # accept boundary: first stamp after the server parsed the
+        # request line + headers — everything from here to the last
+        # response byte is carved into contiguous spans on this clock
+        t_accept = smetrics.now()
         path = h.path.split("?", 1)[0]
         chat = path == "/v1/chat/completions"
         if not chat and path != "/v1/completions":
             self._send(h, 404, "not found\n", "text/plain")
             return
         self._bump("requests")
+        # honor the client's X-Request-Id (sane values only), else mint:
+        # the id rides the engine Request, the trace, the response
+        # header, and GET /v1/requests/<id> — one identity end to end
+        rid_in = (h.headers.get("X-Request-Id") or "").strip()
+        trace_id = rid_in if _RID_RE.match(rid_in) else uuid.uuid4().hex
+        rid_headers = {"X-Request-Id": trace_id}
         try:
             body = self._read_body(h)
-            self._serve_completion(h, body, chat=chat)
+            t_body = smetrics.now()
+            self._serve_completion(h, body, chat=chat, trace_id=trace_id,
+                                   t_accept=t_accept, t_body=t_body)
         except ApiError as e:
-            self._send_error(h, e)
+            self._send_error(h, e, headers=rid_headers)
         except (BrokenPipeError, ConnectionResetError):
             self._bump("disconnects")
         except Exception as e:  # noqa: BLE001
@@ -410,7 +546,7 @@ class ApiServer:
                 self._send_json(h, 500, {"error": {
                     "message": f"{type(e).__name__}: {e}",
                     "type": "internal_error", "param": None, "code": None,
-                }})
+                }}, rid_headers)
             except (BrokenPipeError, ConnectionResetError):
                 pass
 
@@ -434,7 +570,8 @@ class ApiServer:
 
     # -------------------------------------------------------- completion
 
-    def _serve_completion(self, h, body: dict, chat: bool) -> None:
+    def _serve_completion(self, h, body: dict, chat: bool, trace_id: str,
+                          t_accept: float, t_body: float) -> None:
         cfg = self.engine.config
         if self.closing.is_set():
             raise ApiError("server is shutting down", status=503,
@@ -445,7 +582,10 @@ class ApiServer:
                 f"({type(self.loop.error).__name__})", status=503,
                 err_type="server_error", code="engine_failed",
             )
-        params, max_tokens, timeout_s = oai.parse_sampling(body)
+        params, max_tokens, timeout_s = oai.parse_sampling(
+            body,
+            slo_classes=set(cfg.slo_targets) if cfg.slo_targets else None,
+        )
         stream = bool(body.get("stream", False))
         json_mode = oai.wants_json(body, cfg.json_mode)
         if json_mode and self._grammar_err:
@@ -472,6 +612,11 @@ class ApiServer:
         grammar = (JsonStepper(self.token_table, cache=self._grammar_cache)
                    if json_mode else None)
         bridge = _Stream(cfg.stream_queue)
+        # parse boundary: body decoded, sampling/prompt validated, the
+        # grammar built — the next stamp the request gets is its own
+        # submit_time inside the locked engine call, so the gap between
+        # here and there IS the submit-lock handoff
+        t_parsed = smetrics.now()
         try:
             req = self.loop.submit(
                 np.asarray(prompt_ids, np.int32),
@@ -482,19 +627,45 @@ class ApiServer:
             code = ("context_length_exceeded"
                     if "exceeds the engine capacity" in str(e) else None)
             raise ApiError(str(e), code=code) from None
+        req.trace_id = trace_id
+        rec = {
+            "trace_id": trace_id, "req": req, "chat": chat,
+            "stream": stream, "t_accept": t_accept, "t_body": t_body,
+            "t_parsed": t_parsed, "t_done": None, "disconnected": False,
+        }
+        with self._timeline_lock:
+            self._timelines[trace_id] = rec
+            self._timelines.move_to_end(trace_id)
+            while len(self._timelines) > self.timeline_cap:
+                self._timelines.popitem(last=False)
+        tr = self.engine.trace
+        if tr is not None:
+            # HTTP-layer spans on the shared recorder, joined to the
+            # engine's lifecycle spans by req id: contiguous boundaries
+            # (t_accept -> t_body -> t_parsed -> submit_time) extend the
+            # queue+prefill+decode partition across the HTTP boundary
+            tr.complete("accept", "http", "http", ts=t_accept,
+                        dur=t_body - t_accept, req=req.id,
+                        trace_id=trace_id)
+            tr.complete("parse", "http", "http", ts=t_body,
+                        dur=t_parsed - t_body, req=req.id)
+            tr.complete("queue_handoff", "http", "http", ts=t_parsed,
+                        dur=max(req.submit_time - t_parsed, 0.0),
+                        req=req.id)
         if req.state == "rejected":
             self._bump("rejected")
+            rec["t_done"] = smetrics.now()
             self._send_json(h, 503, ApiError(
                 "waiting queue is full — retry shortly", status=503,
                 err_type="server_error", code="overloaded",
-            ).body(), {"Retry-After": "1"})
+            ).body(), {"Retry-After": "1", "X-Request-Id": trace_id})
             return
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
             self._bump("streams")
-            self._stream_response(h, req, bridge, rid, chat)
+            self._stream_response(h, req, bridge, rid, chat, rec)
         else:
-            self._blocking_response(h, req, bridge, rid, chat)
+            self._blocking_response(h, req, bridge, rid, chat, rec)
 
     def _delta(self, tokens, upto: int, rendered: str) -> tuple[str, str]:
         """Text delta for tokens[:upto] given what was already rendered.
@@ -519,11 +690,32 @@ class ApiServer:
             return True
         return False
 
+    def _mark_disconnect(self, req, rec) -> None:
+        rec["disconnected"] = True
+        rec["t_done"] = smetrics.now()
+        self._bump("disconnects")
+        tr = self.engine.trace
+        if tr is not None:
+            tr.instant("disconnect", "http", "http", req=req.id)
+
+    def _mark_done(self, req, rec, events: int = 0) -> None:
+        """Stamp the drain boundary: engine finish -> last response byte
+        flushed (the tail the client observes after the engine is done —
+        event rendering, detokenize, socket writes)."""
+        t_done = smetrics.now()
+        rec["t_done"] = t_done
+        tr = self.engine.trace
+        if tr is not None and req.finish_time is not None:
+            tr.complete("sse_drain", "http", "http", ts=req.finish_time,
+                        dur=max(t_done - req.finish_time, 0.0),
+                        req=req.id, events=events)
+
     def _stream_response(self, h, req, bridge, rid: str,
-                         chat: bool) -> None:
+                         chat: bool, rec: dict) -> None:
         h.send_response(200)
         h.send_header("Content-Type", "text/event-stream")
         h.send_header("Cache-Control", "no-cache")
+        h.send_header("X-Request-Id", rec["trace_id"])
         h.end_headers()
 
         def event(obj) -> None:
@@ -532,6 +724,7 @@ class ApiServer:
 
         self._bump_active(1)
         emitted = 0
+        events = 0
         rendered = ""
         try:
             if chat:
@@ -544,7 +737,7 @@ class ApiServer:
                         finished = True  # cb raced the queue; finish now
                     elif self._disconnected(h):
                         self.loop.cancel(req)
-                        self._bump("disconnects")
+                        self._mark_disconnect(req, rec)
                         return
                     else:
                         # SSE comment heartbeat: keeps proxies from
@@ -561,7 +754,7 @@ class ApiServer:
                 if self._disconnected(h):
                     if not req.done:
                         self.loop.cancel(req)
-                    self._bump("disconnects")
+                    self._mark_disconnect(req, rec)
                     return
                 upto = len(req.tokens)
                 if upto > emitted:
@@ -572,6 +765,7 @@ class ApiServer:
                         event(oai.completion_chunk(rid, self.model_name,
                                                    delta))
                     emitted = upto
+                    events += 1
                 if finished:
                     usage = oai.usage_block(req)
                     if chat:
@@ -585,18 +779,19 @@ class ApiServer:
                                                    usage=usage))
                     h.wfile.write(b"data: [DONE]\n\n")
                     h.wfile.flush()
+                    self._mark_done(req, rec, events=events + 1)
                     return
         except (BrokenPipeError, ConnectionResetError, OSError):
             # client went away mid-stream: free the slot at the next
             # block boundary and count the disconnect
             if not req.done:
                 self.loop.cancel(req)
-            self._bump("disconnects")
+            self._mark_disconnect(req, rec)
         finally:
             self._bump_active(-1)
 
     def _blocking_response(self, h, req, bridge, rid: str,
-                           chat: bool) -> None:
+                           chat: bool, rec: dict) -> None:
         self._bump_active(1)
         try:
             while not req.done:
@@ -607,18 +802,20 @@ class ApiServer:
                 except queue.Empty:
                     if self._disconnected(h):
                         self.loop.cancel(req)
-                        self._bump("disconnects")
+                        self._mark_disconnect(req, rec)
                         return
             if self.decode is not None:
                 text = self.decode(list(req.tokens))
             else:
                 text = "".join(str(t) + " " for t in req.tokens)
+            headers = {"X-Request-Id": rec["trace_id"]}
             if chat:
                 self._send_json(h, 200, oai.chat_response(
-                    rid, self.model_name, req, text))
+                    rid, self.model_name, req, text), headers)
             else:
                 self._send_json(h, 200, oai.completion_response(
-                    rid, self.model_name, req, text))
+                    rid, self.model_name, req, text), headers)
+            self._mark_done(req, rec, events=1)
         finally:
             self._bump_active(-1)
 

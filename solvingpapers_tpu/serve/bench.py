@@ -77,6 +77,43 @@ from solvingpapers_tpu.serve.sampling import SamplingParams
 
 _DECODER_FAMILIES = ("gpt", "llama3", "gemma", "deepseekv3")
 
+# BENCH_serve.json entry schema:
+#   0 (implicit) — PR 1-10 entries: {metric, value, unit, vs_baseline,
+#     detail} with no identity stamp
+#   1 — schema 0 plus a BACKFILLED provenance block (git sha + commit
+#     timestamp recovered from history; jax/host unknown, marked
+#     "backfilled": true)
+#   2 — provenance recorded at measurement time: git sha, timestamp
+#     (INJECTED by the entry writer — cli cmd_serve_bench stamps one
+#     clock reading per run; nothing in here reads the clock ambiently,
+#     so tests pin entries byte-stable), jax/jaxlib versions, host
+#     platform + device kind. tools/bench_check.py keys its trajectory
+#     on these.
+BENCH_SCHEMA_VERSION = 2
+
+
+def bench_provenance(timestamp: float, git_sha: str | None = None) -> dict:
+    """The identity stamp every BENCH_serve.json entry carries (schema
+    v2): WHO measured this (git sha, jax/jaxlib, host device) and WHEN.
+    `timestamp` is required — injected by the caller, one clock reading
+    per bench run — so entries are reproducible under test and two
+    workloads written by one run share one timestamp."""
+    from solvingpapers_tpu.buildinfo import build_info
+
+    info = build_info()
+    return {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "provenance": {
+            "git_sha": git_sha if git_sha is not None else info["git_sha"],
+            "timestamp": round(float(timestamp), 3),
+            "jax": info["jax"],
+            "jaxlib": info["jaxlib"],
+            "python": info["python"],
+            "platform": info["platform"],
+            "device_kind": info["device_kind"],
+        },
+    }
+
 
 def build_serve_model(config_name: str):
     """(model, params, extra_variables, vocab_size) for a registered
@@ -1730,6 +1767,158 @@ def run_http_bench(
             if gaps else None,
             "stream_token_exact": bool(exact),
             **_kv_entry_fields(direct_eng),
+            **probe_fields,
+        },
+    }
+
+
+SLO_CLASS_CYCLE = ("interactive", "standard", "batch")
+
+
+def run_slo_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    seed: int = 0,
+    reps: int = 4,
+    slo_targets: dict | None = None,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """`cli serve-bench --slo`: the SLO-observatory workload.
+
+    The like-for-like Poisson trace runs ABBA-paired through (A) an
+    engine with the full request observatory on — `ServeConfig.
+    slo_targets` set, every request tagged with an SLO class cycling
+    interactive/standard/batch, per-class attainment + burn + goodput
+    accounted on each finish — and (B) the plain engine. Both arms
+    decode greedily and compile the same programs (SLO accounting is
+    pure host-side finish-path bookkeeping), so `slo_overhead_pct` is
+    the cost of the whole observatory layer: the histogram latency
+    backend plus per-finish SLO accounting. The acceptance budget is
+    the PR-4/5 instrumentation budget: <= 2% on this paired arm.
+
+    The entry records per-class attainment/burn and
+    `goodput_tokens_per_s` (tokens from SLO-attained requests only) —
+    the serving quality trajectory `tools/bench_check.py` gates, and
+    the number the DistServe-style disaggregation phase (ROADMAP item
+    2) will optimize.
+    """
+    from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
+
+    targets = slo_targets or DEFAULT_SLO_TARGETS
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    base_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_prompt + max_new,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        seed=seed,
+    )
+    slo_cfg = dataclasses.replace(base_cfg, slo_targets=targets)
+
+    def params_for(i: int) -> SamplingParams:
+        # greedy everywhere — ONLY the class tag differs, so both arms
+        # run identical compiled programs and identical tokens
+        return SamplingParams(slo=SLO_CLASS_CYCLE[i % len(SLO_CLASS_CYCLE)])
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, slo_cfg, max_new,
+        status_port=status_port, params_for=params_for,
+    )
+    _run_engine_arm(model, params, extra, warm, base_cfg, max_new)
+
+    # ABBA pairing with PER-ARM request params (the shared
+    # _paired_makespans helper applies one params_for to both arms;
+    # here the off arm must stay untagged or its submits would reject)
+    mk = {"slo": [], "plain": []}
+    slo_eng = None
+    for r in range(reps):
+        order = ("slo", "plain") if r % 2 == 0 else ("plain", "slo")
+        for arm in order:
+            if arm == "slo":
+                slo_eng, _, span = _run_engine_arm(
+                    model, params, extra, requests, slo_cfg, max_new,
+                    params_for=params_for,
+                )
+            else:
+                _, _, span = _run_engine_arm(
+                    model, params, extra, requests, base_cfg, max_new,
+                )
+            mk[arm].append(span)
+    slo_rps = n_requests / (sum(mk["slo"]) / len(mk["slo"]))
+    plain_rps = n_requests / (sum(mk["plain"]) / len(mk["plain"]))
+
+    snap = slo_eng.metrics.snapshot()
+    slo_doc = slo_eng.statusz()["slo"]
+    per_class = {
+        cls: {
+            "finished": d["finished"],
+            "attainment": d["attainment"],
+            "burn_rate": d["burn_rate"],
+            "violations": d["violations"],
+        }
+        for cls, d in slo_doc["classes"].items()
+    }
+    tokens_per_s = snap.get("serve/tokens_per_sec", 0.0)
+    goodput_per_s = snap.get("serve/goodput_tokens_per_s", 0.0)
+    if status_hold_s > 0 and probe_eng is not None:
+        time.sleep(status_hold_s)
+    if probe_eng is not None:
+        probe_eng.close()
+    return {
+        "metric": "serve_slo_goodput_tokens_per_s",
+        "value": round(goodput_per_s, 2),
+        "unit": "tok/s from SLO-attained requests (last slo-on rep)",
+        # goodput / raw throughput: 1.0 = every token was delivered
+        # inside its class's latency targets
+        "vs_baseline": round(goodput_per_s / tokens_per_s, 3)
+        if tokens_per_s else 0.0,
+        "detail": {
+            "config": config,
+            "workload": "slo-observatory",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "reps": reps,
+            "class_cycle": list(SLO_CLASS_CYCLE),
+            "slo_targets": {
+                cls: {k: v for k, v in spec.items()}
+                for cls, spec in targets.items()
+            },
+            "slo_overhead_pct": round(
+                (1.0 - slo_rps / plain_rps) * 100.0, 2
+            ),
+            "slo_requests_per_sec": round(slo_rps, 2),
+            "plain_requests_per_sec": round(plain_rps, 2),
+            "goodput_tokens_per_s": round(goodput_per_s, 2),
+            "tokens_per_sec": round(tokens_per_s, 2),
+            "goodput_ratio": round(goodput_per_s / tokens_per_s, 4)
+            if tokens_per_s else 0.0,
+            "attainment_by_class": per_class,
+            "goodput_tokens": int(slo_doc["goodput_tokens"]),
+            **_round_if_present(snap, "serve/ttft_s_p95", "ttft_p95_s", 4),
+            **_round_if_present(snap, "serve/itl_s_p95", "itl_p95_s", 5),
+            **_round_if_present(snap, "serve/e2e_s_p95", "e2e_p95_s", 4),
+            **_kv_entry_fields(slo_eng),
             **probe_fields,
         },
     }

@@ -115,6 +115,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# imported for the side effect too: buildinfo stamps its process-start
+# clock at FIRST import, and /statusz's uptime_s should measure from
+# engine-module load (≈ serving-process start), not from whenever the
+# first status probe happened to lazily import it
+from solvingpapers_tpu import buildinfo
 from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve.grammar import encode_allow
 from solvingpapers_tpu.serve.kv_pool import (
@@ -341,6 +346,20 @@ class ServeConfig:
     # XLA:CPU). Requests' top_k must fit under it (submit validates);
     # raise it (up to the vocab size) for exact full-support sampling.
     sample_cap: int = 64
+    # SLO accounting (serve/slo.py, opt-in): per-class latency targets,
+    # {class: {"ttft_s"/"itl_s"/"e2e_s": seconds, "objective": frac}} —
+    # pass `serve.slo.DEFAULT_SLO_TARGETS` for the reference
+    # interactive/standard/batch tier set. When set, every finish is
+    # accounted under its request's `SamplingParams.slo` class (default
+    # "standard", which the dict must define): per-class attainment,
+    # error-budget burn rate, and goodput (tokens from SLO-attained
+    # requests only) ride the snapshot as slo/* +
+    # serve/goodput_tokens[_per_s] gauges and the /statusz `slo`
+    # section. None = off: no gauges, and slo-tagged submissions are
+    # rejected (the tag would silently account to nothing).
+    slo_targets: dict | None = None
+    # finishes in the sliding window the burn rate is computed over
+    slo_burn_window: int = 256
     prefill_chunk: int | None = None
     max_waiting: int = 256
     decode_priority: bool = True
@@ -1398,6 +1417,19 @@ class ServeEngine:
                 probe_every=cfg.spec_probe_every,
             )
             self.metrics.add_gauge_provider(self._spec_gauges)
+        # SLO accounting (serve/slo.py; see the ServeConfig knob block):
+        # host-side per-class attainment/burn/goodput on the finish path,
+        # riding the snapshot via the gauge-provider mechanism — present
+        # iff slo_targets is configured, None = one branch per finish
+        self._slo = None
+        if cfg.slo_targets is not None:
+            from solvingpapers_tpu.serve.slo import SloTracker
+
+            self._slo = SloTracker(cfg.slo_targets,
+                                   burn_window=cfg.slo_burn_window)
+            self.metrics.add_gauge_provider(
+                lambda: self._slo.gauges(self.metrics.elapsed_s)
+            )
         # delivered-token tick weight for the scheduler's anti-starvation
         # clock: a speculative step can deliver many tokens per slot, so
         # ticking 1 per iteration would make a waiting request's budget
@@ -1511,7 +1543,9 @@ class ServeEngine:
 
             self.status = StatusServer(
                 self.statusz,
-                lambda: (self._step_idx, self.metrics.snapshot()),
+                # prom_snapshot: the pull path renders the latency
+                # histograms as native _bucket/_sum/_count series
+                lambda: (self._step_idx, self.metrics.prom_snapshot()),
                 host=cfg.status_host, port=cfg.status_port,
             )
 
@@ -1597,6 +1631,19 @@ class ServeEngine:
                 "ServeConfig.kv_exact_lanes >= 1 (on an unquantized "
                 "engine kv_exact is a no-op and always accepted)"
             )
+        if params.slo is not None:
+            if self._slo is None:
+                raise ValueError(
+                    "params.slo tags the request's SLO class, which needs "
+                    "ServeConfig.slo_targets configured — without the "
+                    "tracker the tag would silently account to nothing"
+                )
+            if params.slo not in self._slo.targets:
+                raise ValueError(
+                    f"unknown SLO class {params.slo!r}: "
+                    f"ServeConfig.slo_targets defines "
+                    f"{sorted(self._slo.targets)}"
+                )
         total = prompt.size + max_new_tokens
         limit = getattr(self.model, "max_positions", None)
         cap = min(self.config.max_len, limit or self.config.max_len)
@@ -1779,6 +1826,11 @@ class ServeEngine:
         host-side mirrors only (safe to call from the status server's
         request threads while the engine steps)."""
         d = {
+            # build identity FIRST: a scraped replica must be
+            # identifiable (which build, which jax, how long up) before
+            # any of its numbers are aggregated — ROADMAP item 2's
+            # per-replica prerequisite
+            "build": buildinfo.build_info(),
             "engine": {
                 "n_slots": self.config.n_slots,
                 "n_free": self.pool.n_free,
@@ -1844,6 +1896,8 @@ class ServeEngine:
                 ) if m.spec_steps else 0.0,
                 **self._spec_ctl.stats(),
             }
+        if self._slo is not None:
+            d["slo"] = self._slo.statusz()
         if self.prefix_cache is not None:
             d["prefix_cache"] = self.prefix_cache.stats()
         if self.registry is not None:
@@ -2067,6 +2121,7 @@ class ServeEngine:
         in-flight program output is lost."""
         slot = req.slot
         self.metrics.record_preemption()
+        req.pages_held = max(req.pages_held, int(self.pool.n_alloc[slot]))
         if self.trace is not None:
             self.trace.instant("preempt", "engine", f"slot{slot}",
                                req=req.id, tokens=len(req.tokens))
@@ -2614,6 +2669,10 @@ class ServeEngine:
                     tot_prop += int(proposed[r, slot])
                     tot_acc += max(n - 1, 0)
                     tot_rounds += 1
+                    # request-scoped acceptance fact (debug timeline):
+                    # engine-wide rates hide a single adversarial stream
+                    req.spec_proposed += int(proposed[r, slot])
+                    req.spec_accepted += max(n - 1, 0)
                 # a grammar slot accepts only round 0's first commit —
                 # later rounds drew through a stale mask (overshoot,
                 # discarded exactly like the plain block's tail)
@@ -2826,7 +2885,15 @@ class ServeEngine:
         req.state = FINISHED
         req.finish_reason = reason
         req.finish_time = now
+        if self._paged and req.slot is not None:
+            # page-usage fact for the request's debug timeline, stamped
+            # before release frees the table (streams only grow, so the
+            # finish-boundary count IS the peak)
+            req.pages_held = max(req.pages_held,
+                                 int(self.pool.n_alloc[req.slot]))
         self.metrics.record_finish(req, now)
+        if self._slo is not None:
+            req.slo_result = self._slo.observe(req, now)
         if self.trace is not None:
             # lifecycle decode phase: first token -> finish (0 for
             # prefill-only finishes) — with queue + prefill above, the
@@ -2870,6 +2937,8 @@ class ServeEngine:
         req.finish_reason = reason
         req.finish_time = now
         self.metrics.record_finish(req, now)
+        if self._slo is not None:
+            req.slo_result = self._slo.observe(req, now)
         if self.trace is not None:
             if req.first_token_time is None:
                 # its whole life was queue time; no prefill/decode phases

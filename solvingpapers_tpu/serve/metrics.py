@@ -1,12 +1,21 @@
 """Serving observability: TTFT, inter-token latency, throughput, occupancy.
 
-Latency observations flow into bounded rings (`metrics.writer.Ring`) and
-summaries flow out through the existing `MetricsWriter` sink interface —
-the same channel train-loop metrics ride, so a serve process logs to
-console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
+Latency observations flow into mergeable log-bucketed histograms
+(`metrics.hist.LogHistogram` — fixed bucket layout, O(1) record, exact
+cross-replica merge; they replaced the bounded `Ring`, whose window was
+a biased estimator under load and could not be aggregated) and summaries
+flow out through the existing `MetricsWriter` sink interface — the same
+channel train-loop metrics ride, so a serve process logs to
+console/JSONL/TensorBoard/wandb with zero new plumbing. Flat sinks get
+the scalar summary keys below (mean exact, percentiles bucket-resolution
+estimates); histogram-capable sinks (`PrometheusTextWriter`, and the
+live `/metrics` pull paths riding its `render`) additionally get the
+histograms themselves via `prom_snapshot()`, exposed as native
+Prometheus ``_bucket{le=...}/_sum/_count`` series. Metric names:
 
     serve/ttft_s_*           submit -> first token (includes queue wait)
     serve/itl_s_*            gap between consecutive token emissions
+    serve/e2e_s_*            submit -> finish (whole-request wall)
     serve/queue_wait_s_*     submit -> slot admission
     serve/tokens_per_sec     generated tokens / elapsed wall time
     serve/requests_per_sec   finished requests / elapsed wall time
@@ -16,6 +25,23 @@ console/JSONL/TensorBoard/wandb with zero new plumbing. Metric names:
     serve/finish_<reason>    finished requests by lifecycle outcome
                              (eos / length / stop / cancelled / timeout —
                              see serve/scheduler.py Request.finish_reason)
+
+SLO gauges (serve/slo.py; present iff `ServeConfig.slo_targets` is set —
+the engine registers a gauge provider, the same mechanism as every
+conditional family below):
+
+    slo/<class>_finished       finished requests in the class (cancelled/
+                               error finishes excluded — client's fault,
+                               not a latency outcome)
+    slo/<class>_attainment     requests that met EVERY configured target
+                               (TTFT / ITL / e2e) / finished
+    slo/<class>_burn_rate      violation rate over the recent window /
+                               the class's error budget (1 - objective);
+                               > 1 means the budget is burning
+    serve/goodput_tokens       tokens delivered by SLO-ATTAINED requests
+    serve/goodput_tokens_per_s ... per elapsed second — the DistServe-
+                               style goodput an iteration-level scheduler
+                               can silently trade away under load
 
 Paged-pool gauges (present iff `ServeConfig.paged`; the engine registers
 a gauge provider, same mechanism as the observatory below):
@@ -99,16 +125,29 @@ from __future__ import annotations
 
 import time
 
+from solvingpapers_tpu.metrics.hist import LogHistogram
 from solvingpapers_tpu.metrics.writer import MetricsWriter, Ring
+
+# one latency bucket layout for every serve histogram: merge across
+# engines/replicas only works on identical layouts, and Prometheus
+# cross-replica aggregation needs aligned `le` label sets
+_LATENCY_LAYOUT = dict(lo=1e-4, hi=1e4, buckets_per_decade=16)
+
+
+def latency_histogram() -> LogHistogram:
+    """A serve-layout latency histogram (the shared layout every
+    ServeMetrics instance and replica aggregator must use)."""
+    return LogHistogram(**_LATENCY_LAYOUT)
 
 
 class ServeMetrics:
     """Engine-side collector; one instance per `ServeEngine`."""
 
     def __init__(self, window: int = 4096):
-        self.ttft = Ring(window)
-        self.itl = Ring(window)
-        self.queue_wait = Ring(window)
+        self.ttft = latency_histogram()
+        self.itl = latency_histogram()
+        self.queue_wait = latency_histogram()
+        self.e2e = latency_histogram()
         self.occupancy = Ring(window)
         self.tokens_out = 0
         self.prefill_tokens = 0
@@ -178,13 +217,12 @@ class ServeMetrics:
         self._touch(now)
         self.tokens_out += n
         if n > 0:
-            per_tok = span_s / n
-            for _ in range(n):
-                self.itl.add(per_tok)
+            self.itl.add(span_s / n, n)
 
     def record_finish(self, req, now: float) -> None:
         self._touch(now)
         self.requests_finished += 1
+        self.e2e.add(max(now - req.submit_time, 0.0))
         reason = req.finish_reason or "unknown"
         self.finish_reasons[reason] = self.finish_reasons.get(reason, 0) + 1
 
@@ -260,21 +298,41 @@ class ServeMetrics:
             out["serve/requests_per_sec"] = self.requests_finished / elapsed
         if len(self.occupancy):
             out["serve/slot_occupancy"] = self.occupancy.mean()
-        for name, ring in (
-            ("ttft_s", self.ttft),
-            ("itl_s", self.itl),
-            ("queue_wait_s", self.queue_wait),
-        ):
-            if len(ring):
-                out[f"serve/{name}_mean"] = ring.mean()
-                for k, v in ring.percentiles().items():
+        for name, hist in self._latency_hists():
+            if len(hist):
+                out[f"serve/{name}_mean"] = hist.mean()
+                for k, v in hist.percentiles().items():
                     out[f"serve/{name}_{k}"] = v
         for provider in self._gauge_providers:
             out.update(provider())
         return out
 
+    def _latency_hists(self):
+        return (
+            ("ttft_s", self.ttft),
+            ("itl_s", self.itl),
+            ("e2e_s", self.e2e),
+            ("queue_wait_s", self.queue_wait),
+        )
+
+    def prom_snapshot(self) -> dict:
+        """`snapshot()` plus the latency histograms THEMSELVES (under
+        their base names, e.g. ``serve/ttft_s``) — the metric set for
+        histogram-capable sinks: `PrometheusTextWriter` and the live
+        `/metrics` pull paths render them as native `_bucket/_sum/_count`
+        series, which is what makes per-replica latency aggregation
+        (`sum by (le)`) possible. Flat sinks keep getting `snapshot()`."""
+        out = self.snapshot()
+        for name, hist in self._latency_hists():
+            if len(hist):
+                out[f"serve/{name}"] = hist
+        return out
+
     def emit(self, writer: MetricsWriter, step: int | None = None) -> None:
-        writer.write(self.steps if step is None else step, self.snapshot())
+        snap = (self.prom_snapshot()
+                if getattr(writer, "accepts_histograms", False)
+                else self.snapshot())
+        writer.write(self.steps if step is None else step, snap)
 
 
 def now() -> float:

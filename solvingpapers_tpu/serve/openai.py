@@ -83,12 +83,14 @@ def _field(body: dict, name: str, types, default, param=None):
     return val
 
 
-def parse_sampling(body: dict) -> tuple[SamplingParams, int, float | None]:
+def parse_sampling(body: dict, slo_classes=None
+                   ) -> tuple[SamplingParams, int, float | None]:
     """The sampling-relevant fields of a completion/chat body ->
     (SamplingParams, max_tokens, timeout_s). OpenAI defaults:
     temperature 1.0 (pass 0 for greedy), top_p 1.0, max_tokens 16.
-    `top_k` / `min_p` / `timeout_s` are accepted extensions (vLLM
-    serves the same ones)."""
+    `top_k` / `min_p` / `timeout_s` / `slo` are accepted extensions
+    (vLLM serves the first three). `slo_classes` is the server's
+    configured SLO class set (None = SLO accounting off)."""
     if _field(body, "n", int, 1) != 1:
         raise ApiError("only n=1 is supported", param="n")
     if _field(body, "best_of", int, 1) != 1:
@@ -124,6 +126,22 @@ def parse_sampling(body: dict) -> tuple[SamplingParams, int, float | None]:
     seed = body.get("seed")
     if seed is not None and not isinstance(seed, int):
         raise ApiError("seed must be an integer", param="seed")
+    # SLO class tag: the explicit "slo" extension is validated strictly
+    # (a typo'd class must 400, at submit, not silently untrack), while
+    # OpenAI's "service_tier" is honored as a BEST-EFFORT alias: it maps
+    # only when it names one of the server's configured classes —
+    # stock OpenAI values this server has no class for ("flex",
+    # "priority", "scale", and "auto"/"default" meaning the default)
+    # are ignored, never promoted into a 400 on an otherwise-valid
+    # OpenAI request.
+    slo = body.get("slo", None)
+    if slo is None:
+        tier = body.get("service_tier")
+        if slo_classes and isinstance(tier, str) and tier in slo_classes:
+            slo = tier
+    if slo is not None and not (isinstance(slo, str) and slo):
+        raise ApiError("slo must be a non-empty class name string",
+                       param="slo")
     try:
         params = SamplingParams(
             temperature=float(_field(body, "temperature", (int, float), 1.0)),
@@ -134,6 +152,7 @@ def parse_sampling(body: dict) -> tuple[SamplingParams, int, float | None]:
             max_tokens=max_tokens,
             stop=stop,
             logprobs=bool(lp),
+            slo=slo,
         )
     except ValueError as e:
         raise ApiError(str(e)) from None

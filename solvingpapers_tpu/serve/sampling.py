@@ -118,6 +118,15 @@ class SamplingParams:
                   `ServeConfig.kv_exact_lanes` >= 1 (submit validates)
                   and bypasses the quantized prefix cache. A no-op on
                   an unquantized engine (everything is exact there).
+    slo           SLO class the request's latency is accounted under
+                  (serve/slo.py: "interactive"/"standard"/"batch" in the
+                  default tier set; any class `ServeConfig.slo_targets`
+                  defines). None = the engine's default class when SLO
+                  accounting is on. Pure host-side bookkeeping — it
+                  never changes sampling or scheduling, only which
+                  attainment/goodput bucket the request lands in; submit
+                  validates the class exists (and that slo_targets is
+                  configured at all).
     """
 
     temperature: float = 0.0
@@ -130,6 +139,7 @@ class SamplingParams:
     stop: tuple[str, ...] = ()
     logprobs: bool = False
     kv_exact: bool = False
+    slo: str | None = None
 
     def __post_init__(self):
         if self.temperature < 0:
@@ -153,6 +163,16 @@ class SamplingParams:
             )
         if self.max_tokens is not None and self.max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {self.max_tokens}")
+        if self.slo is not None and (
+            not isinstance(self.slo, str) or not self.slo
+        ):
+            # class MEMBERSHIP is an engine property (ServeConfig.
+            # slo_targets names the classes) — submit validates that;
+            # here only the type, so the error blames the right knob
+            raise ValueError(
+                f"slo must be None or a non-empty class name, got "
+                f"{self.slo!r}"
+            )
         # normalize: a lone string is a single stop string, not chars
         stop = (self.stop,) if isinstance(self.stop, str) else tuple(self.stop)
         if any(not s for s in stop):

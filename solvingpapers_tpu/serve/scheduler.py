@@ -113,6 +113,24 @@ class Request:
     # would burden the dispatch-bound host loop). Slightly stale by design
     # — it only orders admission; the engine re-matches at admit time.
     prefix_hint: int | None = None
+    # client-facing trace identity (the HTTP front door's X-Request-Id,
+    # honored or minted): joins the wire request to this engine object
+    # in traces and the GET /v1/requests/<id> debug timeline
+    trace_id: str | None = None
+    # per-request speculative-decoding facts (serve/spec.py): drafts this
+    # request's slot proposed / survived verification — the acceptance
+    # fact its debug timeline carries (engine-wide rates hide per-request
+    # adversarial streams)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    # peak pages the request's slot held (paged pool; 0 on lane pools) —
+    # stamped at finish/preempt boundaries, the page-usage fact of the
+    # debug timeline
+    pages_held: int = 0
+    # SLO verdict (serve/slo.py SloTracker.observe): class / attained /
+    # violated metrics / latencies, set at finish when SLO accounting is
+    # configured
+    slo_result: dict | None = None
     # late-bound so every engine timestamp shares one clock domain with
     # serve.metrics.now (patchable in tests/simulation)
     submit_time: float = dataclasses.field(

@@ -367,3 +367,218 @@ def test_close_is_ordered_and_idempotent(gpt_tiny):
     # the port is actually released: a fresh connect fails
     with pytest.raises(OSError):
         socket.create_connection((srv.host, srv.port), timeout=1)
+
+
+# --------------------------------------------- request tracing / timeline
+
+
+@pytest.fixture(scope="module")
+def traced_server(gpt_tiny):
+    """Front door with the flight recorder + SLO accounting on and a
+    1-token decode block, so requests run long enough (many engine
+    steps) for the client-wall partition pin to be meaningful."""
+    from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
+
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=128, decode_block=1, bucket=8, api_port=0,
+        trace=True, slo_targets=DEFAULT_SLO_TARGETS,
+    ), detokenize=_decode)
+    srv = ApiServer(eng, encode=_encode, decode=_decode,
+                    model_name="gpt-tiny-traced")
+    # warm every program shape so the pinned request pays no compile
+    _post(srv, "/v1/completions", {"prompt": list(range(8)),
+                                   "max_tokens": 4, "temperature": 0})
+    yield srv, eng
+    srv.close()
+
+
+def _stream_with_rid(srv, body, rid=None, timeout=120):
+    """Raw-socket SSE POST; returns (response headers dict, events,
+    t_start, t_done) with the wall clock read immediately around the
+    socket's life — the client-observed e2e."""
+    payload = json.dumps({**body, "stream": True}).encode()
+    hdrs = (b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+            b"Content-Type: application/json\r\n")
+    if rid is not None:
+        hdrs += b"X-Request-Id: " + rid.encode() + b"\r\n"
+    hdrs += b"Content-Length: " + str(len(payload)).encode() + b"\r\n\r\n"
+    t_start = time.monotonic()
+    s = socket.create_connection((srv.host, srv.port), timeout=timeout)
+    s.sendall(hdrs + payload)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        buf += s.recv(4096)
+    head, buf = buf.split(b"\r\n\r\n", 1)
+    lines = head.decode().split("\r\n")
+    assert "200" in lines[0], head
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    events = []
+    t_done = None
+    while True:
+        while b"\n\n" not in buf:
+            chunk = s.recv(4096)
+            if not chunk:
+                s.close()
+                return headers, events, t_start, t_done or time.monotonic()
+        frame, buf = buf.split(b"\n\n", 1)
+        frame = frame.strip()
+        if not frame.startswith(b"data: "):
+            continue
+        if frame[6:] == b"[DONE]":
+            t_done = time.monotonic()
+            s.close()
+            return headers, events, t_start, t_done
+        events.append(json.loads(frame[6:]))
+
+
+def _get_json(srv, path):
+    try:
+        with urllib.request.urlopen(srv.url(path), timeout=30) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_request_id_round_trip_and_timeline_partition(traced_server):
+    """Acceptance: X-Request-Id round-trips, GET /v1/requests/<id>
+    returns the end-to-end timeline, and its phases (accept -> parse ->
+    queue_handoff -> queue -> prefill -> decode -> sse_drain) partition
+    the client-observed e2e wall within 5%."""
+    srv, eng = traced_server
+    rid = "pin-req-001"
+    headers, events, t_start, t_done = _stream_with_rid(
+        srv, {"prompt": list(range(12)), "max_tokens": 96,
+              "temperature": 0, "slo": "standard"}, rid=rid)
+    assert headers.get("x-request-id") == rid
+    client_wall = t_done - t_start
+    st, ghdrs, doc = _get_json(srv, f"/v1/requests/{rid}")
+    assert st == 200
+    assert ghdrs.get("X-Request-Id") == rid
+    assert doc["request_id"] == rid
+    assert doc["state"] == "finished"
+    assert doc["finish_reason"] == "length"
+    phases = doc["phases"]
+    assert set(phases) == {"accept", "parse", "queue_handoff", "queue",
+                           "prefill", "decode", "sse_drain"}
+    assert all(v >= 0 for v in phases.values())
+    # server-side partition is exact by construction (contiguous stamps
+    # on one clock)...
+    assert doc["phase_sum_s"] == pytest.approx(doc["e2e_s"], abs=2e-5)
+    # ...and covers the CLIENT-observed wall within 5% (the remainder
+    # is TCP connect + request write ahead of the accept stamp)
+    assert doc["phase_sum_s"] == pytest.approx(client_wall, rel=0.05)
+    # the timeline carries the request's serving facts
+    facts = doc["facts"]
+    assert facts["prompt_tokens"] == 12
+    assert facts["completion_tokens"] == 96
+    assert facts["kv_quant"] is None and facts["kv_exact"] is False
+    assert doc["slo"]["class"] == "standard"
+    assert doc["slo"]["attained"] in (True, False)
+    assert set(doc["slo"]["latencies"]) >= {"ttft_s", "e2e_s"}
+
+
+def test_request_id_minted_when_absent_or_malformed(traced_server):
+    srv, _ = traced_server
+    headers, _, _, _ = _stream_with_rid(
+        srv, {"prompt": list(range(8)), "max_tokens": 4,
+              "temperature": 0})
+    minted = headers.get("x-request-id")
+    assert minted and len(minted) == 32  # uuid4 hex
+    st, _, doc = _get_json(srv, f"/v1/requests/{minted}")
+    assert st == 200 and doc["request_id"] == minted
+    # hostile/malformed ids are replaced, never echoed back verbatim
+    headers, _, _, _ = _stream_with_rid(
+        srv, {"prompt": list(range(8)), "max_tokens": 4,
+              "temperature": 0}, rid="bad id\x7f!" )
+    assert headers.get("x-request-id") != "bad id\x7f!"
+
+
+def test_request_timeline_unknown_id_404_and_blocking_path(traced_server):
+    srv, _ = traced_server
+    st, _, doc = _get_json(srv, "/v1/requests/never-seen")
+    assert st == 404
+    assert doc["error"]["code"] == "request_not_found"
+    # non-streaming responses carry the id + timeline too
+    req = urllib.request.Request(
+        srv.url("/v1/completions"),
+        data=json.dumps({"prompt": list(range(6)), "max_tokens": 6,
+                         "temperature": 0}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "blocking-1"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        assert r.headers.get("X-Request-Id") == "blocking-1"
+        json.loads(r.read())
+    st, _, doc = _get_json(srv, "/v1/requests/blocking-1")
+    assert st == 200
+    assert doc["stream"] is False
+    assert doc["phases"]["sse_drain"] >= 0  # response-write drain
+
+
+def test_http_spans_join_engine_trace(traced_server):
+    """The recorder holds http-category spans for served requests, and
+    summarize_trace assembles rows with BOTH engine and http phases."""
+    from solvingpapers_tpu.metrics.trace import summarize_trace
+
+    srv, eng = traced_server
+    rid = "trace-join-1"
+    _stream_with_rid(srv, {"prompt": list(range(10)), "max_tokens": 8,
+                           "temperature": 0}, rid=rid)
+    names = {e.name for e in eng.trace.events() if e.cat == "http"}
+    assert {"accept", "parse", "queue_handoff", "sse_drain"} <= names
+    accept = next(e for e in eng.trace.events()
+                  if e.cat == "http" and e.name == "accept"
+                  and (e.args or {}).get("trace_id") == rid)
+    summary = summarize_trace(eng.trace.to_chrome())
+    row = next(r for r in summary["requests"]
+               if r["req"] == accept.req)
+    assert {"accept", "parse", "queue_handoff",
+            "sse_drain"} <= set(row["http_phases"])
+    assert row["e2e_s"] > row["total_s"]
+    assert "http" in summary
+
+
+def test_service_tier_alias_is_best_effort(traced_server):
+    """The explicit `slo` field validates strictly (typo -> 400), but
+    OpenAI's `service_tier` only maps when it names a configured class
+    — stock values this server has no class for must not turn a valid
+    OpenAI request into a 400."""
+    srv, _ = traced_server
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(6)), "max_tokens": 4, "temperature": 0,
+        "service_tier": "flex",  # documented OpenAI value, no class here
+    })
+    assert st == 200, doc
+    st, _, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(6)), "max_tokens": 4, "temperature": 0,
+        "service_tier": "interactive",  # names a configured class
+    })
+    assert st == 200
+    st, hdrs, doc = _post(srv, "/v1/completions", {
+        "prompt": list(range(6)), "max_tokens": 4, "temperature": 0,
+        "slo": "platinum",  # explicit field stays strict
+    })
+    assert st == 400
+    assert "unknown SLO class" in doc["error"]["message"]
+    assert hdrs.get("X-Request-Id")  # even the 400 carries an id
+
+
+def test_400_envelope_carries_request_id(traced_server):
+    srv, _ = traced_server
+    req = urllib.request.Request(
+        srv.url("/v1/completions"),
+        data=json.dumps({"prompt": "x", "temperature": -1}).encode(),
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "err-1"}, method="POST")
+    try:
+        urllib.request.urlopen(req, timeout=60)
+        raise AssertionError("expected a 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert e.headers.get("X-Request-Id") == "err-1"
+        assert json.loads(e.read())["error"]["type"] == \
+            "invalid_request_error"

@@ -1,0 +1,148 @@
+"""tools/bench_check.py: the BENCH_serve.json regression gate.
+
+The gate's contract: a synthetically regressed entry fails, the
+committed history passes its own self-check, scale-sensitive metrics
+are only compared at matching scale, direction is resolved per metric
+family, and the trajectory summary covers every committed workload.
+"""
+
+import copy
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+ROOT = pathlib.Path(__file__).parent.parent
+
+
+@pytest.fixture(scope="module")
+def bc():
+    spec = importlib.util.spec_from_file_location(
+        "bench_check", ROOT / "tools" / "bench_check.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _poisson_entry(rps=8.8, ttft=1.44, overhead=-0.6, n_requests=32):
+    return {
+        "schema_version": 2,
+        "provenance": {"git_sha": "a" * 40, "timestamp": 1.0},
+        "metric": "serve_requests_per_sec", "value": rps, "unit": "req/s",
+        "vs_baseline": 2.1,
+        "detail": {
+            "config": "llama3_shakespeare", "n_requests": n_requests,
+            "n_slots": 8, "max_new_tokens": 64, "decode_block": 16,
+            "engine_requests_per_sec": rps, "mean_ttft_s": ttft,
+            "trace_overhead_pct": overhead, "greedy_agreement_rate": 1.0,
+        },
+    }
+
+
+def test_committed_history_passes_and_summary_covers_workloads(bc, capsys):
+    """Acceptance: the committed BENCH_serve.json self-checks green and
+    the emitted trajectory covers all 7+ existing workloads."""
+    entries = bc.load_entries(str(ROOT / "BENCH_serve.json"))
+    assert len(entries) >= 8
+    workloads = {bc.workload_of(e) for e in entries}
+    assert {"poisson", "shared-prefix", "sampling-mix", "paged-vs-lane",
+            "http-stream-soak", "speculative-decode", "quant-kv",
+            "slo-observatory"} <= workloads
+    # every entry is now identifiable: schema + git sha (backfilled for
+    # the pre-gate era, measured from schema 2 on)
+    for e in entries:
+        assert e["schema_version"] in (1, 2)
+        assert e["provenance"]["git_sha"]
+        assert e["provenance"]["timestamp"]
+        if e["schema_version"] >= 2:
+            assert e["provenance"]["jax"]
+            assert e["provenance"]["device_kind"]
+    summary = bc.trajectory_summary(entries)
+    for wl in workloads:
+        assert wl in summary
+    assert bc.check_regressions(entries, []) == []
+    assert bc.main(["--history", str(ROOT / "BENCH_serve.json")]) == 0
+    out = capsys.readouterr().out
+    assert "bench trajectory" in out and "OK" in out
+
+
+def test_synthetic_regression_fails_the_gate(bc):
+    """Acceptance: a regressed entry is caught — throughput collapse,
+    latency blow-up, and overhead-band breach each flag."""
+    base = _poisson_entry()
+    good = _poisson_entry(rps=8.5, ttft=1.5, overhead=1.2)
+    assert bc.check_regressions([base], [good]) == []
+    slow = _poisson_entry(rps=2.9)  # 3x throughput collapse
+    regs = bc.check_regressions([base], [slow])
+    assert any("engine_requests_per_sec" in r for r in regs)
+    laggy = _poisson_entry(ttft=4.0)  # lower-is-better direction
+    regs = bc.check_regressions([base], [laggy])
+    assert any("mean_ttft_s" in r for r in regs)
+    heavy = _poisson_entry(overhead=25.0)  # pct band is absolute pp
+    regs = bc.check_regressions([base], [heavy])
+    assert any("trace_overhead_pct" in r for r in regs)
+    # IMPROVEMENTS never flag (direction-aware)
+    fast = _poisson_entry(rps=30.0, ttft=0.2, overhead=-9.0)
+    assert bc.check_regressions([base], [fast]) == []
+
+
+def test_scale_sensitive_metrics_gated_on_matching_scale(bc):
+    """A CI smoke at 8 requests must not be throughput- or rate-
+    compared against the committed 32-request measurement (a smoke's
+    agreement/acceptance reflects its own shorter training) — only the
+    *_pct overheads and exactness booleans gate across scales."""
+    base = _poisson_entry()
+    smoke = _poisson_entry(rps=0.9, ttft=9.0, n_requests=8)
+    smoke["detail"]["greedy_agreement_rate"] = 0.7  # smoke-scale rate
+    assert bc.check_regressions([base], [smoke]) == []
+    bad_smoke = _poisson_entry(rps=0.9, n_requests=8, overhead=40.0)
+    regs = bc.check_regressions([base], [bad_smoke])
+    assert regs and all("overhead" in r for r in regs)
+    # at MATCHING scale the rate gates
+    worse_rate = _poisson_entry()
+    worse_rate["detail"]["greedy_agreement_rate"] = 0.7
+    regs = bc.check_regressions([base], [worse_rate])
+    assert any("greedy_agreement_rate" in r for r in regs)
+
+
+def test_boolean_exactness_must_not_flip(bc):
+    base = _poisson_entry()
+    base["detail"]["stream_token_exact"] = True
+    flip = _poisson_entry()
+    flip["detail"]["stream_token_exact"] = False
+    regs = bc.check_regressions([base], [flip])
+    assert any("stream_token_exact" in r for r in regs)
+
+
+def test_history_median_absorbs_one_outlier(bc):
+    """Baselines are the MEDIAN of the trailing history: one noisy
+    historical rep must not move the gate."""
+    hist = [_poisson_entry(rps=8.8), _poisson_entry(rps=9.0),
+            _poisson_entry(rps=2.0)]  # one bad historical run
+    cand = _poisson_entry(rps=8.0)
+    assert bc.check_regressions(hist, [cand]) == []
+
+
+def test_unknown_workload_and_empty_history(bc):
+    novel = copy.deepcopy(_poisson_entry())
+    novel["detail"]["workload"] = "brand-new-workload"
+    assert bc.check_regressions([_poisson_entry()], [novel]) == []
+    regs, notes = bc.compare_entry(novel, [])
+    assert regs == [] and any("no trailing history" in n for n in notes)
+
+
+def test_main_gate_exit_codes(bc, tmp_path, capsys):
+    hist = tmp_path / "hist.json"
+    hist.write_text(json.dumps(_poisson_entry()) + "\n")
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_poisson_entry(rps=2.0)) + "\n")
+    assert bc.main(["--history", str(hist),
+                    "--candidate", str(cand)]) == 2
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_poisson_entry(rps=8.9)) + "\n")
+    assert bc.main(["--history", str(hist), "--candidate", str(ok)]) == 0

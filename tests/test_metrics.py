@@ -137,6 +137,135 @@ def test_parity_regression_check():
     assert mod.check_regressions(history, other) == []
 
 
+# --------------------------------------------- log-bucketed histograms
+
+
+def test_histogram_single_observation_is_exact():
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    h = LogHistogram()
+    h.add(0.25)
+    assert len(h) == 1
+    assert h.mean() == pytest.approx(0.25)
+    # min/max clamping makes a single-bucket population exact — the
+    # property that lets histogram percentiles keep the Ring's key
+    # semantics for sparse data
+    assert h.percentiles() == {"p50": 0.25, "p95": 0.25, "p99": 0.25}
+
+
+def test_histogram_quantile_error_bounded_by_bucket_width():
+    """Property: the quantile estimate lands in the same bucket as the
+    exact nearest-rank sample, so its error is at most that bucket's
+    width (the claim the log-bucket layout is sized around)."""
+    import math
+
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(mean=-2.0, sigma=1.8, size=4000)
+    h = LogHistogram()
+    for v in vals:
+        h.add(v)
+    s = np.sort(vals)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 0.999):
+        exact = s[max(1, math.ceil(q * s.size)) - 1]
+        est = h.quantile(q)
+        i = h._index(exact)
+        lo = 0.0 if i == 0 else h.lo * 10.0 ** ((i - 1) / h._scale)
+        hi = h.lo if i == 0 else h.edge(i - 1)
+        width = hi - lo
+        assert abs(est - exact) <= width + 1e-12, (q, est, exact, width)
+    # mean and count are exact, not bucket-resolution
+    assert h.mean() == pytest.approx(float(s.mean()))
+    assert len(h) == s.size
+
+
+def test_histogram_merge_is_exact():
+    """merge-of-shards == shard-of-merged: identical bucket counts,
+    count, min/max (the per-replica aggregation enabler)."""
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(-1.0, 2.0, 2003)
+    whole = LogHistogram()
+    shards = [LogHistogram() for _ in range(5)]
+    for i, v in enumerate(vals):
+        whole.add(v)
+        shards[i % 5].add(v)
+    merged = LogHistogram.merge(shards)
+    assert (merged.counts == whole.counts).all()
+    assert merged.count == whole.count
+    assert merged.min == whole.min and merged.max == whole.max
+    assert merged.sum == pytest.approx(whole.sum, rel=1e-12)
+    for q in (0.5, 0.95, 0.99):
+        assert merged.quantile(q) == whole.quantile(q)
+    # layout mismatch must refuse, not silently mis-bucket
+    with pytest.raises(ValueError, match="layout"):
+        whole.merge_from(LogHistogram(lo=1e-3))
+
+
+def test_histogram_overflow_underflow_counted_and_clamped():
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    h = LogHistogram(lo=1e-2, hi=1e2, buckets_per_decade=4)
+    h.add(1e-5)   # underflow
+    h.add(1e5)    # overflow
+    h.add(1.0)
+    assert len(h) == 3
+    assert h.counts[0] == 1 and h.counts[-1] == 1
+    # quantiles clamp to observed extremes, never invent an edge value
+    assert h.quantile(0.0) == pytest.approx(1e-5)
+    assert h.quantile(1.0) == pytest.approx(1e5)
+    with pytest.raises(ValueError, match="lo"):
+        LogHistogram(lo=0.0)
+    with pytest.raises(ValueError, match="buckets_per_decade"):
+        LogHistogram(buckets_per_decade=0)
+
+
+def test_prometheus_renders_native_histogram(tmp_path):
+    """LogHistogram values become _bucket{le=...}/_sum/_count series on
+    BOTH exposition paths (render backs the textfile sink and the live
+    /metrics endpoint): cumulative counts, every edge emitted (aligned
+    le sets across replicas), +Inf bucket == count."""
+    from solvingpapers_tpu.metrics import LogHistogram, PrometheusTextWriter
+
+    h = LogHistogram(lo=0.01, hi=10.0, buckets_per_decade=2)
+    for v in (0.02, 0.3, 0.3, 5.0):
+        h.add(v)
+    text = PrometheusTextWriter.render(
+        3, {"serve/ttft_s": h, "serve/ttft_s_mean": h.mean()})
+    lines = text.splitlines()
+    assert "# TYPE serve_ttft_s histogram" in lines
+    assert "# TYPE serve_ttft_s_mean gauge" in lines
+    buckets = [ln for ln in lines if ln.startswith("serve_ttft_s_bucket{")]
+    # 3 decades x 2 buckets + underflow + +Inf
+    assert len(buckets) == 8
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 4
+    assert buckets[-1].startswith('serve_ttft_s_bucket{le="+Inf"}')
+    assert "serve_ttft_s_count 4" in lines
+    (sum_line,) = [ln for ln in lines if ln.startswith("serve_ttft_s_sum ")]
+    assert float(sum_line.split(" ")[1]) == pytest.approx(5.62)
+    # atomic-write path renders identically
+    path = str(tmp_path / "h.prom")
+    PrometheusTextWriter(path).write(3, {"serve/ttft_s": h})
+    assert "serve_ttft_s_count 4" in open(path).read()
+
+
+def test_prometheus_histogram_wins_derived_name_collisions():
+    from solvingpapers_tpu.metrics import LogHistogram, PrometheusTextWriter
+
+    h = LogHistogram(lo=0.01, hi=10.0, buckets_per_decade=2)
+    h.add(0.5)
+    text = PrometheusTextWriter.render(
+        0, {"x": h, "x_count": 99.0})
+    # the histogram's _count series wins; no duplicate series emitted
+    value_lines = [ln for ln in text.splitlines()
+                   if ln.startswith("x_count")]
+    assert value_lines == ["x_count 1"]
+
+
 # ----------------------------------------------------- writer robustness
 
 

@@ -97,6 +97,155 @@ def test_latency_summaries_present_iff_observed():
     assert snap["serve/queue_wait_s_p99"] == pytest.approx(0.25)
     assert "serve/itl_s_mean" not in snap  # no tokens streamed yet
     assert "serve/ttft_s_mean" not in snap
+    assert "serve/e2e_s_mean" not in snap  # nothing finished yet
+    m.record_finish(_req(submit=0.0, reason="eos"), now=0.75)
+    snap = m.snapshot()
+    assert snap["serve/e2e_s_mean"] == pytest.approx(0.75)
+    assert snap["serve/e2e_s_p99"] == pytest.approx(0.75)
+
+
+def test_prom_snapshot_carries_histograms_iff_observed():
+    """prom_snapshot() = snapshot() + the LogHistogram objects under the
+    base latency names — what the Prometheus paths render as native
+    _bucket/_sum/_count series; flat sinks keep the float surface."""
+    from solvingpapers_tpu.metrics.hist import LogHistogram
+
+    m = ServeMetrics()
+    assert not any(isinstance(v, LogHistogram)
+                   for v in m.prom_snapshot().values())
+    m.record_admit(_req(submit=0.0), now=0.25)
+    snap = m.prom_snapshot()
+    assert isinstance(snap["serve/queue_wait_s"], LogHistogram)
+    assert "serve/ttft_s" not in snap  # unobserved stays absent
+    # the float summary rides alongside, under its own names
+    assert snap["serve/queue_wait_s_mean"] == pytest.approx(0.25)
+    # the whole mixed set renders as valid exposition text
+    text = PrometheusTextWriter.render(1, snap)
+    assert 'serve_queue_wait_s_bucket{le="+Inf"} 1' in text
+    assert "serve_queue_wait_s_count 1" in text
+    # emit() routes histograms only to sinks that declare support
+    class Flat:
+        accepts_histograms = False
+
+        def write(self, step, metrics):
+            self.seen = metrics
+
+    flat = Flat()
+    m.emit(flat)
+    assert not any(isinstance(v, LogHistogram) for v in flat.seen.values())
+
+
+def test_slo_gauges_present_iff_configured():
+    """slo/* + serve/goodput_* appear exactly when the engine has
+    ServeConfig.slo_targets (gauge provider, same mechanism as the
+    paged/spec/observatory families) and account per-class attainment,
+    burn and goodput."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+    from solvingpapers_tpu.serve import SamplingParams, ServeConfig, ServeEngine
+    from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                          n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    plain = ServeEngine(model, params, ServeConfig(n_slots=2, max_len=32))
+    assert not any(k.startswith("slo/") or "goodput" in k
+                   for k in plain.metrics.snapshot())
+    # an slo tag without a tracker must refuse, not silently untrack
+    with pytest.raises(ValueError, match="slo_targets"):
+        plain.submit(np.arange(4, dtype=np.int32),
+                     params=SamplingParams(slo="interactive"))
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        slo_targets=DEFAULT_SLO_TARGETS,
+    ))
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        eng.submit(np.arange(4, dtype=np.int32),
+                   params=SamplingParams(slo="platinum"))
+    snap = eng.metrics.snapshot()
+    for cls in DEFAULT_SLO_TARGETS:
+        assert snap[f"slo/{cls}_finished"] == 0.0
+        assert f"slo/{cls}_attainment" not in snap  # no invented values
+    assert snap["serve/goodput_tokens"] == 0.0
+    hs = [
+        eng.submit(np.arange(4 + i, dtype=np.int32), max_new_tokens=6,
+                   params=SamplingParams(slo="interactive"))
+        for i in range(2)
+    ]
+    hs.append(eng.submit(np.arange(8, dtype=np.int32), max_new_tokens=6))
+    eng.run()
+    assert all(h.done for h in hs)
+    end = eng.metrics.snapshot()
+    assert end["slo/interactive_finished"] == 2.0
+    assert end["slo/standard_finished"] == 1.0  # untagged -> standard
+    assert 0.0 <= end["slo/interactive_attainment"] <= 1.0
+    assert end["slo/interactive_burn_rate"] >= 0.0
+    assert end["serve/goodput_tokens"] <= end["serve/tokens_out"]
+    if end["serve/goodput_tokens"]:
+        assert end["serve/goodput_tokens_per_s"] > 0
+    # the per-request verdict rides the handle for the debug timeline
+    assert hs[0].slo_result is not None
+    assert hs[0].slo_result["class"] == "interactive"
+    assert set(hs[0].slo_result) >= {"attained", "violated", "latencies"}
+    # /statusz carries the build identity + slo section
+    doc = eng.statusz()
+    assert doc["build"]["jax"] and doc["build"]["uptime_s"] >= 0
+    assert doc["build"]["version"]
+    assert set(doc["slo"]["classes"]) == set(DEFAULT_SLO_TARGETS)
+    assert doc["slo"]["classes"]["interactive"]["finished"] == 2
+    for k in end:
+        if k.startswith("slo/"):
+            assert PrometheusTextWriter.sanitize(k).startswith("slo_")
+
+
+def test_slo_tracker_accounting_rules():
+    """Unit rules: timeout counts as a violation, cancelled is excluded
+    entirely, a request that never reached a configured target's phase
+    is violated (no observation != attained), burn = windowed violation
+    rate / error budget."""
+    import types as _t
+
+    from solvingpapers_tpu.serve.slo import SloTracker
+
+    tr = SloTracker({"standard": {"ttft_s": 1.0, "e2e_s": 5.0,
+                                  "objective": 0.9}}, burn_window=4)
+
+    def req(reason, submit=0.0, first=None, finish=None, tokens=3,
+            slo=None):
+        return _t.SimpleNamespace(
+            finish_reason=reason, submit_time=submit,
+            first_token_time=first, finish_time=finish,
+            tokens=list(range(tokens)),
+            params=_t.SimpleNamespace(slo=slo),
+        )
+
+    ok = tr.observe(req("eos", first=0.5, finish=2.0), now=2.0)
+    assert ok["attained"] and tr.goodput_tokens == 3
+    # timeout before first token: ttft configured but unobservable ->
+    # violated, tokens excluded from goodput
+    bad = tr.observe(req("timeout"), now=2.0)
+    assert not bad["attained"] and "ttft_s" in bad["violated"]
+    assert tr.goodput_tokens == 3
+    # cancelled: excluded from numerator AND denominator
+    assert tr.observe(req("cancelled"), now=1.0) is None
+    g = tr.gauges(elapsed_s=2.0)
+    assert g["slo/standard_finished"] == 2.0
+    assert g["slo/standard_attainment"] == 0.5
+    # window [True, False]: violation rate 0.5 / budget 0.1 = 5.0
+    assert g["slo/standard_burn_rate"] == pytest.approx(5.0)
+    assert g["serve/goodput_tokens_per_s"] == pytest.approx(1.5)
+    assert tr.statusz()["excluded_finishes"] == 1
+    # config validation fails loudly
+    with pytest.raises(ValueError, match="standard"):
+        SloTracker({"gold": {"ttft_s": 1.0}})
+    with pytest.raises(ValueError, match="unknown keys"):
+        SloTracker({"standard": {"ttft_ms": 1.0}})
+    with pytest.raises(ValueError, match="objective"):
+        SloTracker({"standard": {"ttft_s": 1.0, "objective": 1.5}})
 
 
 def test_preemption_keys_present_iff_observed():

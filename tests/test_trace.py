@@ -169,7 +169,10 @@ def test_anomaly_reject_burst_fires_once_per_burst(tmp_path):
     assert mon.dumps == 2
 
 
-def test_anomaly_finish_reasons_and_dump_cap(tmp_path):
+def test_anomaly_finish_reasons_and_keep_newest_rotation(tmp_path):
+    """Past max_dumps the file rotates KEEP-NEWEST (the old hard cap
+    silently dropped every later incident — exactly the records a live
+    post-mortem needs), warning once on the first rotation."""
     rec = FlightRecorder()
     mon = _mon(tmp_path, rec, max_dumps=3)
     mon.observe_finish("eos")
@@ -178,10 +181,23 @@ def test_anomaly_finish_reasons_and_dump_cap(tmp_path):
     mon.observe_finish("timeout")
     mon.observe_finish("cancelled")
     assert mon.dumps == 2
-    for _ in range(10):
-        mon.observe_finish("timeout")
-    assert mon.dumps == 3  # bounded
-    assert len(_dumps(tmp_path)) == 3
+    mon.observe_finish("timeout")  # fills the file to the cap
+    with pytest.warns(RuntimeWarning, match="rotating keep-newest"):
+        for i in range(10):
+            mon.dump("probe", i=i)
+    assert mon.dumps == 13  # total ever taken keeps counting
+    recs = _dumps(tmp_path)
+    assert len(recs) == 3  # file stays bounded...
+    # ...and holds the NEWEST records, oldest rotated out
+    assert [r["detail"].get("i") for r in recs] == [7, 8, 9]
+    # a second overflow must not warn again
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        mon.dump("probe", i=10)
+    assert [r["detail"].get("i") for r in _dumps(tmp_path)] == [8, 9, 10]
+    assert not (tmp_path / "anom.jsonl.tmp").exists()
 
 
 # ------------------------------------------------------ engine integration
@@ -361,6 +377,51 @@ def test_summarize_handles_unadmitted_requests(gpt_tiny, tmp_path):
 def test_events_to_chrome_empty():
     assert events_to_chrome([]) == {"traceEvents": [],
                                     "displayTimeUnit": "ms"}
+
+
+def test_summarize_joins_http_phases(gpt_tiny):
+    """HTTP front-door spans (cat "http", serve/api.py) join their
+    request's engine lifecycle row: http_phases + e2e_s appear on rows
+    that have them, the summary grows an `http` section, and a trace
+    WITHOUT http spans keeps the key absent (PR-8-era traces summarize
+    unchanged)."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    h = eng.submit(_prompts(1, seed=21)[0], max_new_tokens=6)
+    eng.run()
+    assert h.done
+    no_http = summarize_trace(eng.trace.to_chrome())
+    assert "http" not in no_http
+    assert "http_phases" not in no_http["requests"][0]
+    # synthesize the front door's contiguous spans around the engine's
+    t0 = h.submit_time
+    eng.trace.complete("accept", "http", "http", ts=t0 - 0.003,
+                       dur=0.001, req=h.id, trace_id="rid-1")
+    eng.trace.complete("parse", "http", "http", ts=t0 - 0.002,
+                       dur=0.0015, req=h.id)
+    eng.trace.complete("queue_handoff", "http", "http", ts=t0 - 0.0005,
+                       dur=0.0005, req=h.id)
+    eng.trace.complete("sse_drain", "http", "http", ts=h.finish_time,
+                       dur=0.002, req=h.id, events=3)
+    eng.trace.instant("disconnect", "http", "http", req=h.id)
+    # an http span for an UNKNOWN request must not invent a timeline row
+    eng.trace.complete("accept", "http", "http", ts=t0, dur=0.001,
+                       req=99999)
+    summary = summarize_trace(eng.trace.to_chrome())
+    assert summary["n_requests"] == 1
+    r = summary["requests"][0]
+    assert r["http_phases"] == pytest.approx({
+        "accept": 0.001, "parse": 0.0015, "queue_handoff": 0.0005,
+        "sse_drain": 0.002,
+    }, rel=1e-3)
+    assert r["e2e_s"] == pytest.approx(r["total_s"] + 0.005, rel=1e-3)
+    assert summary["http"]["disconnects"] == 1
+    assert summary["http"]["phase_totals_s"]["accept"] == \
+        pytest.approx(0.001, rel=1e-3)
+    out = format_summary(summary)
+    assert "http front door:" in out and "disconnects: 1" in out
 
 
 # ------------------------------------------------------------------- cli

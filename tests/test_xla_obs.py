@@ -260,13 +260,19 @@ def test_status_endpoint_serves_live_engine(gpt_tiny):
 
         code, body = _get(base + "/metrics")
         assert code == 200
-        name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+        # series grammar: bare gauge names plus the latency histograms'
+        # labeled `_bucket{le="..."}` series (native since the log-
+        # bucketed backend replaced the Ring)
+        name_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})?$')
         names = set()
         for line in body.splitlines():
             if line.startswith("#"):
-                assert line.split()[1] == "TYPE"
+                parts = line.split()
+                assert parts[1] == "TYPE"
+                assert parts[3] in ("gauge", "histogram")
                 continue
-            name, value = line.split(" ", 1)
+            name, value = line.rsplit(" ", 1)
             assert name_re.match(name), name
             float(value)  # parseable exposition value
             names.add(name)
@@ -275,6 +281,9 @@ def test_status_endpoint_serves_live_engine(gpt_tiny):
         assert "serve_requests_finished" in names
         assert "compile_compilations" in names
         assert "mem_kv_pool_bytes" in names
+        assert "serve_ttft_s_count" in names  # histogram rode the pull path
+        assert any(n.startswith('serve_ttft_s_bucket{le="')
+                   for n in names)
 
         code, body = _get(base + "/statusz")
         assert code == 200

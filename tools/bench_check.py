@@ -1,0 +1,353 @@
+"""Bench-regression gate over the BENCH_serve.json trajectory.
+
+BENCH_serve.json accumulated hard-won numbers (2.24x spec speedup,
+3.75x quant capacity, sub-2% observatory overheads) with nothing that
+notices when a future PR regresses them. This tool closes the loop:
+
+* entries are KEYED BY WORKLOAD (``detail.workload``, with the original
+  Poisson entry's missing key defaulting to "poisson") and, for
+  scale-sensitive metrics, by SCALE (config / request count / slots /
+  token budget) — a CI smoke at 8 requests is never compared against
+  the committed 32-request measurement on absolute throughput;
+* each candidate entry is compared against the MEDIAN of its workload's
+  trailing history, per metric, with direction-aware tolerance bands:
+    - relative bands for throughput/latency/bytes style metrics
+      (higher-better vs lower-better resolved by name),
+    - absolute percentage-point bands for ``*_pct`` overheads (a
+      relative band around a near-zero overhead is meaningless),
+    - absolute bands for rates in [0, 1] (agreement, attainment,
+      acceptance, hit rate),
+    - booleans (``stream_token_exact``, ``greedy_token_exact``) must
+      never flip to False;
+* a trajectory summary covering every workload in the history is
+  emitted either way — the human-readable view of where the numbers
+  have been;
+* exit status 2 on any regression (the CI gate), 0 otherwise.
+
+Modes::
+
+    python tools/bench_check.py                      # self-check the
+        committed history: each workload's newest entry vs its trailing
+        entries (nothing to compare with single-entry workloads — pass)
+    python tools/bench_check.py --candidate smoke.json [--candidate ...]
+        gate fresh entries (e.g. CI smoke output) against the committed
+        history; widen the bands for smoke noise with --rel-tolerance-pct
+        / --pct-tolerance / --rate-tolerance
+
+Same shape as tools/parity_suite.py's `check_regressions`: pure
+functions over entry dicts, unit-tested in tests/test_bench_check.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+
+# ---------------------------------------------------------------- schema
+
+# fields that identify a measurement's scale: absolute numbers are only
+# comparable when every one of these matches (a missing key matches a
+# missing key — the original entries predate some fields)
+SCALE_KEYS = ("config", "n_requests", "n_slots", "max_new_tokens",
+              "decode_block")
+
+# booleans that must never regress to False
+BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact")
+
+# name-pattern -> (kind, higher_is_better); first match wins.
+# kind: "pct" = absolute percentage-point band — overheads hover near 0
+#       and are the one family comparable ACROSS scales (an 8-request
+#       smoke's tracing overhead still means something);
+#       "rate" = absolute band on a [0, 1]-ish value, gated on matching
+#       scale (a smoke's agreement/acceptance reflects its own shorter
+#       training/scale, not the committed measurement's);
+#       "rel"  = relative band, gated on matching scale (absolute
+#       throughput/latency/bytes)
+_RULES: tuple[tuple[tuple[str, ...], str, bool], ...] = (
+    (("_overhead_pct", "overhead_pct"), "pct", False),
+    (("agreement_rate", "acceptance_rate", "hit_rate", "attainment",
+      "goodput_ratio"), "rate", True),
+    (("requests_per_sec", "tokens_per_sec", "tokens_per_step",
+      "speedup", "peak_active_slots"), "rel", True),
+    (("ttft", "itl_", "_itl", "e2e_", "compile_time_s"), "rel", False),
+    (("hbm_bytes", "pool_bytes", "temp_bytes"), "rel", False),
+)
+
+
+def classify(field: str):
+    """(kind, higher_is_better) for a gated detail field, or None for
+    fields the gate ignores (counts, knobs, paths, nested dicts)."""
+    for patterns, kind, higher in _RULES:
+        if any(p in field for p in patterns):
+            return kind, higher
+    return None
+
+
+def workload_of(entry: dict) -> str:
+    det = entry.get("detail") or {}
+    return det.get("workload") or "poisson"
+
+
+def scale_of(entry: dict) -> tuple:
+    det = entry.get("detail") or {}
+    return tuple(det.get(k) for k in SCALE_KEYS)
+
+
+def load_entries(path: str) -> list[dict]:
+    entries = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(
+                    f"{path}:{i + 1} is not valid JSON ({e.msg}) — "
+                    "BENCH files are JSON-lines, one entry per line"
+                )
+    return entries
+
+
+# ----------------------------------------------------------------- gate
+
+
+def _gated_fields(entry: dict) -> dict:
+    """The comparable numeric fields of one entry: its `detail` scalars
+    plus the top-level `value`/`vs_baseline` (namespaced so they can't
+    collide with detail keys)."""
+    det = entry.get("detail") or {}
+    out = {}
+    for k, v in det.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        out[k] = float(v)
+    for k in ("value", "vs_baseline"):
+        v = entry.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[f"entry.{k}"] = float(v)
+    return out
+
+
+def classify_entry_field(field: str):
+    if field in ("entry.value", "entry.vs_baseline"):
+        # meaning differs per workload (req/s, speedup, slots ratio...)
+        # but "bigger = better" holds for every committed metric;
+        # scale-sensitive, so smokes at other scales skip it
+        return "rel", True
+    return classify(field)
+
+
+def compare_entry(candidate: dict, history: list[dict], *,
+                  rel_tolerance_pct: float = 25.0,
+                  pct_tolerance: float = 10.0,
+                  rate_tolerance: float = 0.05):
+    """Compare one candidate entry against its workload's trailing
+    history. Returns (regressions, notes): regressions are human-
+    readable failure strings (empty = gate passes), notes record what
+    was compared and what was skipped and why."""
+    regressions: list[str] = []
+    notes: list[str] = []
+    wl = workload_of(candidate)
+    if not history:
+        notes.append(f"[{wl}] no trailing history — nothing to gate")
+        return regressions, notes
+    scale_match = [h for h in history if scale_of(h) == scale_of(candidate)]
+    cand = _gated_fields(candidate)
+    cdet = candidate.get("detail") or {}
+
+    for field in BOOL_FIELDS:
+        if field not in cdet:
+            continue
+        ever_true = any((h.get("detail") or {}).get(field) is True
+                        for h in history)
+        if ever_true and cdet[field] is not True:
+            regressions.append(
+                f"[{wl}] {field} flipped to {cdet[field]!r} "
+                "(was True in history)"
+            )
+
+    compared = 0
+    for field, value in sorted(cand.items()):
+        spec = classify_entry_field(field)
+        if spec is None:
+            continue
+        kind, higher = spec
+        pool = history if kind == "pct" else scale_match
+        base_vals = [
+            _gated_fields(h)[field] for h in pool
+            if field in _gated_fields(h)
+        ]
+        if not base_vals:
+            if kind != "pct" and any(
+                field in _gated_fields(h) for h in history
+            ):
+                notes.append(
+                    f"[{wl}] {field}: scale differs from history — "
+                    "skipped (scale-sensitive metric)"
+                )
+            continue
+        base = statistics.median(base_vals)
+        compared += 1
+        if kind == "pct":
+            delta = value - base
+            bad = delta > pct_tolerance if not higher \
+                else -delta > pct_tolerance
+            if bad:
+                regressions.append(
+                    f"[{wl}] {field}: {value:g} vs baseline {base:g} "
+                    f"(Δ {delta:+.2f}pp > {pct_tolerance}pp band)"
+                )
+        elif kind == "rate":
+            delta = (base - value) if higher else (value - base)
+            if delta > rate_tolerance:
+                regressions.append(
+                    f"[{wl}] {field}: {value:g} vs baseline {base:g} "
+                    f"(worse by {delta:.3f} > {rate_tolerance} band)"
+                )
+        else:  # rel
+            if base == 0:
+                continue
+            change = (value - base) / abs(base)
+            worse = -change if higher else change
+            if worse * 100.0 > rel_tolerance_pct:
+                regressions.append(
+                    f"[{wl}] {field}: {value:g} vs baseline {base:g} "
+                    f"({'-' if higher else '+'}{abs(change) * 100:.1f}% "
+                    f"> {rel_tolerance_pct}% band)"
+                )
+    notes.append(f"[{wl}] compared {compared} metrics against "
+                 f"{len(history)} trailing entr"
+                 f"{'y' if len(history) == 1 else 'ies'}"
+                 f" ({len(scale_match)} at matching scale)")
+    return regressions, notes
+
+
+def check_regressions(history_entries: list[dict],
+                      candidates: list[dict], **tol) -> list[str]:
+    """Gate `candidates` against `history_entries` (grouped by
+    workload); returns every regression string found."""
+    by_wl: dict[str, list[dict]] = {}
+    for e in history_entries:
+        by_wl.setdefault(workload_of(e), []).append(e)
+    out: list[str] = []
+    for cand in candidates:
+        regs, _ = compare_entry(cand, by_wl.get(workload_of(cand), []),
+                                **tol)
+        out.extend(regs)
+    return out
+
+
+# -------------------------------------------------------------- summary
+
+
+def _headline(entry: dict) -> str:
+    prov = entry.get("provenance") or {}
+    sha = (prov.get("git_sha") or "")[:9] or "-"
+    return (f"{entry.get('value', '-'):>10} {entry.get('unit', ''):<38.38} "
+            f"sha {sha:<9}")
+
+
+def trajectory_summary(history: list[dict],
+                       candidates: list[dict] | None = None) -> str:
+    """One line per entry, grouped by workload, oldest first — the
+    at-a-glance view of where every workload's headline number has
+    been, and where a candidate would take it."""
+    by_wl: dict[str, list[dict]] = {}
+    for e in history:
+        by_wl.setdefault(workload_of(e), []).append(e)
+    lines = [f"bench trajectory ({len(history)} entries, "
+             f"{len(by_wl)} workloads):"]
+    for wl in sorted(by_wl):
+        lines.append(f"  {wl}:")
+        for e in by_wl[wl]:
+            lines.append(f"    {_headline(e)}  [{e.get('metric', '-')}]")
+        for c in candidates or []:
+            if workload_of(c) == wl:
+                lines.append(f"    {_headline(c)}  <- candidate")
+    for c in candidates or []:
+        if workload_of(c) not in by_wl:
+            lines.append(f"  {workload_of(c)} (new workload):")
+            lines.append(f"    {_headline(c)}  <- candidate")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_check",
+        description="Regression gate + trajectory summary over "
+                    "BENCH_serve.json",
+    )
+    ap.add_argument("--history", default="BENCH_serve.json",
+                    help="JSON-lines bench history (default "
+                         "BENCH_serve.json)")
+    ap.add_argument("--candidate", action="append", default=[],
+                    help="JSON-lines file of fresh entries to gate "
+                         "against the history (repeatable); without "
+                         "one, self-check each workload's newest "
+                         "committed entry against its trailing ones")
+    ap.add_argument("--rel-tolerance-pct", type=float, default=25.0,
+                    help="relative band for throughput/latency/bytes "
+                         "metrics (default 25)")
+    ap.add_argument("--pct-tolerance", type=float, default=10.0,
+                    help="absolute percentage-point band for *_pct "
+                         "overhead metrics (default 10)")
+    ap.add_argument("--rate-tolerance", type=float, default=0.05,
+                    help="absolute band for [0,1] rates — agreement/"
+                         "attainment/acceptance (default 0.05)")
+    args = ap.parse_args(argv)
+
+    history = load_entries(args.history)
+    if not history:
+        print(f"{args.history} is empty — nothing to gate", file=sys.stderr)
+        return 2
+    tol = dict(rel_tolerance_pct=args.rel_tolerance_pct,
+               pct_tolerance=args.pct_tolerance,
+               rate_tolerance=args.rate_tolerance)
+
+    by_wl: dict[str, list[dict]] = {}
+    for e in history:
+        by_wl.setdefault(workload_of(e), []).append(e)
+
+    regressions: list[str] = []
+    notes: list[str] = []
+    candidates: list[dict] = []
+    if args.candidate:
+        for path in args.candidate:
+            candidates.extend(load_entries(path))
+        for cand in candidates:
+            regs, nts = compare_entry(
+                cand, by_wl.get(workload_of(cand), []), **tol)
+            regressions.extend(regs)
+            notes.extend(nts)
+    else:
+        # self-check: newest committed entry per workload vs its tail
+        for wl, entries in sorted(by_wl.items()):
+            regs, nts = compare_entry(entries[-1], entries[:-1], **tol)
+            regressions.extend(regs)
+            notes.extend(nts)
+
+    print(trajectory_summary(history, candidates))
+    print()
+    for n in notes:
+        print(f"note: {n}")
+    if regressions:
+        print()
+        for r in regressions:
+            print(f"REGRESSION: {r}", file=sys.stderr)
+        print(f"\nbench_check: {len(regressions)} regression(s) — "
+              "failing the gate", file=sys.stderr)
+        return 2
+    print("\nbench_check: OK — no regressions against the trailing "
+          "history")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

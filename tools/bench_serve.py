@@ -32,9 +32,17 @@ agreement vs the exact pool on a briefly-trained model, ABBA-paired
 like-for-like Poisson overhead, and an equal-HBM capacity arm booking
 int8+scale slots at the f32 paged pool's resident byte budget).
 
+and with `--slo --append` for the SLO-observatory workload (per-request
+SLO classes — interactive/standard/batch — through an slo_targets
+engine, ABBA-paired against the plain engine: slo_overhead_pct,
+per-class attainment/burn, and goodput_tokens_per_s, the tokens
+delivered inside their latency targets).
+
 Every entry records the `kv_dtype` / `kv_pool_bytes` /
 `greedy_agreement_rate` triple (exact pools report their compute dtype
-and 1.0) so the trajectory stays comparable across quantized rounds.
+and 1.0) so the trajectory stays comparable across quantized rounds,
+plus (schema v2) a provenance stamp — git sha, timestamp, jax/jaxlib,
+host device — that `tools/bench_check.py` keys its regression gate on.
 
 Add `--trace` to any workload to run one extra flight-recorded arm: the
 entry gains `trace_overhead_pct` (tracing-on vs tracing-off req/s on the
