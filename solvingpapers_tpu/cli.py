@@ -495,6 +495,8 @@ def cmd_serve(args) -> int:
         max_waiting=args.max_waiting,
         trace=args.trace,
         slo_targets=slo_targets,
+        degrade=args.degrade,
+        fault_step_deadline_s=args.step_deadline,
     )
     engine = ServeEngine(model, params, serve_cfg,
                          extra_variables=extra or None, detokenize=decode)
@@ -537,14 +539,16 @@ def cmd_serve_bench(args) -> int:
         )
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
-            args.speculative, args.slo, args.kv_quant is not None)) > 1:
+            args.speculative, args.slo, args.chaos,
+            args.kv_quant is not None)) > 1:
         print("--shared-prefix, --sampling, --paged, --http, "
-              "--speculative, --slo and --kv-quant are separate "
-              "workloads; pick one per run",
+              "--speculative, --slo, --chaos and --kv-quant are "
+              "separate workloads; pick one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
         bench_provenance,
+        run_chaos_bench,
         run_http_bench,
         run_paged_bench,
         run_prefix_bench,
@@ -564,6 +568,15 @@ def cmd_serve_bench(args) -> int:
     n_requests = args.requests
     if n_requests is None:
         n_requests = 48 if args.shared_prefix else 32
+    # shared flags with per-workload defaults (None sentinel, so an
+    # EXPLICIT value always wins — even one that matches another
+    # workload's default)
+    n_slots = args.slots
+    if n_slots is None:
+        n_slots = 4 if args.chaos else 8
+    mean_ia = args.mean_interarrival
+    if mean_ia is None:
+        mean_ia = 0.15 if args.chaos else 0.001
     prompt_lens = args.prompt_lens
     if prompt_lens is None:
         # --speculative defaults to gpt_tiny_long (256 positions):
@@ -582,11 +595,11 @@ def cmd_serve_bench(args) -> int:
         result = run_quant_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             page_size=args.page_size,
             kv_quant_block=args.kv_quant_block,
             train_steps=args.quant_train_steps,
@@ -598,15 +611,29 @@ def cmd_serve_bench(args) -> int:
         result = run_spec_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=args.max_new_tokens or 160,
             decode_block=args.decode_block or 8,
             spec_k=args.spec_k,
             spec_rounds=args.spec_rounds,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             train_steps=args.spec_train_steps,
             seed=args.seed,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.chaos:
+        result = run_chaos_bench(
+            config=args.config,
+            n_requests=args.requests or 48,
+            n_slots=n_slots,
+            max_new=args.max_new_tokens or 48,
+            decode_block=args.decode_block or 8,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=mean_ia,
+            seed=args.seed,
+            stall_s=args.chaos_stall,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
         )
@@ -614,11 +641,11 @@ def cmd_serve_bench(args) -> int:
         result = run_slo_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             seed=args.seed,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
@@ -627,22 +654,22 @@ def cmd_serve_bench(args) -> int:
         result = run_http_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             seed=args.seed,
         )
     elif args.paged:
         result = run_paged_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             n_prefixes=args.n_prefixes,
             prefix_requests=args.prefix_requests,
             suffix_len=args.suffix_len,
@@ -655,11 +682,11 @@ def cmd_serve_bench(args) -> int:
         result = run_sampling_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             seed=args.seed,
             **trace_kwargs,
         )
@@ -667,13 +694,13 @@ def cmd_serve_bench(args) -> int:
         result = run_prefix_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             n_prefixes=args.n_prefixes,
             prefix_len=args.prefix_len,
             suffix_len=args.suffix_len,
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             prefix_page=args.prefix_page,
             seed=args.seed,
             **trace_kwargs,
@@ -682,11 +709,11 @@ def cmd_serve_bench(args) -> int:
         result = run_serve_bench(
             config=args.config,
             n_requests=n_requests,
-            n_slots=args.slots,
+            n_slots=n_slots,
             max_new=max_new,
             decode_block=decode_block,
             prompt_lens=tuple(prompt_lens),
-            mean_interarrival_s=args.mean_interarrival,
+            mean_interarrival_s=mean_ia,
             seed=args.seed,
             skip_sequential=args.skip_sequential,
             **trace_kwargs,
@@ -925,7 +952,9 @@ def main(argv=None) -> int:
     _add_common(p_serve)
     p_serve.add_argument("--requests", type=int, default=None,
                          help="default 32 (48 with --shared-prefix)")
-    p_serve.add_argument("--slots", type=int, default=8)
+    p_serve.add_argument("--slots", type=int, default=None,
+                         help="default 8 (4 with --chaos, whose ladder "
+                              "arm needs deliberate overload)")
     p_serve.add_argument("--max-new-tokens", type=int, default=None,
                          help="default 64 (4 with --shared-prefix, whose "
                               "TTFT story is prefill-bound)")
@@ -937,8 +966,12 @@ def main(argv=None) -> int:
                               "compiles in both arms); default "
                               "16 32 48 64 (24 32 40 48 with "
                               "--speculative)")
-    p_serve.add_argument("--mean-interarrival", type=float, default=0.001,
-                         help="Poisson arrival mean gap in seconds")
+    p_serve.add_argument("--mean-interarrival", type=float, default=None,
+                         help="Poisson arrival mean gap in seconds; "
+                              "default 0.001 (0.15 with --chaos — "
+                              "admissions must keep arriving while the "
+                              "ladder is up for shedding to be "
+                              "observable)")
     p_serve.add_argument("--seed", type=int, default=0)
     p_serve.add_argument("--skip-sequential", action="store_true",
                          help="only run the engine arm")
@@ -985,6 +1018,23 @@ def main(argv=None) -> int:
                               "budget), per-class attainment, burn "
                               "rates and goodput_tokens_per_s "
                               "(serve/bench.py run_slo_bench)")
+    p_serve.add_argument("--chaos", action="store_true",
+                         help="fault-tolerance soak instead: one seeded "
+                              "fault schedule (NaN/Inf slot poisons, "
+                              "synthetic XlaRuntimeError + OOM, a step "
+                              "stall) over the Poisson trace through a "
+                              "fault-free reference, a ladder-off chaos "
+                              "arm (streams_survived, survivor token-"
+                              "exactness, fault_recovery_s, zero-leak "
+                              "drain) and a ladder-on arm (goodput with "
+                              "degradation on vs off), plus the ABBA-"
+                              "paired armed-but-quiet fault_overhead_pct "
+                              "(serve/bench.py run_chaos_bench)")
+    p_serve.add_argument("--chaos-stall", type=float, default=0.75,
+                         help="[--chaos] injected step-stall seconds; "
+                              "the watchdog deadline is set BELOW it "
+                              "(max(0.25, 0.75x)) so the stall "
+                              "deterministically trips the fire path")
     p_serve.add_argument("--kv-quant", default=None, choices=["int8"],
                          help="quantized-KV workload instead: int8 cache "
                               "storage vs exact on a briefly-trained "
@@ -1130,6 +1180,19 @@ def main(argv=None) -> int:
                             "one via the 'slo' body field, default "
                             "standard) — per-class attainment, burn "
                             "rate and goodput ride /metrics + /statusz")
+    p_srv.add_argument("--degrade", action="store_true",
+                       help="arm the degradation ladder "
+                            "(serve/faults.py): under page exhaustion, "
+                            "HBM-projection breach or SLO burn the "
+                            "engine sheds prefix-cache leaves, holds "
+                            "speculation, then load-sheds admissions "
+                            "by class (batch first) with a jittered "
+                            "Retry-After; pair with --slo for the "
+                            "burn signal and class-aware shedding")
+    p_srv.add_argument("--step-deadline", type=float, default=None,
+                       help="watchdog: flag engine steps exceeding this "
+                            "absolute wall deadline in seconds "
+                            "(serve/watchdog_stalls + anomaly dump)")
     p_srv.add_argument("--trace", action="store_true",
                        help="flight recorder on (ServeConfig.trace): "
                             "HTTP accept/parse/handoff/drain spans join "

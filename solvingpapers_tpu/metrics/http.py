@@ -4,8 +4,11 @@ A running engine (serve or train) is otherwise a black box unless a
 tracer was attached before launch; this module gives it the vLLM-style
 first-line inspection surface with zero dependencies:
 
-    /healthz   200 "ok" while the server thread is alive (the probe a
-               load balancer or CI smoke polls)
+    /healthz   200 "ok" while the owner is healthy (the probe a load
+               balancer or CI smoke polls); with a `health_fn` bound,
+               200 "degraded" on a degradation-ladder rung and 503
+               "unhealthy" while the engine drains after persistent
+               failures
     /metrics   the current metric snapshot in Prometheus text exposition
                format — the exact same rendering `PrometheusTextWriter`
                writes to textfiles (`PrometheusTextWriter.render`), so
@@ -35,6 +38,20 @@ from typing import Callable
 from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
 
 
+def healthz_response(state: str) -> tuple[int, str]:
+    """ONE mapping from the engine health state machine to the /healthz
+    wire contract, shared by this status-port server and the OpenAI
+    front door (serve/api.py) so the two endpoints can never diverge:
+    ``unhealthy`` -> 503 (a load balancer must drop the replica),
+    ``degraded`` -> 200 "degraded" (keep it — still serving, just
+    shedding load), anything else -> 200 "ok"."""
+    if state == "unhealthy":
+        return 503, "unhealthy\n"
+    if state == "degraded":
+        return 200, "degraded\n"
+    return 200, "ok\n"
+
+
 class StatusServer:
     """Serve /healthz, /metrics, /statusz from live provider callables.
 
@@ -51,10 +68,17 @@ class StatusServer:
         host: str = "127.0.0.1",
         port: int = 0,
         prefix: str = "",
+        health_fn: Callable[[], str] | None = None,
     ):
         self.statusz_fn = statusz_fn
         self.metrics_fn = metrics_fn
         self.prefix = prefix
+        # health_fn() -> "healthy" | "degraded" | "unhealthy": /healthz
+        # answers 503 for "unhealthy" (a draining engine must fall out
+        # of its load balancer), 200 otherwise — "degraded" keeps the
+        # replica in rotation but names its state in the body. None
+        # keeps the historical always-200 "ok".
+        self.health_fn = health_fn
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -73,7 +97,10 @@ class StatusServer:
                 path = self.path.split("?", 1)[0]
                 try:
                     if path == "/healthz":
-                        self._send(200, "ok\n", "text/plain")
+                        state = ("healthy" if server.health_fn is None
+                                 else server.health_fn())
+                        code, body = healthz_response(state)
+                        self._send(code, body, "text/plain")
                     elif path == "/metrics":
                         step, metrics = server.metrics_fn()
                         self._send(

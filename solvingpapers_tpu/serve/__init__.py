@@ -4,10 +4,18 @@ refcounted page sharing on the paged pool) + per-request sampling
 (SamplingParams / fused_sample) + speculative decoding (serve/spec.py:
 n-gram/MTP draft-and-verify with lossless rejection sampling) +
 grammar-constrained JSON decoding (JsonStepper) + OpenAI-compatible
-HTTP front door (ApiServer) + latency metrics."""
+HTTP front door (ApiServer) + latency metrics + fault tolerance
+(serve/faults.py: seeded fault injection, supervised step loop with
+per-request blast-radius isolation, SLO-driven degradation ladder)."""
 
 from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.faults import (
+    DegradationLadder,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
 from solvingpapers_tpu.serve.grammar import JsonStepper
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
@@ -24,7 +32,11 @@ from solvingpapers_tpu.serve.spec import SpecController
 
 __all__ = [
     "ApiServer",
+    "DegradationLadder",
     "EngineLoop",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
     "JsonStepper",
     "serve_api",
     "ServeConfig",

@@ -57,6 +57,7 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import re
 import select
 import socket
@@ -68,6 +69,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from solvingpapers_tpu.metrics.http import healthz_response
 from solvingpapers_tpu.metrics.writer import PrometheusTextWriter
 from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve import openai as oai
@@ -195,8 +197,12 @@ class EngineLoop:
 
     def close(self, drain_timeout_s: float = 0.0) -> None:
         """Stop the loop; with a drain timeout, let in-flight work
-        finish first, then cancel whatever remains so the loop can
-        exit having returned every lane."""
+        finish first, then cancel whatever remains so the loop can exit
+        having returned every lane. BOUNDED end to end: the
+        cancel-resolution drain is also wall-capped (a wedged or
+        fault-stalled program must not turn SIGTERM into a hang), and
+        anything still in flight past the cap is force-finished
+        host-side via `engine.force_drain` — no further device work."""
         if not self._thread.is_alive():
             return
         deadline = time.monotonic() + drain_timeout_s
@@ -211,12 +217,20 @@ class EngineLoop:
                     self.engine.cancel(r)
             for r in list(self.engine.scheduler.queue):
                 self.engine.cancel(r)
-            # one bounded drain pass finishes the cancelled streams;
-            # cancels resolve at the next block boundary
+            # one bounded drain pass finishes the cancelled streams
+            # (cancels resolve at the next block boundary); capped on
+            # BOTH steps and wall clock — a step stalled past the cap
+            # falls through to the host-side force drain below
             steps = 0
-            while self.engine.has_work() and steps < 64:
+            cancel_deadline = time.monotonic() + min(
+                5.0, max(1.0, drain_timeout_s)
+            )
+            while (self.engine.has_work() and steps < 64
+                   and time.monotonic() < cancel_deadline):
                 self.engine.step()
                 steps += 1
+            if self.engine.has_work():
+                self.engine.force_drain("cancelled")
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=5)
@@ -279,6 +293,14 @@ class ApiServer:
             "rejected": 0, "client_errors": 0,
         }
         self._count_lock = threading.Lock()
+        # jittered Retry-After source: a fixed hint synchronizes every
+        # rejected client into a retry herd that lands back as one
+        # burst — each 503 draws its own delay instead (seeded for
+        # reproducible tests; the draw ORDER across racing handler
+        # threads is inherently nondeterministic, which is fine — the
+        # point is that the hints differ, not which client gets which)
+        self._retry_rng = random.Random(0xFA17)
+        self._retry_lock = threading.Lock()
         self._timelines: OrderedDict[str, dict] = OrderedDict()
         self._timeline_lock = threading.Lock()
         vocab = getattr(getattr(engine.model, "cfg", None), "vocab_size",
@@ -379,12 +401,23 @@ class ApiServer:
         self._send(h, code, json.dumps(obj) + "\n", "application/json",
                    headers)
 
+    def _retry_headers(self) -> dict:
+        """Backpressure headers for every 503: a JITTERED Retry-After
+        (integer seconds; the base grows with the degradation rung, so
+        a deeper squeeze pushes retries further out) plus the current
+        rung itself — client observability into WHY it was shed."""
+        rung = getattr(self.engine, "degradation_rung", 0)
+        with self._retry_lock:
+            retry = self._retry_rng.randint(1 + rung, 4 + rung)
+        return {"Retry-After": str(retry),
+                "X-Degradation-Rung": str(rung)}
+
     def _send_error(self, h, err: ApiError,
                     headers: dict | None = None) -> None:
         self._bump("rejected" if err.status == 503 else "client_errors")
         headers = dict(headers or {})
         if err.status == 503:
-            headers["Retry-After"] = "1"
+            headers.update(self._retry_headers())
         try:
             self._send_json(h, err.status, err.body(), headers)
         except (BrokenPipeError, ConnectionResetError):
@@ -396,7 +429,16 @@ class ApiServer:
         path = h.path.split("?", 1)[0]
         try:
             if path == "/healthz":
-                self._send(h, 200, "ok\n", "text/plain")
+                # the engine's health state machine through the shared
+                # wire mapping (metrics/http.py healthz_response — the
+                # status-port endpoint uses the same one, so the two
+                # /healthz surfaces can never diverge); a dead engine
+                # loop is unhealthy regardless of what the engine says
+                state = getattr(self.engine, "health", "healthy")
+                if self.loop.error is not None:
+                    state = "unhealthy"
+                code, body = healthz_response(state)
+                self._send(h, code, body, "text/plain")
             elif path == "/metrics":
                 with self.loop.lock:
                     # prom_snapshot: latency histograms render as native
@@ -655,10 +697,29 @@ class ApiServer:
         if req.state == "rejected":
             self._bump("rejected")
             rec["t_done"] = smetrics.now()
-            self._send_json(h, 503, ApiError(
-                "waiting queue is full — retry shortly", status=503,
-                err_type="server_error", code="overloaded",
-            ).body(), {"Retry-After": "1", "X-Request-Id": trace_id})
+            why = req.reject_reason or ""
+            if why == "unhealthy":
+                err = ApiError(
+                    "engine is unhealthy and draining — retry shortly",
+                    status=503, err_type="server_error",
+                    code="engine_unhealthy",
+                )
+            elif why.startswith("shed:"):
+                err = ApiError(
+                    f"admissions for SLO class {why[5:]!r} are being "
+                    f"load-shed (degradation rung "
+                    f"{getattr(self.engine, 'degradation_rung', 0)}) — "
+                    "retry after the hinted delay",
+                    status=503, err_type="server_error", code="overloaded",
+                )
+            else:
+                err = ApiError(
+                    "waiting queue is full — retry shortly", status=503,
+                    err_type="server_error", code="overloaded",
+                )
+            self._send_json(h, 503, err.body(), {
+                **self._retry_headers(), "X-Request-Id": trace_id,
+            })
             return
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
@@ -719,6 +780,28 @@ class ApiServer:
         h.end_headers()
 
         def event(obj) -> None:
+            # fault-plane site: the SSE write boundary (socket_reset
+            # specs break the connection here, exercising the
+            # disconnect-cancel path without a real flaky client).
+            # FaultPlan.poke serializes internally — handler threads
+            # and the engine loop share one plan across lock domains.
+            faults = getattr(self.engine, "_faults", None)
+            if faults is not None:
+                for spec in faults.poke("sse_write"):
+                    self.engine.metrics.record_fault_injected()
+                    tr = self.engine.trace
+                    if tr is not None:
+                        # same instant the engine's _poke_site stamps,
+                        # so counters and timeline agree on injections
+                        tr.instant("fault_injected", "engine", "http",
+                                   site="sse_write", kind=spec.kind,
+                                   slot=spec.slot)
+                    if spec.kind == "socket_reset":
+                        raise ConnectionResetError(
+                            "injected socket reset at sse_write"
+                        )
+                    if spec.kind == "stall":
+                        time.sleep(spec.stall_s)
             h.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
             h.wfile.flush()
 
@@ -767,6 +850,16 @@ class ApiServer:
                     emitted = upto
                     events += 1
                 if finished:
+                    if req.finish_reason == "error":
+                        # SSE error protocol: a quarantined / engine-
+                        # failed stream ends with a STRUCTURED error
+                        # event before its terminal chunk — never a
+                        # silently truncated stream
+                        event(oai.error_event(
+                            "the request failed in the engine "
+                            "(finish_reason error) — partial output "
+                            "above is complete as delivered",
+                        ))
                     usage = oai.usage_block(req)
                     if chat:
                         event(oai.chat_chunk(rid, self.model_name, None,
@@ -787,6 +880,29 @@ class ApiServer:
             if not req.done:
                 self.loop.cancel(req)
             self._mark_disconnect(req, rec)
+        except Exception as e:  # noqa: BLE001 — server-side failure
+            # AFTER the 200 + SSE headers went out: the status line is
+            # spent, so emit the structured error event + a terminal
+            # chunk with finish_reason "error" + [DONE] (best-effort —
+            # the socket may be the thing that broke), then release the
+            # engine side
+            if not req.done:
+                self.loop.cancel(req)
+            try:
+                payload = (b"data: " + json.dumps(oai.error_event(
+                    f"{type(e).__name__}: {e}")).encode() + b"\n\n")
+                term = (oai.chat_chunk(rid, self.model_name, None,
+                                       reason="error")
+                        if chat else
+                        oai.completion_chunk(rid, self.model_name, "",
+                                             reason="error"))
+                payload += (b"data: " + json.dumps(term).encode()
+                            + b"\n\ndata: [DONE]\n\n")
+                h.wfile.write(payload)
+                h.wfile.flush()
+            except OSError:
+                pass
+            self._mark_done(req, rec, events=events + 2)
         finally:
             self._bump_active(-1)
 
@@ -809,6 +925,15 @@ class ApiServer:
             else:
                 text = "".join(str(t) + " " for t in req.tokens)
             headers = {"X-Request-Id": rec["trace_id"]}
+            if req.finish_reason == "error":
+                # no bytes have gone out on a blocking response: the
+                # honest status is a 500 with the structured envelope,
+                # not a 200 wrapping a failed stream
+                self._send_json(h, 500, oai.error_event(
+                    "the request failed in the engine "
+                    "(finish_reason error)"), headers)
+                self._mark_done(req, rec, events=1)
+                return
             if chat:
                 self._send_json(h, 200, oai.chat_response(
                     rid, self.model_name, req, text), headers)

@@ -1922,3 +1922,274 @@ def run_slo_bench(
             **probe_fields,
         },
     }
+
+
+# ----------------------------------------------------------------- chaos
+
+
+def chaos_fault_plan(n_slots: int, seed: int = 0,
+                     stall_s: float = 0.05) -> tuple:
+    """The seeded chaos schedule `run_chaos_bench` drives: two slot
+    poisons (NaN + Inf — the quarantine path, both finite-guard codes),
+    one synthetic XlaRuntimeError and one prefill OOM (the
+    rebuild-and-recompute path), and one step stall (the watchdog).
+    Deterministic given (n_slots, seed): the same schedule replays
+    bit-identically across the ladder-on and ladder-off arms, which is
+    what makes their goodput comparison a controlled experiment."""
+    rng = np.random.default_rng(seed)
+    slots = rng.permutation(n_slots)
+    v = sorted(int(x) for x in rng.integers(8, 48, size=4))
+    return (
+        dict(site="prefill", kind="oom", visit=int(rng.integers(3, 8))),
+        dict(site="decode", kind="nan", visit=v[0], slot=int(slots[0])),
+        dict(site="decode", kind="inf", visit=v[1],
+             slot=int(slots[1 % len(slots)])),
+        dict(site="decode", kind="xla_error", visit=v[2]),
+        dict(site="decode", kind="stall", visit=v[3], stall_s=stall_s),
+    )
+
+
+def _run_chaos_arm(model, params, extra, requests, serve_cfg, max_new,
+                   params_for=None):
+    """`_run_engine_arm` that tolerates rejects: under the degradation
+    ladder (or an unhealthy window) submissions may bounce — those are
+    collected as `shed`, not crashed on. Returns (engine, accepted
+    handles BY REQUEST INDEX (None = shed), shed count, makespan)."""
+    eng = ServeEngine(model, params, serve_cfg, extra_variables=extra)
+    pending = sorted(enumerate(requests), key=lambda r: r[1][0])
+    handles: list = [None] * len(requests)
+    shed = 0
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or eng.has_work():
+        elapsed = time.monotonic() - t0
+        while i < len(pending) and pending[i][1][0] <= elapsed:
+            ridx, (_, prompt) = pending[i]
+            h = eng.submit(
+                prompt, max_new_tokens=max_new,
+                params=params_for(ridx) if params_for is not None else None,
+            )
+            if h.state == "rejected":
+                shed += 1
+            else:
+                handles[ridx] = h
+            i += 1
+        if eng.has_work():
+            eng.step()
+        elif i < len(pending):
+            time.sleep(max(0.0, pending[i][1][0]
+                           - (time.monotonic() - t0)))
+    makespan = (time.monotonic() - t0) - pending[0][1][0]
+    live = [h for h in handles if h is not None]
+    assert all(h.done for h in live), "chaos arm drained unfinished"
+    return eng, handles, shed, makespan
+
+
+def _zero_leak_fields(eng) -> dict:
+    """The post-drain leak invariant as bench-entry facts (the test
+    suite's `assert_no_leaks` as data): slot free-mask/free-list
+    consistency, paged free-pages == budget with the refcount sum back
+    at the trash page's 1 (the prefix tree is fully evicted first —
+    its references are the one legitimate post-drain holder), and the
+    exact-lane free list intact."""
+    pool = eng.pool
+    ok = (pool.n_active == 0 and bool(pool._free_mask.all())
+          and sorted(pool._free) == list(range(pool.n_slots)))
+    out = {"slots_clean": ok}
+    if eng.prefix_cache is not None:
+        while eng.prefix_cache.evict_one():
+            pass
+    if hasattr(pool, "refcount"):
+        out["pages_free"] = pool.pages_free
+        out["page_budget"] = pool.page_budget
+        out["refcount_sum"] = int(pool.refcount.sum())
+        ok = (ok and pool.pages_free == pool.page_budget
+              and out["refcount_sum"] == 1)
+    if getattr(pool, "exact_lanes", 0):
+        ok = ok and sorted(eng._exact_free) == list(
+            range(1, pool.exact_lanes + 1))
+    out["zero_leak"] = ok
+    return out
+
+
+def run_chaos_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 48,
+    n_slots: int = 4,
+    max_new: int = 48,
+    decode_block: int = 8,
+    prompt_lens=(16, 32, 48, 64),
+    # arrivals SPREAD (vs the other workloads' burst): load-shedding is
+    # only observable while admissions keep arriving with the ladder up
+    mean_interarrival_s: float = 0.15,
+    seed: int = 0,
+    reps: int = 4,
+    # long enough that the injected stall ALONE exceeds the watchdog
+    # deadline below — the soak must actually exercise the fire path
+    stall_s: float = 0.75,
+    slo_targets: dict | None = None,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """`cli serve-bench --chaos`: the fault-tolerance soak.
+
+    One SEEDED fault schedule (`chaos_fault_plan`: NaN + Inf slot
+    poisons, a synthetic XlaRuntimeError, a prefill OOM, a step stall)
+    replays over the Poisson trace through three engines:
+
+    * reference — fault-free, SLO-tracked: the token-exactness oracle.
+    * chaos, ladder OFF — every request admitted; measures the blast
+      radius: `streams_survived` (finished non-"error"),
+      `survivors_token_exact` (every surviving stream byte-identical
+      to the reference — quarantine contained the poison, rebuilds
+      recomputed exactly), `fault_recovery_s` (first failure -> first
+      clean step), and the post-drain `zero_leak` invariant.
+    * chaos, ladder ON — same schedule plus the degradation ladder
+      over DEFAULT_SLO_TARGETS under deliberate overload: burn-rate
+      pressure climbs the rungs, admissions shed by class (batch
+      first), and `goodput_ladder_on` vs `goodput_ladder_off` records
+      whether shedding protected more SLO-attained tokens than it cost
+      — the number the ladder exists for (>= 1.0 ratio is the claim).
+
+    `fault_overhead_pct` is the ABBA-paired cost of an ARMED-BUT-QUIET
+    fault plane (a schedule that never fires) vs `fault_plan=None` —
+    the None-pattern budget (<= 2%, the tracer's). The always-traced
+    finite-logits guard rides BOTH arms (it has no off switch by
+    design), so the number isolates the plan hooks themselves.
+    """
+    from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
+
+    targets = slo_targets or DEFAULT_SLO_TARGETS
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    max_len = -(-(max_prompt + max_new) // 16) * 16  # page multiple
+    plan = chaos_fault_plan(n_slots, seed=seed, stall_s=stall_s)
+    base_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_len,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        paged=True,
+        page_size=16,
+        seed=seed,
+    )
+    ref_cfg = dataclasses.replace(base_cfg, slo_targets=targets)
+    # deadline BELOW the injected stall (floored well above a normal
+    # tiny-model step): the stall spec must trip the watchdog, not
+    # sneak under its own deadline
+    chaos_cfg = dataclasses.replace(
+        ref_cfg, fault_plan=plan,
+        fault_step_deadline_s=max(0.25, 0.75 * stall_s),
+    )
+    ladder_cfg = dataclasses.replace(chaos_cfg, degrade=True)
+
+    def params_for(i: int) -> SamplingParams:
+        return SamplingParams(slo=SLO_CLASS_CYCLE[i % len(SLO_CLASS_CYCLE)])
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, ref_cfg, max_new,
+        status_port=status_port, params_for=params_for,
+    )
+    # reference arm: the fault-free token oracle (also the jit warmup)
+    ref_eng, ref_handles, _, _ = _run_chaos_arm(
+        model, params, extra, requests, ref_cfg, max_new,
+        params_for=params_for,
+    )
+
+    # chaos, ladder OFF: blast radius + recovery + leaks
+    off_eng, off_handles, off_shed, _ = _run_chaos_arm(
+        model, params, extra, requests, chaos_cfg, max_new,
+        params_for=params_for,
+    )
+    off_snap = off_eng.metrics.snapshot()
+    survivors = [(i, h) for i, h in enumerate(off_handles)
+                 if h is not None and h.finish_reason != "error"]
+    errored = sum(1 for h in off_handles
+                  if h is not None and h.finish_reason == "error")
+    exact = all(h.tokens == ref_handles[i].tokens for i, h in survivors)
+    leak_fields = _zero_leak_fields(off_eng)
+    goodput_off = off_snap.get("serve/goodput_tokens_per_s", 0.0)
+
+    # chaos, ladder ON: same schedule + degradation under overload
+    on_eng, on_handles, on_shed, _ = _run_chaos_arm(
+        model, params, extra, requests, ladder_cfg, max_new,
+        params_for=params_for,
+    )
+    on_snap = on_eng.metrics.snapshot()
+    goodput_on = on_snap.get("serve/goodput_tokens_per_s", 0.0)
+    ladder_stats = on_eng.statusz()["health"].get("ladder", {})
+    on_leaks = _zero_leak_fields(on_eng)
+
+    # armed-but-quiet plan vs None: the hook overhead (ABBA-paired)
+    quiet = (dict(site="decode", kind="stall", visit=1_000_000_000,
+                  stall_s=0.001),)
+    quiet_cfg = dataclasses.replace(base_cfg, fault_plan=quiet)
+    mk_on, mk_off, _ = _paired_makespans(
+        model, params, extra, requests, quiet_cfg, base_cfg, max_new,
+        reps=reps,
+    )
+    armed_rps = n_requests / (sum(mk_on) / len(mk_on))
+    plain_rps = n_requests / (sum(mk_off) / len(mk_off))
+
+    if status_hold_s > 0 and probe_eng is not None:
+        time.sleep(status_hold_s)
+    if probe_eng is not None:
+        probe_eng.close()
+    admitted = sum(1 for h in off_handles if h is not None)
+    return {
+        "metric": "serve_chaos_streams_survived",
+        "value": len(survivors),
+        "unit": (f"streams finished non-error of {admitted} admitted "
+                 "under the seeded fault schedule (ladder-off arm)"),
+        "vs_baseline": round(len(survivors) / admitted, 4) if admitted
+        else 0.0,
+        "detail": {
+            "config": config,
+            "workload": "chaos",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "reps": reps,
+            "fault_plan": [dict(s) for s in plan],
+            "streams_survived": len(survivors),
+            "streams_admitted": admitted,
+            "streams_quarantined": errored,
+            "streams_shed_off_arm": off_shed,
+            "survivors_token_exact": exact,
+            "faults_injected": int(
+                off_snap.get("serve/fault_injected", 0)),
+            "fault_retries": int(off_snap.get("serve/fault_retries", 0)),
+            "fault_recovery_s": round(
+                off_snap.get("serve/fault_recovery_s", 0.0), 4),
+            "watchdog_stalls": int(
+                off_snap.get("serve/watchdog_stalls", 0)),
+            **leak_fields,
+            "ladder_zero_leak": on_leaks["zero_leak"],
+            "goodput_ladder_on": round(goodput_on, 2),
+            "goodput_ladder_off": round(goodput_off, 2),
+            "goodput_ladder_ratio": round(goodput_on / goodput_off, 4)
+            if goodput_off else None,
+            "ladder_max_shed": on_shed,
+            "ladder_rung_final": ladder_stats.get("rung"),
+            "ladder_transitions": ladder_stats.get("transitions"),
+            "fault_overhead_pct": round(
+                (1.0 - armed_rps / plain_rps) * 100.0, 2),
+            "armed_requests_per_sec": round(armed_rps, 2),
+            "plain_requests_per_sec": round(plain_rps, 2),
+            **_kv_entry_fields(ref_eng),
+            **probe_fields,
+        },
+    }

@@ -103,6 +103,23 @@ cost_analysis flops/bytes) and flags recompile storms, while an HBM
 ledger accounts per-pool live bytes and projected peak vs device
 capacity; `ServeConfig.status_port` serves the live /healthz /metrics
 /statusz endpoint (`metrics/http.py`).
+
+Fault tolerance (`serve/faults.py`; always on — real NaN forwards and
+device runtime errors need no opt-in): every `step()` runs inside a
+supervised fault boundary. A traced per-slot finite-logits guard pins
+NaN/Inf forwards to their slot, which is QUARANTINED — block output
+discarded, lane/pages scrubbed to zero before release (0 * NaN is NaN;
+the stale-lane contract only covers finite values), request finished
+``"error"``, every other stream byte-identical. Systemic failures
+(XlaRuntimeError / OOM / anything escaping a program call) cost a
+bounded pool-rebuild retry — active streams requeue and resume by
+recompute, token-exactly — then drain the engine to a 503-reporting
+`unhealthy` state until a backed-off recovery. `ServeConfig.fault_plan`
+arms the deterministic seeded fault-injection plane (None-pattern off),
+`fault_step_deadline_s` the stalled-step watchdog, and
+`ServeConfig.degrade` the SLO/ledger-driven degradation ladder (shed
+prefix leaves -> hold speculation -> load-shed admissions by class with
+jittered Retry-After; hysteresis both ways).
 """
 
 from __future__ import annotations
@@ -110,6 +127,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -121,8 +139,17 @@ import numpy as np
 # first status probe happened to lazily import it
 from solvingpapers_tpu import buildinfo
 from solvingpapers_tpu.serve import metrics as smetrics
+from solvingpapers_tpu.serve.faults import (
+    FAULT_INF,
+    FAULT_NAN,
+    DegradationLadder,
+    FaultPlan,
+    InjectedFault,
+    classify_failure,
+)
 from solvingpapers_tpu.serve.grammar import encode_allow
 from solvingpapers_tpu.serve.kv_pool import (
+    TRASH_PAGE,
     KVSlotPool,
     PagedKVPool,
     QuantStore,
@@ -144,6 +171,8 @@ from solvingpapers_tpu.serve.kv_pool import (
     scatter_lane_pages,
     scatter_window_pages,
     scatter_written_pages,
+    scrub_lane_program,
+    scrub_pages_program,
     store_lane,
     strip_time,
 )
@@ -161,6 +190,7 @@ from solvingpapers_tpu.serve.sampling import (
 from solvingpapers_tpu.serve.scheduler import (
     ACTIVE,
     FINISHED,
+    REJECTED,
     WAITING,
     FIFOScheduler,
     Request,
@@ -360,6 +390,60 @@ class ServeConfig:
     slo_targets: dict | None = None
     # finishes in the sliding window the burn rate is computed over
     slo_burn_window: int = 256
+    # Fault tolerance (serve/faults.py; see that module's docstring for
+    # the failure taxonomy). The supervised step loop is ALWAYS on —
+    # every step() runs inside a fault boundary that quarantines
+    # NaN/Inf-poisoned slots (finish_reason "error", leak-free reclaim,
+    # other streams byte-identical) and answers systemic device
+    # failures with bounded pool-rebuild retries, then a draining
+    # `unhealthy` state /healthz reports as 503 until recovery. The
+    # knobs below tune the boundary; `fault_plan` arms the DETERMINISTIC
+    # seeded fault-injection plane (None-pattern off, like the tracer):
+    #   fault_plan       sequence of serve.faults.FaultSpec (or dicts):
+    #                    named sites (prefill/decode/scatter/
+    #                    prefix_splice/sse_write) x kinds (nan/inf
+    #                    logits poison, synthetic xla_error/oom, stall,
+    #                    socket_reset), each firing at an exact visit of
+    #                    its site — so every recovery path is testable
+    #                    on CPU, bit-reproducibly. None = off: the hot
+    #                    path pays one `is not None` branch per site.
+    #   fault_max_retries  consecutive pool-rebuild retries a systemic
+    #                    failure may consume before the engine drains to
+    #                    `unhealthy` (in-flight streams finish "error")
+    #   fault_retry_backoff_s  base sleep between rebuild retries
+    #                    (doubles per consecutive failure)
+    #   fault_recover_backoff_s  how long an unhealthy engine waits
+    #                    before accepting work again (doubles across
+    #                    repeated unhealthy episodes until a clean step)
+    #   fault_step_deadline_s  watchdog: a step exceeding this absolute
+    #                    wall deadline is flagged (serve/watchdog_stalls
+    #                    counter, trace instant, anomaly dump when the
+    #                    dumper is armed). None = off.
+    fault_plan: object | None = None
+    fault_max_retries: int = 2
+    fault_retry_backoff_s: float = 0.05
+    fault_recover_backoff_s: float = 0.25
+    fault_step_deadline_s: float | None = None
+    # Degradation ladder (serve/faults.py DegradationLadder, opt-in):
+    # under sustained pressure — paged-pool page exhaustion
+    # (pages_free below degrade_free_page_frac of the budget),
+    # HBM-projection breach (the xla_obs ledger's projected peak within
+    # degrade_headroom_frac of capacity), or SLO error-budget burn
+    # (any class's burn rate above degrade_burn_threshold) — the
+    # engine climbs one rung at a time: shed prefix-cache leaves ->
+    # hold speculation -> load-shed admissions by SLO class (batch
+    # first, then standard; shed submissions reject with a jittered
+    # Retry-After through the front door). Escalation needs
+    # degrade_up_steps consecutive pressured steps, de-escalation
+    # degrade_down_steps clear ones (hysteresis — the ladder cannot
+    # flap), and recovery re-arms in reverse order. Each rung is the
+    # serve/degradation_rung gauge; each transition a trace instant.
+    degrade: bool = False
+    degrade_up_steps: int = 2
+    degrade_down_steps: int = 16
+    degrade_free_page_frac: float = 0.125
+    degrade_burn_threshold: float = 1.5
+    degrade_headroom_frac: float = 0.05
     prefill_chunk: int | None = None
     max_waiting: int = 256
     decode_priority: bool = True
@@ -448,6 +532,30 @@ class ServeConfig:
 _UNSET = object()
 
 
+def _inject_fault(logits, fault):
+    """Apply the fault-injection plane's logits poison (traced): `fault`
+    is the i32 code riding the packed control transfer — 0 clean,
+    FAULT_NAN / FAULT_INF poison the slot's whole logits row. An
+    all-zero fault operand selects `logits` bitwise unchanged, so the
+    disabled plane is a numeric no-op (fault-free streams stay
+    token-exact) and costs no extra compiled program — the fault row is
+    always part of the signature."""
+    f = jnp.asarray(fault)
+    mask = (f > 0).reshape(f.shape + (1,) * (logits.ndim - f.ndim))
+    bad = jnp.where(f == FAULT_NAN, jnp.nan, jnp.inf).astype(logits.dtype)
+    bad = bad.reshape(mask.shape)
+    return jnp.where(mask, bad, logits)
+
+
+def _finite_ok(logits):
+    """Per-slot finite-logits guard (traced): True iff every logit the
+    sampler would draw from is finite. One cheap reduction riding the
+    program's existing outputs — the host pins a NaN/Inf forward to its
+    slot with zero extra transfers."""
+    axes = tuple(range(1, logits.ndim)) or None
+    return jnp.all(jnp.isfinite(logits.astype(jnp.float32)), axis=axes)
+
+
 def _prefill_lane(model, padded, chunk, start, variables, lane, prompt,
                   length):
     """Shared chunked-prefill core: run `prompt` (right-padded to
@@ -524,11 +632,16 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
     """
     slot, length = ctl[0], ctl[1]
     quant = isinstance(caches, QuantStore)
-    eidx = ctl[-1] if quant else None
+    # fault-plane layout contract: the poison code is ALWAYS the last
+    # ctl element; the exact-lane index (quant pools) sits before it
+    fault = ctl[-1]
+    eidx = ctl[-2] if quant else None
     lane = (quant_lane_view(caches, slot, eidx) if quant
             else extract_lane(caches, slot))
     lane, last = _prefill_lane(model, padded, chunk, start, variables,
                                lane, prompt, length)
+    last = _inject_fault(last, fault)
+    ok = _finite_ok(last)
     packed = PackedSampling(
         temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
         top_k=ctl[3:4], need_lp=ctl[5:6],
@@ -542,7 +655,7 @@ def _prefill_program(model, padded, chunk, start, cap, variables, caches,
                                   start + padded, hi=start + length)
     else:
         caches = store_lane(caches, lane, slot)
-    return caches, first[0], logprob[0]
+    return caches, first[0], logprob[0], ok
 
 
 @functools.partial(
@@ -575,15 +688,18 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     and the scatter re-quantizes only the written pages."""
     slot, length = ctl[0], ctl[1]
     quant = isinstance(phys, QuantStore)
+    fault = ctl[-1]
     if quant:
-        eidx = ctl[-1]
-        row = ctl[6 + cap:-1]
+        eidx = ctl[-2]
+        row = ctl[6 + cap:-2]
         lane = quant_gather_lane(phys, row, eidx)
     else:
-        row = ctl[6 + cap:]
+        row = ctl[6 + cap:-1]
         lane = gather_lane(phys, row)
     lane, last = _prefill_lane(model, padded, chunk, start, variables,
                                lane, prompt, length)
+    last = _inject_fault(last, fault)
+    ok = _finite_ok(last)
     packed = PackedSampling(
         temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
         top_k=ctl[3:4], need_lp=ctl[5:6],
@@ -599,7 +715,7 @@ def _paged_prefill_program(model, padded, chunk, start, cap, variables,
     else:
         page = jax.tree_util.tree_leaves(phys)[0].shape[1]
         phys = scatter_lane_pages(phys, lane, row, start // page)
-    return phys, first[0], logprob[0]
+    return phys, first[0], logprob[0], ok
 
 
 @functools.partial(
@@ -640,6 +756,9 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     active, eos = state[2].astype(bool), state[3]
     step_tag, seeds = state[4, 0], state[6]
     allow = state[9:9 + cap].T  # (S, cap)
+    # fault-plane layout contract: the per-slot poison row is ALWAYS the
+    # last state row; the exact-lane index row (quant) sits before it
+    fault = state[-1]
     packed = PackedSampling(
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
         need_lp=state[8],
@@ -648,11 +767,11 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     # the scan carries the DEQUANTIZED (S, max_len, ...) lane view —
     # within-block reads are full precision, quantization happens at the
     # block boundary — and the store requantizes only the blocks each
-    # slot's write window [pos0, pos0 + block) touched. state[-1] is the
+    # slot's write window [pos0, pos0 + block) touched. state[-2] is the
     # per-slot exact-lane index row.
     quant = isinstance(caches, QuantStore)
     if quant:
-        eidx = state[-1]
+        eidx = state[-2]
         pos0 = pos
         lanes = quant_lanes_view(caches, eidx)
     else:
@@ -671,6 +790,8 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
     def step(carry, _):
         toks, pos, samp_idx, lanes = carry
         logits, lanes = jax.vmap(one)(toks, pos, lanes)
+        logits = _inject_fault(logits, fault)
+        ok = _finite_ok(logits)
         keys = slot_keys(rng, step_tag, seeds, samp_idx)
         nxt, logprob = fused_sample(logits, packed, keys, cap=cap,
                                     allow=allow)
@@ -679,16 +800,16 @@ def _decode_program(model, block, cap, variables, caches, state, samp, rng):
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
         nxt = jnp.where(active, nxt, toks)
         pos = jnp.where(active, pos + 1, pos)
-        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob)
+        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob, ok)
 
-    (toks, pos, _, lanes), out = jax.lax.scan(
+    (toks, pos, _, lanes), (out, lps, oks) = jax.lax.scan(
         step, (toks, pos, state[7], lanes), None, length=block
     )
     if quant:
         caches = quant_store_written(caches, lanes, pos0, block, eidx)
     else:
         caches = lanes
-    return caches, out
+    return caches, (out, lps, jnp.all(oks, axis=0))
 
 
 @functools.partial(
@@ -729,14 +850,16 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     active, eos = state[2].astype(bool), state[3]
     step_tag, seeds = state[4, 0], state[6]
     allow = state[9:9 + cap].T  # (S, cap)
+    fault = state[-1]
     quant = isinstance(phys, QuantStore)
     if quant:
-        # the exact-lane index row rides after the page tables
-        table = state[9 + cap:-1].T  # (S, pages_per_lane)
-        eidx = state[-1]
+        # the exact-lane index row rides after the page tables, the
+        # fault row after it
+        table = state[9 + cap:-2].T  # (S, pages_per_lane)
+        eidx = state[-2]
         lanes = quant_gather_lanes(phys, table, eidx)
     else:
-        table = state[9 + cap:].T  # (S, pages_per_lane)
+        table = state[9 + cap:-1].T  # (S, pages_per_lane)
         lanes = gather_lanes(phys, table)
     pos0 = pos
     packed = PackedSampling(
@@ -757,6 +880,8 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
     def step(carry, _):
         toks, pos, samp_idx, lanes = carry
         logits, lanes = jax.vmap(one)(toks, pos, lanes)
+        logits = _inject_fault(logits, fault)
+        ok = _finite_ok(logits)
         keys = slot_keys(rng, step_tag, seeds, samp_idx)
         nxt, logprob = fused_sample(logits, packed, keys, cap=cap,
                                     allow=allow)
@@ -765,11 +890,12 @@ def _paged_decode_program(model, block, cap, variables, phys, state, samp,
         nxt = jnp.where(hit_eos, eos.astype(toks.dtype), nxt)
         nxt = jnp.where(active, nxt, toks)
         pos = jnp.where(active, pos + 1, pos)
-        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob)
+        return (nxt, pos, samp_idx + 1, lanes), (nxt, logprob, ok)
 
-    (toks, pos, _, lanes), out = jax.lax.scan(
+    (toks, pos, _, lanes), (out, lps, oks) = jax.lax.scan(
         step, (toks, pos, state[7], lanes), None, length=block
     )
+    out = (out, lps, jnp.all(oks, axis=0))
     page = jax.tree_util.tree_leaves(phys.q if quant else phys)[0].shape[1]
     # static window bound: positions [p, p + block) touch at most this
     # many pages; windows clipped past the lane end rewrite the last
@@ -818,6 +944,7 @@ def _spec_rounds_scan(model, k, rounds, cap, max_len, nmax, variables,
     active = state[2].astype(bool)
     step_tag, seeds, samp0 = state[4, 0], state[6], state[7]
     allow = state[9:9 + cap].T
+    fault = state[-1]  # fault-plane poison row (always the last row)
     spec_ok = state[9 + cap].astype(bool)
     packed = PackedSampling(
         temperature=samp[0], top_p=samp[1], min_p=samp[2], top_k=state[5],
@@ -865,6 +992,8 @@ def _spec_rounds_scan(model, k, rounds, cap, max_len, nmax, variables,
             (logits, hs), lanes = jax.vmap(fwd)(toks, ds, pos, lanes)
         else:
             logits, lanes = jax.vmap(fwd)(toks, ds, pos, lanes)
+        logits = _inject_fault(logits, fault)
+        ok = _finite_ok(logits)
         keys = round_keys(rng, step_tag, seeds, cnt, k + 1)
         out, commits, lps = spec_verify(
             logits, ds, avail, packed, keys, cap=cap, allow=allow
@@ -919,7 +1048,7 @@ def _spec_rounds_scan(model, k, rounds, cap, max_len, nmax, variables,
         pos = jnp.minimum(pos + commits, max_len - 1)
         cnt = cnt + commits
         carry = (toks, pos, cnt, hist, hlen, drafts, lanes, mlanes)
-        return carry, (out, commits, avail, lps)
+        return carry, (out, commits, avail, lps, ok)
 
     if hist is not None:
         # pad so the (k+1)-wide write at hlen <= max_len never shifts
@@ -927,12 +1056,13 @@ def _spec_rounds_scan(model, k, rounds, cap, max_len, nmax, variables,
             [hist, jnp.zeros((hist.shape[0], k + 1), hist.dtype)], axis=1
         )
     carry0 = (toks, pos, samp0, hist, hlen, drafts0, lanes, mtp_lanes)
-    carry, (out, commits, proposed, lps) = jax.lax.scan(
+    carry, (out, commits, proposed, lps, oks) = jax.lax.scan(
         rnd, carry0, None, length=rounds
     )
     next_drafts = (carry[5] if drafts0 is not None
                    else jnp.zeros((toks.shape[0], k), jnp.int32))
-    return carry[6], carry[7], out, commits, proposed, lps, next_drafts
+    return (carry[6], carry[7], out, commits, proposed, lps, next_drafts,
+            jnp.all(oks, axis=0))
 
 
 @functools.partial(
@@ -958,7 +1088,7 @@ def _spec_decode_program(model, k, rounds, cap, max_len, nmax, variables,
     stale-lane contract as the plain program."""
     quant = isinstance(caches, QuantStore)
     if quant:
-        eidx = state[-1]
+        eidx = state[-2]
         pos0 = state[1]
         views = quant_lanes_view(caches, eidx)
     else:
@@ -966,7 +1096,7 @@ def _spec_decode_program(model, k, rounds, cap, max_len, nmax, variables,
     lanes = pad_time(views, k + 1)
     hist = state[10 + cap:10 + cap + max_len].T
     hlen = state[10 + cap + max_len]
-    lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
+    lanes, _, out, commits, proposed, lps, _, finite = _spec_rounds_scan(
         model, k, rounds, cap, max_len, nmax, variables, lanes, state,
         samp, rng, hist=hist, hlen=hlen,
     )
@@ -983,7 +1113,7 @@ def _spec_decode_program(model, k, rounds, cap, max_len, nmax, variables,
                                      tail_garbage=True)
     else:
         caches = views
-    return caches, (out, commits, proposed, lps)
+    return caches, (out, commits, proposed, lps, finite)
 
 
 @functools.partial(
@@ -1009,17 +1139,17 @@ def _paged_spec_decode_program(model, k, rounds, cap, max_len, nmax,
     base = 11 + cap + max_len
     quant = isinstance(phys, QuantStore)
     if quant:
-        table = state[base:-1].T  # (S, pages_per_lane)
-        eidx = state[-1]
+        table = state[base:-2].T  # (S, pages_per_lane)
+        eidx = state[-2]
         gathered = quant_gather_lanes(phys, table, eidx)
     else:
-        table = state[base:].T  # (S, pages_per_lane)
+        table = state[base:-1].T  # (S, pages_per_lane)
         gathered = gather_lanes(phys, table)
     hist = state[10 + cap:10 + cap + max_len].T
     hlen = state[10 + cap + max_len]
     pos0 = state[1]
     lanes = pad_time(gathered, k + 1)
-    lanes, _, out, commits, proposed, lps, _ = _spec_rounds_scan(
+    lanes, _, out, commits, proposed, lps, _, finite = _spec_rounds_scan(
         model, k, rounds, cap, max_len, nmax, variables, lanes, state,
         samp, rng, hist=hist, hlen=hlen,
     )
@@ -1033,7 +1163,7 @@ def _paged_spec_decode_program(model, k, rounds, cap, max_len, nmax,
     else:
         phys = scatter_window_pages(phys, lanes, table, pos0, last,
                                     rounds * (k + 1))
-    return phys, (out, commits, proposed, lps)
+    return phys, (out, commits, proposed, lps, finite)
 
 
 @functools.partial(
@@ -1053,11 +1183,13 @@ def _mtp_spec_decode_program(model, k, rounds, cap, max_len, variables,
     returned `next_drafts`)."""
     lanes = pad_time(caches, k + 1)
     drafts0 = state[10 + cap:10 + cap + k].T.astype(jnp.int32)
-    lanes, mtp, out, commits, proposed, lps, nxt = _spec_rounds_scan(
-        model, k, rounds, cap, max_len, 0, variables, lanes, state, samp,
-        rng, mtp_lanes=mtp, drafts0=drafts0,
-    )
-    return strip_time(lanes, k + 1), mtp, (out, commits, proposed, lps), nxt
+    lanes, mtp, out, commits, proposed, lps, nxt, finite = (
+        _spec_rounds_scan(
+            model, k, rounds, cap, max_len, 0, variables, lanes, state,
+            samp, rng, mtp_lanes=mtp, drafts0=drafts0,
+        ))
+    return (strip_time(lanes, k + 1), mtp,
+            (out, commits, proposed, lps, finite), nxt)
 
 
 @functools.partial(
@@ -1106,6 +1238,8 @@ def _mtp_prefill_program(model, padded, chunk, cap, k, variables, caches,
         last = row if last is None else jnp.where(sel, row, last)
     h_all = jnp.concatenate(hs, axis=1)  # (1, padded, D)
     caches = store_lane(caches, lane, slot)
+    last = _inject_fault(last, ctl[-1])
+    ok = _finite_ok(last)
     packed = PackedSampling(
         temperature=samp[0:1], top_p=samp[1:2], min_p=samp[2:3],
         top_k=ctl[3:4], need_lp=ctl[5:6],
@@ -1172,7 +1306,7 @@ def _mtp_prefill_program(model, padded, chunk, cap, k, variables, caches,
         drafts = jnp.stack([d1, d2])
     else:
         drafts = d1[None]
-    return caches, tuple(out_mtp), first[0], logprob[0], drafts
+    return caches, tuple(out_mtp), first[0], logprob[0], drafts, ok
 
 
 class ServeEngine:
@@ -1430,6 +1564,51 @@ class ServeEngine:
             self.metrics.add_gauge_provider(
                 lambda: self._slo.gauges(self.metrics.elapsed_s)
             )
+        # fault-tolerance layer (serve/faults.py; see the ServeConfig
+        # knob block). The supervised step boundary is ALWAYS armed —
+        # real NaN forwards and device runtime errors need no opt-in —
+        # while the injection plane and the degradation ladder follow
+        # the None-pattern.
+        if cfg.fault_max_retries < 0:
+            raise ValueError(
+                f"fault_max_retries must be >= 0, got {cfg.fault_max_retries}"
+            )
+        if (cfg.fault_step_deadline_s is not None
+                and not cfg.fault_step_deadline_s > 0):
+            raise ValueError(
+                "fault_step_deadline_s must be > 0 (or None to disarm "
+                f"the watchdog), got {cfg.fault_step_deadline_s}"
+            )
+        self._faults = FaultPlan.from_config(cfg.fault_plan)
+        # per-slot logits-poison row: rides the LAST row/element of every
+        # packed control transfer (all-zero = bitwise no-op inside the
+        # programs), written by the plan's decode-site pokes and cleared
+        # after each dispatch
+        self._fault_row = np.zeros(cfg.n_slots, np.int32)
+        self._health = "healthy"
+        self._consec_failures = 0
+        self._failed_since: float | None = None
+        self._last_error: str | None = None
+        self._recover_at = 0.0
+        self._backoff = cfg.fault_recover_backoff_s
+        self._ladder = None
+        if cfg.degrade:
+            for knob in ("degrade_free_page_frac", "degrade_headroom_frac"):
+                v = getattr(cfg, knob)
+                if not 0.0 < v < 1.0:
+                    raise ValueError(f"{knob} must be in (0, 1), got {v}")
+            if not cfg.degrade_burn_threshold > 0:
+                raise ValueError(
+                    "degrade_burn_threshold must be > 0, got "
+                    f"{cfg.degrade_burn_threshold}"
+                )
+            self._ladder = DegradationLadder(
+                up_steps=cfg.degrade_up_steps,
+                down_steps=cfg.degrade_down_steps,
+            )
+            self.metrics.add_gauge_provider(
+                lambda: {"serve/degradation_rung": float(self._ladder.rung)}
+            )
         # delivered-token tick weight for the scheduler's anti-starvation
         # clock: a speculative step can deliver many tokens per slot, so
         # ticking 1 per iteration would make a waiting request's budget
@@ -1547,6 +1726,8 @@ class ServeEngine:
                 # histograms as native _bucket/_sum/_count series
                 lambda: (self._step_idx, self.metrics.prom_snapshot()),
                 host=cfg.status_host, port=cfg.status_port,
+                # /healthz answers 503 while the engine is unhealthy
+                health_fn=lambda: self.health,
             )
 
     # ------------------------------------------------------------- submit
@@ -1678,6 +1859,37 @@ class ServeEngine:
         )
         if deadline_s is not None:
             req.deadline = req.submit_time + deadline_s
+        # fault boundary: an unhealthy engine is draining — it must not
+        # book slots it cannot serve. Past the recovery backoff the next
+        # submission re-arms it (the pool was rebuilt at the unhealthy
+        # transition, so recovery is a host-side state flip).
+        if self._health == "unhealthy":
+            if smetrics.now() >= self._recover_at:
+                self._recover()
+            else:
+                req.state = REJECTED
+                req.reject_reason = "unhealthy"
+                self.metrics.record_reject()
+                if self.trace is not None:
+                    self.trace.instant("reject", "request", "queue",
+                                       req=req.id, ts=req.submit_time,
+                                       reason="unhealthy")
+                return req
+        # degradation ladder: load-shed admissions by SLO class (batch
+        # first) — the front door maps the shed to 503 with a jittered
+        # Retry-After and the current rung header
+        if self._ladder is not None:
+            cls = req.params.slo or "standard"
+            if cls in self._ladder.shed_classes():
+                req.state = REJECTED
+                req.reject_reason = f"shed:{cls}"
+                self.metrics.record_reject()
+                self.metrics.record_shed(cls)
+                if self.trace is not None:
+                    self.trace.instant("shed", "engine", "queue",
+                                       req=req.id, ts=req.submit_time,
+                                       slo=cls, rung=self._ladder.rung)
+                return req
         if not self.scheduler.submit(req):
             self.metrics.record_reject()
             if self.trace is not None:
@@ -1718,7 +1930,55 @@ class ServeEngine:
         """One engine iteration: admit + prefill, then one decode block.
 
         Returns the requests that FINISHED this iteration.
+
+        Supervised (the fault boundary): any exception escaping the
+        iteration — a real `XlaRuntimeError`, a device OOM, or an
+        injected fault — is classified (`serve.faults.classify_failure`)
+        and answered with a bounded pool-rebuild retry (active streams
+        requeue and resume by recompute, token-exactly — the
+        preemption argument); after `fault_max_retries` consecutive
+        failures the engine drains to `unhealthy` (every in-flight
+        stream finishes "error" with its terminal client envelope,
+        /healthz flips to 503) and re-arms after a backoff. NaN/Inf
+        forwards never raise: the traced finite-logits guard pins them
+        to a slot, which `_quarantine` contains below the step
+        boundary. A watchdog flags steps exceeding
+        `fault_step_deadline_s`; the degradation ladder (if armed)
+        re-evaluates its pressure signals after every step.
         """
+        if self._health == "unhealthy":
+            now = smetrics.now()
+            if now < self._recover_at:
+                # draining: no device work until the backoff elapses (a
+                # tight external drive loop must not busy-spin)
+                time.sleep(min(0.005, self._recover_at - now))
+                self._step_idx += 1
+                return []
+            self._recover()
+        t0 = smetrics.now()
+        try:
+            finished = self._step_inner()
+        except Exception as exc:  # noqa: BLE001 — the fault boundary
+            # no watchdog check on this path: the boundary's own
+            # recovery work (pool rebuild + backoff sleep) is not a
+            # wedged step — the incident is already accounted as
+            # serve/fault_retries, and double-reporting it as a stall
+            # would page operators twice for one failure
+            finished = self._systemic_failure(exc)
+        else:
+            ddl = self.config.fault_step_deadline_s
+            if ddl is not None:
+                dur = smetrics.now() - t0
+                if dur > ddl:
+                    self._watchdog_fire(dur)
+            if self._failed_since is not None:
+                # first clean step after a failure episode
+                self._note_recovery()
+        if self._ladder is not None:
+            self._ladder_step()
+        return finished
+
+    def _step_inner(self) -> list[Request]:
         if not self._profile_done:
             self._profile_tick()
         tr = self.trace
@@ -1736,12 +1996,29 @@ class ServeEngine:
         n_admitted = 0
         if self._paged:
             self._unblock_head()
-        for req in self.scheduler.pick(self.pool.n_free, self.pool.n_active):
-            if req.deadline is not None:
-                self._waiting_deadlines -= 1  # left the queue via pick
-            n_admitted += 1
-            if self._admit(req):
-                finished.append(req)  # prefill-only finish (eos/budget 1)
+        picked = self.scheduler.pick(self.pool.n_free, self.pool.n_active)
+        at = -1
+        try:
+            for at, req in enumerate(picked):
+                if req.deadline is not None:
+                    self._waiting_deadlines -= 1  # left the queue via pick
+                n_admitted += 1
+                if self._admit(req):
+                    finished.append(req)  # prefill-only finish (eos/budget 1)
+        except BaseException:
+            # failure-safe admission: `pick` already popped this
+            # iteration's batch off the queue, so a program failure mid
+            # loop would silently LOSE the not-yet-admitted tail (the
+            # raising request itself is registered in _slot_req before
+            # any dispatch and the fault boundary's rebuild requeues it
+            # from there). Put the tail back at the head, order
+            # preserved, before the boundary sees the exception. No
+            # _waiting_deadlines adjustment: the tail never reached its
+            # per-request decrement above, so the counter still counts
+            # it — incrementing here would double-count forever.
+            for r in reversed(picked[at + 1:]):
+                self.scheduler.requeue_front(r)
+            raise
         decode_slots = self.pool.n_active
         if decode_slots > 0:
             finished.extend(self._decode_block())
@@ -1821,6 +2098,344 @@ class ServeEngine:
             self._profiling = False
             self._profile_done = True
 
+    # ------------------------------------------------ fault boundary
+
+    @property
+    def health(self) -> str:
+        """The /healthz state machine: ``"healthy"`` -> ``"degraded"``
+        (the ladder is on a rung > 0 — still serving, a load balancer
+        should keep it) -> ``"unhealthy"`` (draining after persistent
+        systemic failures; /healthz answers 503 until recovery).
+        Reports readiness, not the raw internal flag: once the recovery
+        backoff elapses the engine IS ready (the pool was rebuilt at the
+        unhealthy transition; the next submission flips the flag), so
+        /healthz must return to 200 then — a load balancer that dropped
+        the replica on 503 routes no traffic, and a health view gated
+        on traffic arriving would keep it out of rotation forever."""
+        if (self._health == "unhealthy"
+                and smetrics.now() < self._recover_at):
+            return "unhealthy"
+        if self._ladder is not None and self._ladder.rung > 0:
+            return "degraded"
+        return "healthy"
+
+    @property
+    def degradation_rung(self) -> int:
+        """Current ladder rung (0 = normal; 0 when the ladder is off)."""
+        return self._ladder.rung if self._ladder is not None else 0
+
+    def _poke_site(self, site: str) -> int:
+        """Fault-plane hook at a named hot-path site (one `is None`
+        branch when disarmed). Applies host-side effects — ``stall``
+        sleeps here, ``xla_error``/``oom`` raise a synthetic
+        `InjectedFault` the step boundary classifies like the real
+        thing — and routes logits poison: returned as the ctl code for
+        prefill sites, written to the per-slot fault row for decode
+        sites (cleared after the dispatch it rides)."""
+        if self._faults is None:
+            return 0
+        code = 0
+        for spec in self._faults.poke(site):
+            self.metrics.record_fault_injected()
+            if self.trace is not None:
+                self.trace.instant("fault_injected", "engine", "engine",
+                                   site=site, kind=spec.kind,
+                                   slot=spec.slot)
+            if spec.kind == "stall":
+                time.sleep(spec.stall_s)
+            elif spec.kind in ("xla_error", "oom"):
+                raise InjectedFault(spec.kind, site)
+            elif spec.kind in ("nan", "inf"):
+                k = FAULT_NAN if spec.kind == "nan" else FAULT_INF
+                if site == "prefill":
+                    code = k
+                else:
+                    self._fault_row[spec.slot % self.config.n_slots] = k
+            # socket_reset belongs to the front door's sse_write site
+        return code
+
+    def _systemic_failure(self, exc: Exception) -> list[Request]:
+        """A step escaped with an exception: the in-flight program's
+        donated pool buffers are unusable, so the remedy is rebuild —
+        bounded retries first (streams requeue and resume by recompute,
+        token-exactly), then the draining `unhealthy` state."""
+        kind = classify_failure(exc)
+        err = f"{type(exc).__name__}: {exc}"
+        now = smetrics.now()
+        self._consec_failures += 1
+        self._last_error = err
+        if self._failed_since is None:
+            self._failed_since = now
+        if self._consec_failures <= self.config.fault_max_retries:
+            # counted only when a rebuild retry is actually granted —
+            # the failure that EXHAUSTS the budget is accounted as the
+            # unhealthy transition below, not as a retry
+            self.metrics.record_engine_retry()
+        if self.trace is not None:
+            self.trace.instant("engine_fault", "engine", "engine", ts=now,
+                               kind=kind, error=err[:200],
+                               failures=self._consec_failures)
+            if self._mon is not None:
+                self._mon.dump("engine_fault", failure_kind=kind,
+                               error=err[:500],
+                               consecutive=self._consec_failures)
+        if self._consec_failures > self.config.fault_max_retries:
+            return self._go_unhealthy(err)
+        self._rebuild_pool(requeue=True)
+        time.sleep(min(
+            self.config.fault_retry_backoff_s
+            * (2 ** (self._consec_failures - 1)), 2.0,
+        ))
+        return []
+
+    def _go_unhealthy(self, err: str) -> list[Request]:
+        """Retries exhausted: drain — every in-flight and queued request
+        finishes "error" host-side (each client gets its terminal
+        envelope; slots/pages/exact lanes reclaim leak-free), the pool
+        rebuilds so recovery starts from fresh fully-owned buffers, and
+        /healthz reports 503 until the recovery backoff elapses."""
+        self._health = "unhealthy"
+        now = smetrics.now()
+        self._recover_at = now + self._backoff
+        # doubles across consecutive unhealthy episodes; a clean step
+        # (via _note_recovery) resets it
+        self._backoff = min(self._backoff * 2, 30.0)
+        self.metrics.record_engine_unhealthy()
+        if self.trace is not None:
+            self.trace.instant("unhealthy", "engine", "engine", ts=now,
+                               error=err[:200],
+                               recover_after_s=round(
+                                   self._recover_at - now, 3))
+        finished = self.force_drain("error")
+        self._rebuild_pool(requeue=False)
+        return finished
+
+    def _recover(self) -> None:
+        """Re-arm an unhealthy engine (the pool was rebuilt at the
+        unhealthy transition, so this is a host-side state flip)."""
+        self._health = "healthy"
+        self._consec_failures = 0
+        if self.trace is not None:
+            self.trace.instant("recovered", "engine", "engine",
+                               ts=smetrics.now())
+
+    def _note_recovery(self) -> None:
+        """First clean step after a failure episode: stamp the
+        wall-clock recovery time (first failure -> first clean step)."""
+        now = smetrics.now()
+        if self._failed_since is not None:
+            self.metrics.record_recovery(now - self._failed_since)
+            if self.trace is not None:
+                self.trace.instant(
+                    "fault_recovered", "engine", "engine", ts=now,
+                    recovery_s=round(now - self._failed_since, 4),
+                )
+        self._failed_since = None
+        self._consec_failures = 0
+        self._backoff = self.config.fault_recover_backoff_s
+
+    def _rebuild_pool(self, requeue: bool) -> None:
+        """Replace the device pool with fresh buffers after a systemic
+        failure (a raising jitted call may have consumed its donated
+        inputs — the old pytree cannot be trusted). With `requeue`,
+        every active stream returns to the queue head ordered oldest-
+        first and resumes by recompute: cached KV depends only on token
+        ids and seeded chains fold only (seed, sample index), so
+        resumed streams are token-exact (the preemption argument). The
+        prefix cache is dropped wholesale — lane segments may alias
+        rebuilt state and paged trees hold page ids into the dead pool."""
+        cfg = self.config
+        if requeue:
+            active = [r for r in self._slot_req if r is not None]
+            # youngest requeued first so the OLDEST ends at the head
+            active.sort(key=lambda r: r.admit_time or 0.0, reverse=True)
+            for req in active:
+                if self._paged and req.slot is not None:
+                    req.pages_held = max(
+                        req.pages_held, int(self.pool.n_alloc[req.slot])
+                    )
+                req.slot = None
+                self.scheduler.requeue_front(req)
+                if req.deadline is not None:
+                    self._waiting_deadlines += 1
+        self._slot_req = [None] * cfg.n_slots
+        self._toks[:] = 0
+        self._pos[:] = 0
+        self._samp_f[:] = np.asarray(GREEDY_ROW, np.float32)[:, None]
+        self._allow[:] = -1
+        self._top_k[:] = 0
+        self._seed[:] = -1
+        self._need_lp[:] = 0
+        self._fault_row[:] = 0
+        self._eidx[:] = 0
+        self._exact_free = list(range(cfg.kv_exact_lanes, 0, -1))
+        if self._paged:
+            page = cfg.page_size or cfg.prefix_page
+            self.pool = PagedKVPool(
+                self.model, cfg.n_slots, cfg.max_len, page,
+                page_budget=cfg.page_budget, quant=cfg.kv_quant,
+                exact_lanes=cfg.kv_exact_lanes,
+            )
+        else:
+            self.pool = KVSlotPool(
+                self.model, cfg.n_slots, cfg.max_len, quant=cfg.kv_quant,
+                quant_block=cfg.kv_quant_block,
+                exact_lanes=cfg.kv_exact_lanes,
+            )
+            if self.registry is not None:
+                self.pool.registry = self.registry
+        if self._mtp_pool is not None:
+            from solvingpapers_tpu.infer.cache import LatentCache
+
+            dim = self.model.cfg.latent_dim + self.model.cfg.rope_dim
+            self._mtp_pool = tuple(
+                LatentCache.init(
+                    cfg.n_slots, cfg.max_len + self._spec_k + 1, dim,
+                    self.model.cfg.compute_dtype,
+                )
+                for _ in range(self._spec_k)
+            )
+            self._next_drafts[:] = 0
+        if self.prefix_cache is not None:
+            self.prefix_cache = PrefixCache(
+                page=cfg.prefix_page, max_bytes=cfg.prefix_cache_bytes,
+                trace=self.trace,
+                pool=self.pool if cfg.paged else None,
+            )
+            self.metrics.record_prefix_state(0, self.prefix_cache.evictions)
+
+    def _watchdog_fire(self, dur_s: float) -> None:
+        """A step exceeded the absolute deadline: count it, stamp a
+        trace instant, and (when the anomaly dumper is armed) dump the
+        flight-recorder tail for the post-mortem."""
+        self.metrics.record_watchdog_stall(dur_s)
+        if self.trace is not None:
+            self.trace.instant(
+                "watchdog_stall", "engine", "engine",
+                step_s=round(dur_s, 4),
+                deadline_s=self.config.fault_step_deadline_s,
+            )
+            if self._mon is not None:
+                self._mon.dump(
+                    "watchdog_stall", step_s=round(dur_s, 4),
+                    deadline_s=self.config.fault_step_deadline_s,
+                )
+
+    def _quarantine(self, req: Request, now: float) -> Request:
+        """Blast-radius containment for a NaN/Inf-poisoned slot: the
+        block's tokens are discarded (drawn from non-finite logits), the
+        slot's lane/pages are SCRUBBED to zero before release (masked
+        attention annihilates finite stale values exactly, but
+        ``0 * NaN`` is NaN — an unscrubbed poisoned lane would leak into
+        its next occupant), and the request finishes "error". Every
+        other stream — computed in the same program call from its own
+        per-slot lane — continues byte-identically."""
+        slot = req.slot
+        self.metrics.record_quarantine()
+        # a prefill-poisoned request has no first token: _finish closes
+        # its lifecycle spans with a zero-width prefill phase
+        if self.trace is not None:
+            self.trace.instant("quarantine", "engine", f"slot{slot}",
+                               req=req.id, ts=now, tokens=len(req.tokens))
+            if self._mon is not None:
+                self._mon.dump("quarantine", req=req.id, slot=slot)
+        self._scrub_slot(slot)
+        self._finish(req, "error", now)
+        self._notify(req, 0)
+        return req
+
+    def _scrub_slot(self, slot: int) -> None:
+        """Zero a poisoned slot's device state before its storage is
+        reused (see `_quarantine`). Paged pools scrub only the slot's
+        exclusively-owned pages — shared prefix pages hold KV written
+        strictly before the poisoned step and other holders still read
+        them — plus the trash page, where the poisoned slot's masked
+        overshoot writes land."""
+        eidx = jnp.int32(int(self._eidx[slot]) if self._quant else 0)
+        if self._paged:
+            n = int(self.pool.n_alloc[slot])
+            own = [int(p) for p in self.pool.table[slot, :n]
+                   if self.pool.refcount[p] == 1]
+            row = np.full(self.pool.pages_per_lane + 1, TRASH_PAGE,
+                          np.int32)
+            row[:len(own)] = own
+            self.pool.phys = scrub_pages_program(
+                self.pool.phys, jnp.asarray(row), eidx
+            )
+        else:
+            self.pool.caches = scrub_lane_program(
+                self.pool.caches, jnp.int32(slot), eidx
+            )
+            if self._mtp_pool is not None:
+                self._mtp_pool = tuple(
+                    scrub_lane_program(c, jnp.int32(slot), jnp.int32(0))
+                    for c in self._mtp_pool
+                )
+
+    def force_drain(self, reason: str = "cancelled") -> list[Request]:
+        """Finish every in-flight and queued request host-side — no
+        device work, so it cannot hang on a wedged program. The
+        bounded-shutdown backstop (`close`) and the unhealthy drain
+        (`reason="error"`); slots, pages and exact lanes reclaim through
+        the ordinary finish paths, so the pool drains leak-free."""
+        now = smetrics.now()
+        finished: list[Request] = []
+        for req in [r for r in self._slot_req if r is not None]:
+            self._finish(req, reason, now)
+            self._notify(req, 0)
+            finished.append(req)
+        for req in list(self.scheduler.queue):
+            self.scheduler.remove(req)
+            self._finish_unadmitted(req, reason, now)
+            finished.append(req)
+        self._waiting_deadlines = 0
+        return finished
+
+    def _ladder_step(self) -> None:
+        """One degradation-ladder evaluation (per engine step): gather
+        the pressure signals, move at most one rung (hysteresis lives in
+        the ladder), and apply the current rung's effects. Rung 1 sheds
+        a few prefix-cache leaves per step (gradual — a short spike must
+        not destroy the whole cache); rung 2 additionally holds
+        speculation; rungs 3/4 shed admissions in `submit`."""
+        cfg = self.config
+        reasons = []
+        if self._paged and (self.pool.pages_free
+                            < cfg.degrade_free_page_frac
+                            * self.pool.page_budget):
+            reasons.append("pages")
+        if self.ledger is not None and self.ledger.capacity_bytes:
+            peak = self.ledger.projected_peak_bytes()
+            if peak > (1.0 - cfg.degrade_headroom_frac) \
+                    * self.ledger.capacity_bytes:
+                reasons.append("hbm")
+        if self._slo is not None:
+            for cls in self._slo.targets:
+                if self._slo.burn_rate(cls) > cfg.degrade_burn_threshold:
+                    reasons.append(f"burn:{cls}")
+                    break
+        new = self._ladder.observe(bool(reasons), reasons)
+        if new is not None:
+            self.metrics.record_degrade_transition()
+            if self.trace is not None:
+                self.trace.instant(
+                    "degrade", "engine", "engine", rung=new,
+                    name=self._ladder.name,
+                    reasons=",".join(reasons) or "clear",
+                )
+        rung = self._ladder.rung
+        if rung >= 1 and self.prefix_cache is not None:
+            shed = 0
+            while shed < 4 and self.prefix_cache.evict_one():
+                shed += 1
+            if shed:
+                self.metrics.record_prefix_state(
+                    self.prefix_cache.bytes_held,
+                    self.prefix_cache.evictions,
+                )
+        if rung >= 2 and self._spec_ctl is not None:
+            self._spec_ctl.hold(2)
+
     def statusz(self) -> dict:
         """The /statusz document: live engine state assembled from
         host-side mirrors only (safe to call from the status server's
@@ -1850,6 +2465,20 @@ class ServeEngine:
             ],
             "metrics": self.metrics.snapshot(),
         }
+        m = self.metrics
+        d["health"] = {
+            "state": self.health,
+            "consecutive_failures": self._consec_failures,
+            "last_error": self._last_error,
+            "quarantines": m.quarantines,
+            "retries": m.engine_retries,
+            "unhealthy_episodes": m.engine_unhealthy,
+            "watchdog_stalls": m.watchdog_stalls,
+        }
+        if self._faults is not None:
+            d["health"]["fault_plan"] = self._faults.stats()
+        if self._ladder is not None:
+            d["health"]["ladder"] = self._ladder.stats()
         if self._paged:
             d["kv_pages"] = {
                 "page_size": self.pool.page_size,
@@ -1906,9 +2535,21 @@ class ServeEngine:
             d["mem"] = self.ledger.snapshot()
         return d
 
-    def close(self) -> None:
-        """Release external resources (status endpoint, profiler
-        window). Idempotent; the engine itself stays usable."""
+    def close(self, drain_s: float = 0.0) -> None:
+        """Bounded shutdown: drive step() for up to `drain_s` seconds of
+        graceful drain, then FORCE-CANCEL whatever is still in flight
+        host-side (`force_drain`) — so SIGTERM can never hang on a
+        wedged request (the deadline is checked before every step; a
+        single stalled step can overrun it by at most its own duration,
+        after which no further device work is dispatched). Releases
+        external resources (status endpoint, profiler window).
+        Idempotent; the engine itself stays usable."""
+        deadline = smetrics.now() + drain_s
+        while (self.has_work() and self._health != "unhealthy"
+               and smetrics.now() < deadline):
+            self.step()
+        if self.has_work():
+            self.force_drain("cancelled")
         self.stop_profile()
         if self.status is not None:
             self.status.close()
@@ -2200,6 +2841,11 @@ class ServeEngine:
         req.state = ACTIVE
         req.slot = slot
         req.admit_time = now
+        # registered BEFORE any device dispatch: if a program call below
+        # raises, the fault boundary's rebuild scans _slot_req to
+        # requeue in-flight work — a mid-admission request must not slip
+        # through the scan and get lost (the bail paths clear it)
+        self._slot_req[slot] = req
 
         if resumed:
             seq = np.concatenate(
@@ -2218,6 +2864,9 @@ class ServeEngine:
             match = self.prefix_cache.match(seq[: length - 1])
             matched = match.length
             if matched:
+                # fault-plane site: the prefix-cache reuse path (splice
+                # program / zero-copy page append)
+                self._poke_site("prefix_splice")
                 # pin across the reuse. In today's single-threaded engine
                 # nothing can evict between match and splice (eviction only
                 # runs inside insert, below) — the pin is the invariant a
@@ -2265,6 +2914,7 @@ class ServeEngine:
             # pathological: even after shedding the whole tree and every
             # other stream the pool cannot cover this prefill. Hand the
             # pages and slot back and retry next iteration.
+            self._slot_req[slot] = None
             self.pool.release(slot)
             req.slot = None
             self.scheduler.requeue_front(req)
@@ -2277,6 +2927,7 @@ class ServeEngine:
                 # the admission gate's estimate went stale (several exact
                 # picks in one iteration): requeue and retry when a
                 # sidecar lane frees — the paged bail path's discipline
+                self._slot_req[slot] = None
                 self.pool.release(slot)
                 req.slot = None
                 self.scheduler.requeue_front(req)
@@ -2313,13 +2964,20 @@ class ServeEngine:
         # well-formed); free/unconstrained lanes rest at -1
         self._allow[slot] = (self._grammar_allow(req)
                              if req.grammar is not None else -1)
+        # fault-plane site: the prefill dispatch (stall/synthetic-error
+        # effects apply here; a nan/inf spec poisons THIS prefill's
+        # sampled-token logits through the ctl code below)
+        pf_fault = self._poke_site("prefill")
         # the paged program reads the slot's page-table row off the SAME
         # packed int transfer as the allow-list (logical->physical
-        # translation with zero extra host->device traffic)
+        # translation with zero extra host->device traffic); the
+        # fault-plane poison code is ALWAYS the last element, the
+        # exact-lane index (quant pools) second-to-last
         ctl = np.concatenate(
             [head, self._allow[slot]]
             + ([self.pool.table[slot]] if self._paged else [])
             + ([np.asarray([eidx], np.int32)] if self._quant else [])
+            + [np.asarray([pf_fault], np.int32)]
         )
         self._rng_step += 1
         t_pf = smetrics.now() if tr is not None else 0.0
@@ -2337,15 +2995,15 @@ class ServeEngine:
             )
             with self._scope("serve/prefill"):
                 if self.registry is not None:
-                    pool_tree, self._mtp_pool, first, logprob, drafts = (
-                        self.registry.call(
-                            "mtp_prefill_program", (padded, chunk),
-                            _mtp_prefill_program, pf_args,
-                            static_argnums=(0, 1, 2, 3, 4),
-                        ))
+                    (pool_tree, self._mtp_pool, first, logprob, drafts,
+                     ok) = self.registry.call(
+                        "mtp_prefill_program", (padded, chunk),
+                        _mtp_prefill_program, pf_args,
+                        static_argnums=(0, 1, 2, 3, 4),
+                    )
                 else:
-                    pool_tree, self._mtp_pool, first, logprob, drafts = (
-                        _mtp_prefill_program(*pf_args))
+                    (pool_tree, self._mtp_pool, first, logprob, drafts,
+                     ok) = _mtp_prefill_program(*pf_args)
             self.pool.caches = pool_tree
             self._next_drafts[slot] = np.asarray(drafts)
         else:
@@ -2362,12 +3020,12 @@ class ServeEngine:
                 if self.registry is not None:
                     # signature = the static shape triple; everything else
                     # (params, caches, control arrays) is fixed per engine
-                    pool_tree, first, logprob = self.registry.call(
+                    pool_tree, first, logprob, ok = self.registry.call(
                         "prefill_program", (padded, chunk, matched),
                         prog, pf_args, static_argnums=(0, 1, 2, 3, 4),
                     )
                 else:
-                    pool_tree, first, logprob = prog(*pf_args)
+                    pool_tree, first, logprob, ok = prog(*pf_args)
             if self._paged:
                 self.pool.phys = pool_tree
             else:
@@ -2379,6 +3037,12 @@ class ServeEngine:
             tr.complete("prefill_program", "engine", f"slot{slot}", ts=t_pf,
                         dur=t_pf1 - t_pf, req=req.id, padded=padded,
                         suffix=suffix, chunk=chunk or 0)
+        if not bool(np.asarray(ok)):
+            # poisoned prefill: quarantine BEFORE the prefix-cache
+            # insert below — a non-finite lane must never be snapshotted
+            # or page-shared into the radix tree
+            self._quarantine(req, smetrics.now())
+            return True
         if use_pc:
             # hand the prefilled span to the tree while [0, length) is
             # pristine (an active lane's decode writes land at positions
@@ -2422,7 +3086,8 @@ class ServeEngine:
             self.pool.positions[slot] = length
             self._toks[slot] = req.tokens[-1]
             self._pos[slot] = length
-            self._slot_req[slot] = req
+            # _slot_req[slot] was registered before the dispatch (the
+            # fault boundary's rebuild scans it) — nothing to set here
             if tr is not None:
                 tr.instant("resume", "request", f"slot{slot}", req=req.id,
                            ts=now, recomputed=suffix,
@@ -2450,7 +3115,6 @@ class ServeEngine:
         self.pool.positions[slot] = length
         self._toks[slot] = first
         self._pos[slot] = length
-        self._slot_req[slot] = req
         reason = self._stop_reason(req, first)
         if req.grammar is not None and req.grammar.done:
             reason = "stop"  # complete document beats a length finish
@@ -2544,13 +3208,15 @@ class ServeEngine:
             self._cover_decode(min(rounds * (k + 1), cfg.max_len))
             if self.pool.n_active == 0:
                 return []
+        # fault-plane site: the speculative block IS the decode dispatch
+        self._poke_site("decode")
         acap = cfg.sample_cap
         if mtp:
-            rows = 10 + acap + k
+            rows = 10 + acap + k + 1
         else:
             rows = (11 + acap + cfg.max_len
                     + (self.pool.pages_per_lane if self._paged else 0)
-                    + (1 if self._quant else 0))
+                    + (1 if self._quant else 0) + 1)
         state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
@@ -2588,7 +3254,10 @@ class ServeEngine:
             base = 11 + acap + cfg.max_len
             state[base:base + self.pool.pages_per_lane] = self.pool.table.T
         if self._quant:
-            state[-1] = self._eidx
+            state[-2] = self._eidx
+        # fault-plane poison row, always last; one-shot per dispatch
+        state[-1] = self._fault_row
+        self._fault_row[:] = 0
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
@@ -2627,7 +3296,9 @@ class ServeEngine:
             self.pool.phys, outs = res
         else:
             self.pool.caches, outs = res
-        out, commits, proposed, lps = outs
+        out, commits, proposed, lps, finite = outs
+        # fault-plane site: post-block output fetch / paged scatter
+        self._poke_site("scatter")
         t_dev = 0.0
         if tr is not None:
             jax.block_until_ready(out)
@@ -2637,6 +3308,7 @@ class ServeEngine:
         commits = np.asarray(commits)  # (rounds, S)
         proposed = np.asarray(proposed)
         lps = np.asarray(lps)
+        finite = np.asarray(finite)    # (S,) — the per-slot guard
         now = smetrics.now()
         finished: list[Request] = []
         tot_prop = tot_acc = tot_rounds = 0
@@ -2649,6 +3321,9 @@ class ServeEngine:
                 tr.complete("spec_block", "engine", f"slot{slot}",
                             ts=t_dec, dur=t_dev - t_dec, req=req.id,
                             rounds=rounds, k=k)
+            if not finite[slot]:
+                finished.append(self._quarantine(req, now))
+                continue
             if req.cancelled:
                 self._finish(req, "cancelled", now)
                 finished.append(req)
@@ -2735,9 +3410,13 @@ class ServeEngine:
             self._cover_decode(block)
             if self.pool.n_active == 0:
                 return []  # exhaustion preempted every stream this block
+        # fault-plane site: the decode-block dispatch (stall/synthetic
+        # errors apply here; nan/inf pokes write the per-slot fault row
+        # packed into THIS call's control transfer)
+        self._poke_site("decode")
         acap = cfg.sample_cap
         rows = (9 + acap + (self.pool.pages_per_lane if self._paged else 0)
-                + (1 if self._quant else 0))
+                + (1 if self._quant else 0) + 1)
         state = np.zeros((rows, cfg.n_slots), np.int32)
         state[0] = self._toks
         state[1] = self._pos
@@ -2766,8 +3445,12 @@ class ServeEngine:
             state[9 + acap:9 + acap + self.pool.pages_per_lane] = \
                 self.pool.table.T
         if self._quant:
-            # exact-lane indices ride last (0 = quantized/trash)
-            state[-1] = self._eidx
+            # exact-lane indices ride second-to-last (0 = quantized/trash)
+            state[-2] = self._eidx
+        # the fault-plane poison row is ALWAYS the last row (all-zero =
+        # bitwise no-op in the program); one-shot per dispatch
+        state[-1] = self._fault_row
+        self._fault_row[:] = 0
         self._rng_step += 1
         tr = self.trace
         t_dec = smetrics.now() if tr is not None else 0.0
@@ -2783,16 +3466,19 @@ class ServeEngine:
                 # IS the anomaly the registry exists to catch. Named
                 # after the trace span ("decode_block") so the offline
                 # roofline join in summarize_trace matches.
-                pool_tree, (out, lps) = self.registry.call(
+                pool_tree, (out, lps, finite) = self.registry.call(
                     "decode_block", (block,), prog, dec_args,
                     static_argnums=(0, 1, 2),
                 )
             else:
-                pool_tree, (out, lps) = prog(*dec_args)
+                pool_tree, (out, lps, finite) = prog(*dec_args)
         if self._paged:
             self.pool.phys = pool_tree
         else:
             self.pool.caches = pool_tree
+        # fault-plane site: the post-block output fetch / paged scatter
+        # boundary (where async XLA runtime errors actually surface)
+        self._poke_site("scatter")
         t_dev = 0.0
         if tr is not None:
             # fence so the span is device wall time, not dispatch time;
@@ -2803,6 +3489,7 @@ class ServeEngine:
             self._dev_s += t_dev - t_dec
         out = np.asarray(out)  # (block, n_slots); overshoot truncated below
         lps = np.asarray(lps)
+        finite = np.asarray(finite)  # (n_slots,) — the per-slot guard
         now = smetrics.now()
         finished: list[Request] = []
         for slot, req in enumerate(self._slot_req):
@@ -2814,6 +3501,12 @@ class ServeEngine:
                 tr.complete("decode_block", "engine", f"slot{slot}",
                             ts=t_dec, dur=t_dev - t_dec, req=req.id,
                             block=block)
+            if not finite[slot]:
+                # the guard pinned a NaN/Inf forward to this slot: its
+                # block output is garbage — contain it; every other
+                # slot's walk below proceeds untouched
+                finished.append(self._quarantine(req, now))
+                continue
             if req.cancelled:
                 # lifecycle kill at the block boundary: this block's
                 # output is discarded, the lane frees for the next pick
@@ -2885,6 +3578,22 @@ class ServeEngine:
         req.state = FINISHED
         req.finish_reason = reason
         req.finish_time = now
+        if req.first_token_time is None:
+            # finished before its first token ever landed (a quarantined
+            # or force-drained mid-admission request): close the
+            # lifecycle with a zero-width prefill phase so the traced
+            # three-span partition below never subtracts None
+            req.first_token_time = now
+            if self.trace is not None:
+                self.trace.complete("queue", "request", "queue",
+                                    ts=req.submit_time,
+                                    dur=(req.admit_time or now)
+                                    - req.submit_time, req=req.id)
+                self.trace.complete("prefill", "request",
+                                    f"slot{req.slot}",
+                                    ts=req.admit_time or now,
+                                    dur=now - (req.admit_time or now),
+                                    req=req.id)
         if self._paged and req.slot is not None:
             # page-usage fact for the request's debug timeline, stamped
             # before release frees the table (streams only grow, so the

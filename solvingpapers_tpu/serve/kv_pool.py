@@ -581,6 +581,69 @@ def _extract_program(caches, ctl, length):
     return jax.tree_util.tree_map(ext, caches)
 
 
+def _zero_batch_entry(a, idx):
+    """Zero batch entry `idx` of one cache leaf (traced `idx` — one
+    compiled scrub program per tree structure, not per slot)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        a, jnp.zeros((1,) + a.shape[1:], a.dtype), idx, axis=0
+    )
+
+
+@functools.partial(jax.jit, donate_argnames=("caches",))
+def scrub_lane_program(caches, slot, eidx):
+    """Quarantine decontamination (lane pools): zero slot `slot`'s lane
+    — and, on a quantized pool, its scale rows plus exact sidecar lane
+    `eidx` (0 = the trash lane, harmless to clear). The stale-data
+    contract above ("masked-softmax zeros annihilate stale values")
+    only holds for FINITE stale values: ``0 * NaN`` is NaN, so a lane a
+    NaN/Inf-poisoned forward wrote into would contaminate the next
+    stream admitted into it through the masked attention tail. Compiled
+    only when a quarantine actually fires — a fault-free engine never
+    traces it."""
+    if isinstance(caches, QuantStore):
+        exact = caches.exact
+        if exact is not None:
+            exact = jax.tree_util.tree_map(
+                lambda a: _zero_batch_entry(a, eidx), exact
+            )
+        return caches.replace(
+            q=jax.tree_util.tree_map(
+                lambda a: _zero_batch_entry(a, slot), caches.q),
+            scale=jax.tree_util.tree_map(
+                lambda a: _zero_batch_entry(a, slot), caches.scale),
+            exact=exact,
+        )
+    return jax.tree_util.tree_map(
+        lambda a: _zero_batch_entry(a, slot), caches
+    )
+
+
+@functools.partial(jax.jit, donate_argnames=("phys",))
+def scrub_pages_program(phys, row, eidx):
+    """Quarantine decontamination (paged pools): zero the physical pages
+    listed in `row` — a fixed-length id vector holding the quarantined
+    slot's exclusively-owned pages padded with the trash page, which is
+    therefore ALWAYS scrubbed too (the poisoned slot's masked overshoot
+    writes land there, and a non-finite trash page would leak into every
+    slot's masked gather tail). Duplicate ids are idempotent zero
+    writes. Shared (refcount > 1) pages are excluded by the caller: they
+    hold prompt-prefix KV written strictly before the poisoned step and
+    other holders still read them."""
+    if isinstance(phys, QuantStore):
+        exact = phys.exact
+        if exact is not None:
+            exact = jax.tree_util.tree_map(
+                lambda a: _zero_batch_entry(a, eidx), exact
+            )
+        return phys.replace(
+            q=jax.tree_util.tree_map(lambda a: a.at[row].set(0), phys.q),
+            scale=jax.tree_util.tree_map(
+                lambda a: a.at[row].set(0), phys.scale),
+            exact=exact,
+        )
+    return jax.tree_util.tree_map(lambda a: a.at[row].set(0), phys)
+
+
 @functools.partial(jax.jit, donate_argnames=("caches",))
 def _quant_splice_program(caches, segment, ctl):
     """Quantized splice: the segment's int8 payload lands at

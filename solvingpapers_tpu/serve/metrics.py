@@ -166,6 +166,15 @@ class ServeMetrics:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_tokens = 0
+        self.faults_injected = 0
+        self.quarantines = 0
+        self.engine_retries = 0
+        self.engine_unhealthy = 0
+        self.watchdog_stalls = 0
+        self.recoveries = 0
+        self.recovery_s_last = 0.0
+        self.degrade_transitions = 0
+        self.sheds: dict[str, int] = {}
         self._t_first: float | None = None
         self._t_last: float | None = None
         # zero-arg dict providers merged into every snapshot — how the
@@ -260,6 +269,42 @@ class ServeMetrics:
         self.spec_accepted += accepted
         self.spec_tokens += delivered
 
+    def record_fault_injected(self) -> None:
+        """The fault plan fired one spec at a hot-path site."""
+        self.faults_injected += 1
+
+    def record_quarantine(self) -> None:
+        """A NaN/Inf-poisoned slot was contained (its request finished
+        "error"; every other stream continued)."""
+        self.quarantines += 1
+
+    def record_engine_retry(self) -> None:
+        """A systemic step failure consumed one pool-rebuild retry."""
+        self.engine_retries += 1
+
+    def record_engine_unhealthy(self) -> None:
+        """Retries exhausted: the engine drained to `unhealthy`."""
+        self.engine_unhealthy += 1
+
+    def record_watchdog_stall(self, dur_s: float) -> None:
+        """A step exceeded the absolute watchdog deadline (`dur_s` is
+        carried by the trace instant / anomaly dump, not a gauge)."""
+        self.watchdog_stalls += 1
+
+    def record_recovery(self, dur_s: float) -> None:
+        """First clean step after a failure episode: `dur_s` = first
+        failure -> first clean step (the serve/fault_recovery_s gauge)."""
+        self.recoveries += 1
+        self.recovery_s_last = dur_s
+
+    def record_degrade_transition(self) -> None:
+        """The degradation ladder moved one rung (either direction)."""
+        self.degrade_transitions += 1
+
+    def record_shed(self, slo_class: str) -> None:
+        """An admission was load-shed by SLO class (ladder rung >= 3)."""
+        self.sheds[slo_class] = self.sheds.get(slo_class, 0) + 1
+
     def record_recompute_tokens(self, n: int) -> None:
         """Prompt+stream tokens re-prefilled by a preempted request's
         resume — the compute cost of preemption-by-recompute."""
@@ -292,6 +337,27 @@ class ServeMetrics:
         if self.preemptions:
             out["serve/preemptions"] = float(self.preemptions)
             out["serve/recompute_tokens"] = float(self.recompute_tokens)
+        # fault-tolerance counters: present iff the event family ever
+        # occurred (the serve/preemptions discipline — a fault-free run
+        # keeps its key surface identical to the pre-fault engine's)
+        if self.faults_injected:
+            out["serve/fault_injected"] = float(self.faults_injected)
+        if self.quarantines:
+            out["serve/fault_quarantined"] = float(self.quarantines)
+        if self.engine_retries:
+            out["serve/fault_retries"] = float(self.engine_retries)
+        if self.engine_unhealthy:
+            out["serve/fault_unhealthy"] = float(self.engine_unhealthy)
+        if self.watchdog_stalls:
+            out["serve/watchdog_stalls"] = float(self.watchdog_stalls)
+        if self.recoveries:
+            out["serve/fault_recovery_s"] = float(self.recovery_s_last)
+        if self.degrade_transitions:
+            out["serve/degrade_transitions"] = float(
+                self.degrade_transitions
+            )
+        for cls in sorted(self.sheds):
+            out[f"serve/shed_{cls}"] = float(self.sheds[cls])
         elapsed = self.elapsed_s
         if elapsed > 0:
             out["serve/tokens_per_sec"] = self.tokens_out / elapsed

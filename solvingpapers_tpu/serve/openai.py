@@ -36,8 +36,13 @@ from solvingpapers_tpu.serve.sampling import SamplingParams
 # OpenAI finish_reason values; engine reasons outside the standard set
 # ("timeout") pass through as extensions — a client that only switches
 # on "stop"/"length" treats them as an unknown terminal state, which is
-# exactly what they are
-_FINISH_MAP = {"eos": "stop", "stop": "stop", "length": "length"}
+# exactly what they are. "error" maps EXPLICITLY (not by fallthrough):
+# it is the engine's failure-isolation contract — a quarantined or
+# engine-failed stream ends with finish_reason "error" plus a
+# structured error event (see `error_event` and serve/api.py's SSE
+# error protocol), never a silently dropped connection.
+_FINISH_MAP = {"eos": "stop", "stop": "stop", "length": "length",
+               "error": "error"}
 
 
 class ApiError(Exception):
@@ -67,6 +72,24 @@ def finish_reason(engine_reason: str | None) -> str | None:
     if engine_reason is None:
         return None
     return _FINISH_MAP.get(engine_reason, engine_reason)
+
+
+def error_event(message: str, err_type: str = "server_error",
+                code: str | None = "engine_error") -> dict:
+    """Mid-stream SSE error payload: the OpenAI error envelope as a
+    `data:` event. Sent when a stream that already holds a 200 + SSE
+    headers fails server-side (engine quarantine, engine-loop death, a
+    rendering bug) — the client gets a STRUCTURED terminal error, then
+    the finish chunk with ``finish_reason: "error"`` and ``[DONE]``,
+    instead of a connection that just drops."""
+    return {
+        "error": {
+            "message": message,
+            "type": err_type,
+            "param": None,
+            "code": code,
+        }
+    }
 
 
 def _field(body: dict, name: str, types, default, param=None):

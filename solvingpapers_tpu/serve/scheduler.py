@@ -95,6 +95,11 @@ class Request:
     # set by engine.cancel on an ACTIVE request; the lane is freed (and
     # the request finished "cancelled") at the next block boundary
     cancelled: bool = False
+    # why a REJECTED request bounced beyond a full queue: "unhealthy"
+    # (the engine is draining after persistent failures) or
+    # "shed:<class>" (the degradation ladder load-shed its SLO class) —
+    # the HTTP front door words its 503 envelope from this
+    reject_reason: str | None = None
     # grammar-constrained decoding (serve/grammar.py JsonStepper or any
     # object with allowed(budget)/advance(tok)/done): the engine packs
     # its allowed-token list into the jitted programs' allow-mask and

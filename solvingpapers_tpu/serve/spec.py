@@ -343,6 +343,15 @@ class SpecController:
             return "probe"
         return "full"
 
+    def hold(self, steps: int) -> None:
+        """External hold (the degradation ladder's rung 2): force plain
+        blocks for at least `steps` upcoming decode steps WITHOUT
+        touching the acceptance EMA or the backoff schedule — when the
+        ladder steps back down, the controller resumes exactly the
+        adaptive state it held before the squeeze."""
+        if steps > 0:
+            self._hold = max(self._hold, steps)
+
     def observe(self, accepted: int, rounds: int) -> None:
         """Feed one spec call's outcome (accepted drafts over `rounds`
         draft-verify rounds across the drafting slots)."""

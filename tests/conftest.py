@@ -32,3 +32,42 @@ def devices():
     if len(devs) != 8:
         pytest.skip(f"needs the 8-virtual-device CPU mesh, have {len(devs)}")
     return devs
+
+
+def assert_no_leaks(eng):
+    """The serve engine's drained-pool leak invariant, shared across
+    the paged-pool, fault/quarantine and degradation suites (apply
+    after every test drain): every slot back on the free list with a
+    consistent `_free_mask`; on paged pools every page back on the free
+    list (the prefix tree — the one legitimate post-drain holder — is
+    fully evicted first), the refcount sum back at the trash page's
+    permanent 1, and the free list exactly the zero-refcount pages; on
+    quantized pools the exact-lane free list intact."""
+    pool = eng.pool
+    assert pool.n_active == 0, "slots still active after drain"
+    assert pool._free_mask.all(), "slot leaked (_free_mask inconsistent)"
+    assert sorted(pool._free) == list(range(pool.n_slots)), \
+        "slot free list leaked or duplicated"
+    assert all(r is None for r in eng._slot_req), \
+        "engine slot mirror still holds a request"
+    if eng.prefix_cache is not None:
+        while eng.prefix_cache.evict_one():
+            pass
+    if hasattr(pool, "refcount"):  # paged pool
+        assert pool.pages_free == pool.page_budget, (
+            f"pages leaked: {pool.pages_free} free of "
+            f"{pool.page_budget} budgeted"
+        )
+        assert int(pool.refcount.sum()) == 1, (
+            "refcounts leaked (expected only the trash page's "
+            f"permanent hold): sum={int(pool.refcount.sum())}"
+        )
+        free = set(pool._free_pages)
+        zero = {p for p in range(1, pool.n_pages)
+                if pool.refcount[p] == 0}
+        assert free == zero, "free list != zero-refcount pages"
+        assert len(pool._free_pages) == len(free), "duplicate free entries"
+    if getattr(pool, "exact_lanes", 0):
+        assert sorted(eng._exact_free) == list(
+            range(1, pool.exact_lanes + 1)
+        ), "exact-lane free list leaked"

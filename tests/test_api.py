@@ -582,3 +582,176 @@ def test_400_envelope_carries_request_id(traced_server):
         assert e.headers.get("X-Request-Id") == "err-1"
         assert json.loads(e.read())["error"]["type"] == \
             "invalid_request_error"
+
+
+# ------------------------------------------------------- fault tolerance
+
+
+from conftest import assert_no_leaks  # noqa: E402
+
+
+def _fault_server(gpt_tiny, plan, **cfg_kw):
+    model, params = gpt_tiny
+    base = dict(n_slots=2, max_len=128, decode_block=4, bucket=8,
+                api_port=0, fault_plan=plan)
+    base.update(cfg_kw)
+    eng = ServeEngine(model, params, ServeConfig(**base),
+                      detokenize=_decode)
+    srv = ApiServer(eng, encode=_encode, decode=_decode,
+                    model_name="gpt-tiny")
+    return srv, eng
+
+
+def test_sse_error_protocol_on_quarantine(gpt_tiny):
+    """The mid-stream error contract: a quarantined stream must end
+    with a structured OpenAI error event, a terminal chunk carrying
+    finish_reason "error", and [DONE] — never a silently dropped
+    connection."""
+    plan = [dict(site="decode", kind="nan", visit=1, slot=0)]
+    srv, eng = _fault_server(gpt_tiny, plan)
+    try:
+        events = _stream_events(srv, {
+            "prompt": list(range(20, 28)), "max_tokens": 24,
+            "temperature": 0,
+        })
+        assert events[-1] == "DONE", "stream must terminate cleanly"
+        err_events = [e for e in events[:-1] if "error" in e]
+        assert err_events, "no structured error event before [DONE]"
+        assert err_events[0]["error"]["type"] == "server_error"
+        terminal = [e for e in events[:-1] if "choices" in e
+                    and e["choices"][0]["finish_reason"]]
+        assert terminal and \
+            terminal[-1]["choices"][0]["finish_reason"] == "error"
+        assert_no_leaks(eng)
+    finally:
+        srv.close()
+
+
+def test_injected_socket_reset_drives_disconnect_cancel(gpt_tiny):
+    """A socket_reset fault at the sse_write site maps to the
+    disconnect path: the engine cancels at the block boundary and the
+    drained pool leaks nothing."""
+    plan = [dict(site="sse_write", kind="socket_reset", visit=1)]
+    srv, eng = _fault_server(gpt_tiny, plan, paged=True, page_size=8)
+    try:
+        events = _stream_events(srv, {
+            "prompt": list(range(16, 24)), "max_tokens": 64,
+            "temperature": 0,
+        })
+        assert "DONE" not in events, "reset stream cannot complete"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            snap = eng.metrics.snapshot()
+            if snap.get("serve/finish_cancelled"):
+                break
+            time.sleep(0.02)
+        assert eng.metrics.snapshot().get("serve/finish_cancelled") == 1.0
+        assert snap.get("serve/fault_injected") == 1.0
+        deadline = time.monotonic() + 10
+        while eng.pool.n_active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with srv.loop.lock:
+            assert_no_leaks(eng)
+    finally:
+        srv.close()
+
+
+def test_retry_after_is_jittered_and_carries_rung(gpt_tiny):
+    """503s must not synchronize retry herds: the Retry-After hint is
+    drawn per response (observably non-constant over a handful of
+    draws) and the current degradation rung rides a response header."""
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=128, decode_block=4, bucket=8, api_port=0,
+        max_waiting=1,
+    ), detokenize=_decode)
+    loop = EngineLoop(eng, start=False)  # engine never steps: queue fills
+    srv = ApiServer(eng, encode=_encode, decode=_decode,
+                    model_name="gpt-tiny", loop=loop)
+    try:
+        # fill the 1-deep waiting queue directly (the loop never steps,
+        # so it stays full and every HTTP submission bounces 503)
+        srv.loop.submit(np.asarray([1, 2, 3], np.int32),
+                        max_new_tokens=4)
+        hints = set()
+        for _ in range(12):
+            st, hdrs, doc = _post(srv, "/v1/completions",
+                                  {"prompt": [1, 2, 3], "max_tokens": 4})
+            if st != 503:
+                continue
+            assert doc["error"]["code"] == "overloaded"
+            assert hdrs.get("X-Degradation-Rung") == "0"
+            retry = int(hdrs["Retry-After"])
+            assert 1 <= retry <= 4
+            hints.add(retry)
+        assert len(hints) > 1, f"Retry-After never varied: {hints}"
+    finally:
+        srv.close()
+
+
+def test_unhealthy_engine_503s_then_recovers_token_exact(gpt_tiny):
+    """End-to-end recovery through the front door: persistent systemic
+    faults drain the engine (blocking response = 500 envelope, /healthz
+    = 503, new submissions = 503 engine_unhealthy), and after the
+    backoff a fresh HTTP request streams token-exactly vs direct
+    submit on the recovered engine."""
+    plan = [dict(site="decode", kind="xla_error", visit=0, count=2)]
+    srv, eng = _fault_server(
+        gpt_tiny, plan, fault_max_retries=1, fault_retry_backoff_s=0.001,
+        fault_recover_backoff_s=0.6,
+    )
+    try:
+        prompt = list(range(30, 38))
+        st, _, doc = _post(srv, "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 12,
+                            "temperature": 0})
+        assert st == 500, (st, doc)
+        assert doc["error"]["code"] == "engine_error"
+        with urllib.request.urlopen(srv.url("/healthz"),
+                                    timeout=30) as r:
+            raise AssertionError(f"healthz answered {r.status}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503 and e.read() == b"unhealthy\n"
+        # inside the backoff: the front door sheds with the reason
+        st, hdrs, doc = _post(srv, "/v1/completions",
+                              {"prompt": prompt, "max_tokens": 12,
+                               "temperature": 0})
+        assert st == 503 and doc["error"]["code"] == "engine_unhealthy"
+        assert "Retry-After" in hdrs
+        time.sleep(0.65)
+        st, _, doc = _post(srv, "/v1/completions",
+                           {"prompt": prompt, "max_tokens": 12,
+                            "temperature": 0})
+        assert st == 200, (st, doc)
+        assert doc["choices"][0]["finish_reason"] == "length"
+        with urllib.request.urlopen(srv.url("/healthz"),
+                                    timeout=30) as r:
+            assert r.status == 200
+        # token-exact vs direct submit on the recovered engine
+        ref = srv.loop.submit(np.asarray(prompt, np.int32),
+                              max_new_tokens=12)
+        deadline = time.monotonic() + 60
+        while not ref.done and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ref.done
+        assert doc["choices"][0]["text"] == _decode(ref.tokens)
+    finally:
+        srv.close()
+
+
+def test_server_close_bounded_under_injected_stall(gpt_tiny):
+    """SIGTERM cannot hang on a wedged request: with every step
+    stalling, ApiServer.close() force-cancels and returns within its
+    bound instead of waiting out 64 stalled steps."""
+    plan = [dict(site="decode", kind="stall", visit=0, stall_s=0.3,
+                 count=1000)]
+    srv, eng = _fault_server(gpt_tiny, plan, drain_timeout_s=0.2)
+    req = srv.loop.submit(np.asarray(list(range(8)), np.int32),
+                          max_new_tokens=64)
+    time.sleep(0.2)  # let the loop start stepping (and stalling)
+    t0 = time.monotonic()
+    srv.close()
+    took = time.monotonic() - t0
+    assert took < 6.0, f"close took {took:.1f}s — unbounded shutdown"
+    assert req.done
+    assert_no_leaks(eng)

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from conftest import assert_no_leaks
 from solvingpapers_tpu.infer import generate
 from solvingpapers_tpu.infer.cache import KVCache
 from solvingpapers_tpu.serve import PagedKVPool, ServeConfig, ServeEngine
@@ -220,8 +221,8 @@ def test_preemption_recompute_streams_token_exact():
     snap = eng.metrics.snapshot()
     assert snap["serve/preemptions"] >= 1, "budget never forced preemption"
     assert snap["serve/recompute_tokens"] > 0
-    # drained engine: every page back on the free list
-    assert eng.pool.pages_free == eng.pool.page_budget
+    # drained engine: every page/slot back on the free lists
+    assert_no_leaks(eng)
 
 
 def test_paged_prefix_hit_dispatches_no_splice_program():
@@ -310,6 +311,7 @@ def test_tree_hoarded_pages_never_livelock_admission():
         eng.step()
     assert h1.done, "page-starved head was never admitted (livelock)"
     assert h1.tokens == _ref_stream(model, params, b, 4)
+    assert_no_leaks(eng)
 
 
 def test_paged_engine_validates_config():

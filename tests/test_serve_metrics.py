@@ -263,6 +263,38 @@ def test_preemption_keys_present_iff_observed():
     assert snap["serve/tokens_prefilled"] == 24.0
 
 
+def test_fault_keys_present_iff_observed():
+    """The fault-tolerance counter family (serve/fault_*,
+    serve/watchdog_stalls, serve/shed_<class>, serve/degrade_transitions)
+    rides the snapshot only once its event happened — a fault-free run's
+    key surface is byte-identical to the pre-fault engine's."""
+    m = ServeMetrics()
+    base = m.snapshot()
+    fault_prefixes = ("serve/fault", "serve/watchdog", "serve/shed_",
+                      "serve/degrade")
+    assert not [k for k in base if k.startswith(fault_prefixes)]
+    m.record_fault_injected()
+    m.record_quarantine()
+    m.record_engine_retry()
+    m.record_engine_unhealthy()
+    m.record_watchdog_stall(1.25)
+    m.record_recovery(0.5)
+    m.record_degrade_transition()
+    m.record_shed("batch")
+    m.record_shed("batch")
+    snap = m.snapshot()
+    assert snap["serve/fault_injected"] == 1.0
+    assert snap["serve/fault_quarantined"] == 1.0
+    assert snap["serve/fault_retries"] == 1.0
+    assert snap["serve/fault_unhealthy"] == 1.0
+    assert snap["serve/watchdog_stalls"] == 1.0
+    assert snap["serve/fault_recovery_s"] == 0.5
+    assert snap["serve/degrade_transitions"] == 1.0
+    assert snap["serve/shed_batch"] == 2.0
+    # every key must survive the Prometheus name sanitizer
+    PrometheusTextWriter.render(0, snap)
+
+
 def test_page_gauges_present_iff_paged_engine():
     """serve/pages_* appear exactly when the engine runs the paged pool
     (the engine registers a gauge provider, same mechanism as the

@@ -54,7 +54,9 @@ SCALE_KEYS = ("config", "n_requests", "n_slots", "max_new_tokens",
               "decode_block")
 
 # booleans that must never regress to False
-BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact")
+BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
+               "survivors_token_exact", "zero_leak", "ladder_zero_leak",
+               "slots_clean")
 
 # name-pattern -> (kind, higher_is_better); first match wins.
 # kind: "pct" = absolute percentage-point band — overheads hover near 0
@@ -70,8 +72,10 @@ _RULES: tuple[tuple[tuple[str, ...], str, bool], ...] = (
     (("agreement_rate", "acceptance_rate", "hit_rate", "attainment",
       "goodput_ratio"), "rate", True),
     (("requests_per_sec", "tokens_per_sec", "tokens_per_step",
-      "speedup", "peak_active_slots"), "rel", True),
-    (("ttft", "itl_", "_itl", "e2e_", "compile_time_s"), "rel", False),
+      "speedup", "peak_active_slots", "streams_survived",
+      "goodput_ladder_ratio"), "rel", True),
+    (("ttft", "itl_", "_itl", "e2e_", "compile_time_s",
+      "fault_recovery_s"), "rel", False),
     (("hbm_bytes", "pool_bytes", "temp_bytes"), "rel", False),
 )
 

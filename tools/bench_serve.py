@@ -36,7 +36,15 @@ and with `--slo --append` for the SLO-observatory workload (per-request
 SLO classes — interactive/standard/batch — through an slo_targets
 engine, ABBA-paired against the plain engine: slo_overhead_pct,
 per-class attainment/burn, and goodput_tokens_per_s, the tokens
-delivered inside their latency targets).
+delivered inside their latency targets),
+
+and with `--chaos --append` for the fault-tolerance soak (one seeded
+fault schedule — NaN/Inf slot poisons, synthetic XlaRuntimeError + OOM,
+a step stall — through a fault-free reference, a ladder-off chaos arm
+and a degradation-ladder arm: streams_survived, survivor
+token-exactness, fault_recovery_s, the zero-leak drain invariant,
+goodput ladder-on vs ladder-off, and the ABBA-paired armed-but-quiet
+fault_overhead_pct).
 
 Every entry records the `kv_dtype` / `kv_pool_bytes` /
 `greedy_agreement_rate` triple (exact pools report their compute dtype
