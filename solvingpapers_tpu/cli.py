@@ -12,6 +12,7 @@ star: "every notebook's train() cell becomes a CLI entrypoint"):
                [--port 8000] — OpenAI-compatible /v1/completions +
                /v1/chat/completions (SSE streaming, json_object mode)
     cli serve-bench --config llama3_shakespeare [--trace] [--http]
+    cli kernel-bench [--config gpt_shakespeare] [--out BENCH_kernels.json]
     cli trace-summary serve_trace.json [--top 10]
 """
 
@@ -591,6 +592,19 @@ def cmd_serve_bench(args) -> int:
         status_port=args.status_port,
         status_hold_s=args.status_hold_s,
     )
+    if args.obs_hlo_dir:
+        if any((args.shared_prefix, args.sampling, args.paged, args.http,
+                args.speculative, args.slo, args.chaos,
+                args.kv_quant is not None)):
+            # say so instead of silently dropping the flag — a user
+            # waiting on dumps should not debug an empty directory
+            print("--obs-hlo-dir only dumps from the Poisson workload's "
+                  "probe engine; ignoring it for this workload (use "
+                  "ServeConfig.obs_hlo_dir directly elsewhere)",
+                  file=sys.stderr)
+        else:
+            # Poisson workload: the probe engine is the one that dumps
+            trace_kwargs["obs_hlo_dir"] = args.obs_hlo_dir
     if args.kv_quant:
         result = run_quant_bench(
             config=args.config,
@@ -734,6 +748,44 @@ def cmd_serve_bench(args) -> int:
     return 0
 
 
+def cmd_kernel_bench(args) -> int:
+    """Microbench the serving stack's hot inner ops in isolation over
+    the full (pool layout x kv_quant) grid and print/write one
+    BENCH_kernels.json entry per grid cell (serve/kernel_bench.py)."""
+    from solvingpapers_tpu.serve.bench import bench_provenance
+    from solvingpapers_tpu.serve.kernel_bench import run_kernel_bench
+
+    entries = run_kernel_bench(
+        config=args.config,
+        n_slots=args.slots,
+        max_len=args.max_len,
+        page_size=args.page_size,
+        quant_block=args.kv_quant_block,
+        sample_cap=args.sample_cap,
+        spec_k=args.spec_k,
+        decode_block=args.decode_block,
+        reps=args.reps,
+        seed=args.seed,
+    )
+    # one provenance stamp per RUN (the serve-bench discipline: the
+    # timestamp is injected at the single write site, so the grid's
+    # four entries share one clock reading and one git sha)
+    import time as _time
+
+    prov = bench_provenance(timestamp=_time.time())
+    lines = [json.dumps({**prov, **e}) for e in entries]
+    for line in lines:
+        print(line)
+    if args.out:
+        with open(args.out, "a" if args.append else "w") as f:
+            for line in lines:
+                f.write(line + "\n")
+        verb = "appended to" if args.append else "wrote"
+        print(f"[kernel-bench] {verb} {args.out} "
+              f"({len(lines)} entries)", file=sys.stderr)
+    return 0
+
+
 def cmd_trace_summary(args) -> int:
     """Rebuild per-request timelines from a Chrome trace-event JSON the
     flight recorder exported (`serve-bench --trace`,
@@ -779,20 +831,22 @@ def cmd_trace_summary(args) -> int:
     # request-less traces: a train trace keeps its per-phase summary even
     # when the observatory also recorded compile events — the roofline
     # and mesh (bubble/comm) sections ride along instead of displacing it
+    from solvingpapers_tpu.metrics.hlo_cost import format_anatomy
     from solvingpapers_tpu.metrics.trace import format_mesh, format_roofline
 
     train = summarize_train_trace(args.trace)
     roofline = format_roofline(summary.get("programs") or {})
+    anatomy = format_anatomy(summary.get("anatomy") or {})
     mesh = format_mesh(summary.get("mesh"))
     if train is not None:
         print(format_train_summary(train))
-        for section in (roofline, mesh):
+        for section in (roofline, anatomy, mesh):
             if section:
                 print()
                 print(section)
         return 0
-    if roofline or mesh:
-        print("\n\n".join(s for s in (roofline, mesh) if s))
+    if roofline or anatomy or mesh:
+        print("\n\n".join(s for s in (roofline, anatomy, mesh) if s))
         return 0
     print(
         f"{args.trace} holds neither request lifecycle events "
@@ -1124,6 +1178,52 @@ def main(argv=None) -> int:
                          help="[--status-port] keep the status endpoint "
                               "up this many seconds after the arms "
                               "finish (CI curl window)")
+    p_serve.add_argument("--obs-hlo-dir", default=None,
+                         help="dump each compiled program's HLO text "
+                              "here from the observatory probe engine "
+                              "(ServeConfig.obs_hlo_dir: one file per "
+                              "signature, atomic writes) so the anatomy "
+                              "ledger's claims can be diffed offline; "
+                              "Poisson workload only")
+
+    p_kern = sub.add_parser(
+        "kernel-bench",
+        help="fenced min-of-reps microbenchmarks of the serving stack's "
+             "hot inner ops — gather/scatter/quant-roundtrip/splice/"
+             "sample/spec-verify over the (pool layout x kv_quant) grid "
+             "(serve/kernel_bench.py; tools/bench_kernels.py defaults "
+             "--out BENCH_kernels.json)",
+    )
+    p_kern.add_argument("--config", default="gpt_shakespeare",
+                        help="registered decoder config whose cache "
+                             "shapes the ops are benched at (default "
+                             "gpt_shakespeare — the paged bench's "
+                             "model)")
+    p_kern.add_argument("--slots", type=int, default=8)
+    p_kern.add_argument("--max-len", type=int, default=256,
+                        help="lane length in tokens (rounded down to "
+                             "the page/quant-block grain and the "
+                             "model's position budget)")
+    p_kern.add_argument("--page-size", type=int, default=16)
+    p_kern.add_argument("--kv-quant-block", type=int, default=16)
+    p_kern.add_argument("--sample-cap", type=int, default=64)
+    p_kern.add_argument("--spec-k", type=int, default=4,
+                        help="draft width of the speculative 1+k verify "
+                             "window op")
+    p_kern.add_argument("--decode-block", type=int, default=16,
+                        help="recorded knob: sets the decomposition's "
+                             "scatter multiplier — the paged decode "
+                             "program runs (decode_block-1)//page_size "
+                             "+ 2 write-back windows per call")
+    p_kern.add_argument("--reps", type=int, default=5,
+                        help="fenced repetitions per op (min is kept)")
+    p_kern.add_argument("--seed", type=int, default=0)
+    p_kern.add_argument("--out", default=None,
+                        help="also write the JSON-lines entries here "
+                             "(tools/bench_kernels.py default: "
+                             "BENCH_kernels.json)")
+    p_kern.add_argument("--append", action="store_true",
+                        help="append to --out instead of overwriting")
 
     p_srv = sub.add_parser("serve")
     _add_common(p_srv)
@@ -1217,7 +1317,9 @@ def main(argv=None) -> int:
     p_export.add_argument("--out", required=True)
 
     args = parser.parse_args(argv)
-    if args.cmd not in ("list", "trace-summary"):
+    # kernel-bench skips _apply_platform: it takes no _add_common flags
+    # (no data/checkpoint plumbing) — set JAX_PLATFORMS in the env
+    if args.cmd not in ("list", "trace-summary", "kernel-bench"):
         # before any command code touches jax (see _apply_platform docstring)
         _apply_platform(args)
     return {
@@ -1226,6 +1328,7 @@ def main(argv=None) -> int:
         "sample": cmd_sample,
         "serve": cmd_serve,
         "serve-bench": cmd_serve_bench,
+        "kernel-bench": cmd_kernel_bench,
         "trace-summary": cmd_trace_summary,
         "eval": cmd_eval,
         "export": cmd_export,

@@ -26,6 +26,10 @@ from solvingpapers_tpu.metrics.mfu import (
     mfu,
     active_param_count,
 )
+from solvingpapers_tpu.metrics.hlo_cost import (
+    format_anatomy,
+    parse_hlo_costs,
+)
 from solvingpapers_tpu.metrics.xla_obs import (
     CompileRegistry,
     HBMLedger,

@@ -1,0 +1,303 @@
+"""Per-op HLO cost ledger: the program-anatomy half of the observatory.
+
+`metrics/xla_obs.py` records each compiled program's `cost_analysis()`
+TOTALS — one flops number, one bytes number per program. That is enough
+to rank programs against each other but useless for the question ROADMAP
+item 1 (the fused paged-attention kernel) has to answer: of the paged
+decode program's cost, how much is the full-lane page GATHER, how much
+the int8 dequant CONVERTs, how much the written-page SCATTER, and how
+much the attention/MLP dots the kernel must keep? This module parses the
+compiled program's HLO text (`compiled.as_text()`, the same line-scan
+discipline as `metrics.mesh_obs.parse_hlo_collectives`) into a per-op-
+CATEGORY ledger:
+
+    gather / scatter / dot / convert / fusion / dynamic-slice /
+    custom-call / parameter / other
+
+with three numbers per category — op count, estimated flops, and
+output-shape bytes — plus the top-k heaviest NAMED ops (with their
+jax-level `metadata op_name` source when the compiler kept it), so an
+"opaque 27% tax" becomes "%gather.12, 5.2 MB output, from
+jit(decode)/gather_lanes/gather".
+
+Conventions (shared with the collective ledger, documented here once):
+
+* Counts are STATIC — an op inside a `while` body (the decode scan)
+  counts once, not per trip. The ledger answers "which ops, how big",
+  not cycle-exact totals.
+* Bytes are the op's OUTPUT shape bytes (tuple outputs summed) — a
+  uniform traffic proxy across op kinds. `parameter` ops in the ENTRY
+  computation are counted (their "output" is the argument the program
+  reads), so the all-category bytes total approximates cost_analysis's
+  operand+output "bytes accessed"; parameters of fused/sub-computations
+  alias an already-counted operand and are skipped.
+* Flops follow XLA's own cost-analysis conventions closely enough to
+  reconcile on simple programs (pinned in tests/test_hlo_cost.py):
+  elementwise/transcendental ops count one flop per output element,
+  `dot` counts ``2 * output_elems * contraction_size`` (contraction
+  parsed from the operand shape + `lhs_contracting_dims`), `reduce`
+  counts its input elements, and pure data movement (gather, scatter,
+  slice, broadcast, copy, bitcast, parameter, ...) counts zero. A
+  `fusion` op's flops live on the INNER ops of its fused computation
+  (which the scan also walks); the fusion line itself contributes only
+  its output bytes — the buffer the fusion materializes.
+
+Nothing here imports jax: the input is a string, so the parser is unit-
+testable on crafted HLO and usable offline on `obs_hlo_dir` dumps.
+"""
+
+from __future__ import annotations
+
+import re
+
+# category order is the display order everywhere (statusz, trace
+# summary, README table) — the paged-tax story first, remainder last
+CATEGORIES = (
+    "gather",
+    "scatter",
+    "dot",
+    "convert",
+    "fusion",
+    "dynamic-slice",
+    "custom-call",
+    "parameter",
+    "other",
+)
+
+_CATEGORY_OF = {
+    "gather": "gather",
+    "scatter": "scatter",
+    "select-and-scatter": "scatter",
+    "dot": "dot",
+    "convolution": "dot",
+    "convert": "convert",
+    "fusion": "fusion",
+    "dynamic-slice": "dynamic-slice",
+    "dynamic-update-slice": "dynamic-slice",
+    "custom-call": "custom-call",
+    "parameter": "parameter",
+}
+
+# data movement / bookkeeping: zero flops (the XLA cost-analysis
+# convention the reconciliation test pins). Everything not listed and
+# not special-cased (dot, reduce) counts one flop per output element.
+_ZERO_FLOP_OPS = frozenset({
+    "parameter", "constant", "broadcast", "bitcast", "bitcast-convert",
+    "reshape", "transpose", "copy", "copy-start", "copy-done", "tuple",
+    "get-tuple-element", "gather", "scatter", "dynamic-slice",
+    "dynamic-update-slice", "slice", "concatenate", "pad", "iota",
+    "reverse", "after-all", "all-reduce", "all-gather", "reduce-scatter",
+    "all-to-all", "collective-permute", "fusion", "custom-call", "call",
+    "while", "conditional", "optimization-barrier", "domain", "send",
+    "recv", "send-done", "recv-done", "infeed", "outfeed",
+    "partition-id", "replica-id", "rng-bit-generator", "get-dimension-size",
+})
+
+# "%name = <output shape(s)> <op>(" — defining occurrences only, the
+# parse_hlo_collectives discipline: operand references live inside the
+# parens of another op's definition and never follow " = ".
+_DEF_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[^\s=]+)\s*=\s*"
+    r"(?P<out>\([^)]*\)|\S+)\s+"
+    r"(?P<op>[a-z][a-z0-9\-]*)\("
+)
+
+_SHAPE_RE = re.compile(
+    r"(?P<dt>[a-z]\d*[a-z0-9]*|pred)\[(?P<dims>[\d,]*)\]"
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{(?P<dims>[\d,]*)\}")
+_OP_NAME_RE = re.compile(r'op_name="(?P<src>[^"]*)"')
+
+
+def _atom_elems_bytes(dt: str, dims: str) -> tuple[int, int]:
+    nbytes = _DTYPE_BYTES.get(dt)
+    if nbytes is None:
+        digits = re.search(r"(\d+)$", dt)
+        nbytes = max(int(digits.group(1)) // 8, 1) if digits else 4
+    n = 1
+    if dims:
+        for d in dims.split(","):
+            n *= int(d)
+    return n, n * nbytes
+
+
+def _shape_elems_bytes(text: str) -> tuple[int, int]:
+    """(total elements, total bytes) of every shape atom in `text` —
+    a single shape, or a tuple shape summed."""
+    elems = 0
+    nbytes = 0
+    for m in _SHAPE_RE.finditer(text):
+        e, b = _atom_elems_bytes(m.group("dt"), m.group("dims"))
+        elems += e
+        nbytes += b
+    return elems, nbytes
+
+
+def classify_op(op: str) -> str:
+    """HLO opcode -> ledger category (CATEGORIES)."""
+    return _CATEGORY_OF.get(op, "other")
+
+
+def _dot_flops(line: str, tail: str, out_elems: int) -> int:
+    """``2 * output_elems * contraction_size`` with the contraction
+    parsed from the first operand's shape atom + lhs_contracting_dims;
+    falls back to ``2 * output_elems`` when either is absent (elided
+    operand shapes in minimized dumps)."""
+    lhs = _SHAPE_RE.search(tail)
+    contract = _CONTRACT_RE.search(line)
+    if lhs is None or contract is None:
+        return 2 * out_elems
+    dims_txt = lhs.group("dims")
+    lhs_dims = [int(d) for d in dims_txt.split(",")] if dims_txt else []
+    k = 1
+    for i in contract.group("dims").split(","):
+        if i == "":
+            continue
+        idx = int(i)
+        if idx < len(lhs_dims):
+            k *= lhs_dims[idx]
+    return 2 * out_elems * k
+
+
+def parse_hlo_costs(hlo_text: str, top_k: int = 5) -> dict:
+    """Scan an HLO module's text into the per-op-category cost ledger.
+
+    Returns::
+
+        {"ops": N, "flops": F, "bytes": B,
+         "categories": {category: {"ops": n, "flops": f, "bytes": b}},
+         "top_ops": [{"name", "op", "category", "flops", "bytes"
+                      [, "source"]}, ...]}   # heaviest first
+
+    ``top_ops`` ranks by ``max(flops, bytes)`` — a zero-flop gather
+    moving megabytes is exactly as interesting as a dot burning them —
+    and carries the jax-level ``metadata op_name`` as ``source`` when
+    present. Categories with no ops are ABSENT, never zero-filled; an
+    empty module returns zero totals and an empty category dict.
+    """
+    categories: dict[str, dict[str, int]] = {}
+    ops_list: list[dict] = []
+    total_ops = 0
+    total_flops = 0
+    total_bytes = 0
+    in_entry = False
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and " = " not in stripped:
+            # a computation header ("%fused_computation (...) -> ... {",
+            # "ENTRY %main (...) {", while/reduce region bodies): only
+            # the entry computation's parameters are argument traffic
+            in_entry = stripped.startswith("ENTRY")
+            continue
+        m = _DEF_RE.match(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        if op == "parameter" and not in_entry:
+            # a sub-computation's parameter aliases an operand the
+            # caller already counted — skipping it keeps the bytes
+            # total an operand+output traffic proxy, not double counts
+            continue
+        out = m.group("out")
+        out_elems, out_bytes = _shape_elems_bytes(out)
+        tail = line[m.end():]
+        if op in ("dot", "convolution"):
+            flops = _dot_flops(line, tail, out_elems)
+        elif op in ("reduce", "reduce-window"):
+            first = _SHAPE_RE.search(tail)
+            flops = (
+                _atom_elems_bytes(first.group("dt"), first.group("dims"))[0]
+                if first is not None else out_elems
+            )
+        elif op in _ZERO_FLOP_OPS:
+            flops = 0
+        else:
+            flops = out_elems
+        cat = classify_op(op)
+        d = categories.setdefault(cat, {"ops": 0, "flops": 0, "bytes": 0})
+        d["ops"] += 1
+        d["flops"] += flops
+        d["bytes"] += out_bytes
+        total_ops += 1
+        total_flops += flops
+        total_bytes += out_bytes
+        entry = {
+            "name": m.group("name"),
+            "op": op,
+            "category": cat,
+            "flops": flops,
+            "bytes": out_bytes,
+        }
+        src = _OP_NAME_RE.search(line)
+        if src is not None:
+            entry["source"] = src.group("src")
+        ops_list.append(entry)
+    ops_list.sort(key=lambda e: -max(e["flops"], e["bytes"]))
+    return {
+        "ops": total_ops,
+        "flops": total_flops,
+        "bytes": total_bytes,
+        "categories": categories,
+        "top_ops": ops_list[:top_k],
+    }
+
+
+def best_anatomy(candidates) -> dict | None:
+    """Pick the representative ledger from an iterable of per-signature
+    candidates: the heaviest-output-bytes NON-EMPTY parse (the
+    steady-state variant — the collective-ledger convention), or None
+    when nothing parsed. ONE implementation shared by the live registry
+    (statusz + anatomy_stats) and the offline trace join, so the three
+    surfaces can never pick differently."""
+    best = None
+    for a in candidates:
+        if not a or not a.get("ops"):
+            continue
+        if best is None or a.get("bytes", 0) > best.get("bytes", 0):
+            best = a
+    return best
+
+
+def format_anatomy(anatomy: dict) -> str:
+    """Human-readable per-program anatomy report (the `anatomy` section
+    of `summarize_trace` / the statusz `programs.<name>.anatomy` dicts:
+    {program: parse_hlo_costs result}), or "" when empty."""
+    if not anatomy:
+        return ""
+    lines = ["program anatomy (per-op HLO ledger: static counts, "
+             "output-shape bytes):"]
+    for prog, d in sorted(anatomy.items(),
+                          key=lambda kv: -kv[1].get("bytes", 0)):
+        lines.append(
+            f"  {prog}: {d.get('ops', 0)} ops, "
+            f"{d.get('flops', 0):.3g} flops, {d.get('bytes', 0)} bytes"
+        )
+        cats = d.get("categories") or {}
+        for cat in CATEGORIES:
+            c = cats.get(cat)
+            if not c:
+                continue
+            lines.append(
+                f"    {cat:<14} x{c['ops']:<4} flops {c['flops']:>12.3g} "
+                f"bytes {c['bytes']:>12}"
+            )
+        top = d.get("top_ops") or []
+        if top:
+            lines.append("    heaviest ops:")
+            for t in top:
+                src = t.get("source")
+                lines.append(
+                    f"      {t['name']:<24} {t['category']:<14} "
+                    f"flops {t['flops']:>12.3g} bytes {t['bytes']:>12}"
+                    + (f"  [{src}]" if src else "")
+                )
+    return "\n".join(lines)

@@ -611,7 +611,38 @@ def summarize_trace(trace) -> dict:
         # present IFF the trace holds mesh-observatory events — a PR-4/5
         # era trace summarizes without the key (no invented zeros)
         summary["mesh"] = mesh
+    anatomy = _anatomy_section(events)
+    if anatomy:
+        # present IFF the trace holds compile events carrying the
+        # per-op anatomy ledger (xla_obs with the anatomy parse, i.e.
+        # any post-PR-13 observatory run) — earlier traces summarize
+        # with the key ABSENT, pinned in tests
+        summary["anatomy"] = anatomy
     return summary
+
+
+def _anatomy_section(events: list[dict]) -> dict:
+    """Per-program anatomy ledgers from the compile events' `anatomy`
+    args (metrics/hlo_cost.parse_hlo_costs output, recorded when the
+    engine ran with trace + xla_obs): {program: ledger} keeping the
+    heaviest-bytes signature per program — the collective-ledger
+    convention. Empty dict when no compile event carries one."""
+    from solvingpapers_tpu.metrics.hlo_cost import best_anatomy
+
+    candidates: dict[str, list] = {}
+    for e in events:
+        if e.get("cat") != "xla" or e.get("name") != "compile":
+            continue
+        args = e.get("args") or {}
+        prog = args.get("program")
+        if prog and args.get("anatomy"):
+            candidates.setdefault(prog, []).append(args["anatomy"])
+    out = {}
+    for prog, cands in candidates.items():
+        best = best_anatomy(cands)
+        if best is not None:
+            out[prog] = best
+    return out
 
 
 def _mesh_section(events: list[dict]) -> dict | None:
@@ -807,6 +838,12 @@ def format_summary(summary: dict, top: int = 5) -> str:
     if roofline:
         lines.append("")
         lines.append(roofline)
+    from solvingpapers_tpu.metrics.hlo_cost import format_anatomy
+
+    anatomy = format_anatomy(summary.get("anatomy") or {})
+    if anatomy:
+        lines.append("")
+        lines.append(anatomy)
     mesh = format_mesh(summary.get("mesh"))
     if mesh:
         lines.append("")

@@ -55,7 +55,10 @@ BENCH_serve.json).
 
 from __future__ import annotations
 
+import hashlib
 import math
+import os
+import tempfile
 import threading
 import time
 import warnings
@@ -146,7 +149,7 @@ class _Executable:
 
     __slots__ = ("compiled", "jitted", "compile_s", "flops",
                  "bytes_accessed", "temp_bytes", "arg_bytes", "out_bytes",
-                 "collectives")
+                 "collectives", "anatomy")
 
     def __init__(self, compiled, jitted, compile_s: float):
         self.compiled = compiled
@@ -161,6 +164,11 @@ class _Executable:
         # is lazy and gated on CompileRegistry(collectives=True) — the
         # HLO text render is not free, and most registries never ask)
         self.collectives: dict | None = None
+        # parse_hlo_costs result (metrics/hlo_cost.py), same lazy
+        # contract gated on CompileRegistry(anatomy=True): None = never
+        # parsed, {} = parse failed (as_text unavailable) — absence,
+        # never an invented zero ledger
+        self.anatomy: dict | None = None
         try:
             ca = compiled.cost_analysis()
             d = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
@@ -250,6 +258,8 @@ class CompileRegistry:
         clock: Callable[[], float] = time.monotonic,
         time_programs: bool = True,
         collectives: bool = False,
+        anatomy: bool = False,
+        hlo_dir: str | None = None,
     ):
         if storm_k < 2:
             raise ValueError(f"storm_k must be >= 2, got {storm_k}")
@@ -267,6 +277,20 @@ class CompileRegistry:
         # compiled program's HLO text for collective ops so the ledger
         # can report per-program comm bytes — compile-time-only cost
         self.collectives = collectives
+        # program-anatomy mode (metrics/hlo_cost.py): parse each
+        # compiled program's HLO text into the per-op-category cost
+        # ledger (gather/scatter/dot/convert/... flops + output-shape
+        # bytes, top-k heaviest ops) — compile-time-only cost, same
+        # lazy contract as the collective ledger
+        self.anatomy = anatomy
+        # optional per-signature compiled-HLO text dump directory
+        # (ServeConfig.obs_hlo_dir): one file per TRUE compile, written
+        # atomically (tmp + rename), named
+        # <sanitized program>__<signature hash>.hlo.txt — so anatomy
+        # claims can be diffed offline against the exact HLO they came
+        # from. Dump failures warn once and never break a compile.
+        self.hlo_dir = hlo_dir
+        self._hlo_dump_warned = False
         self._programs: dict[str, _ProgramStats] = {}
         self._lock = threading.Lock()
         # chip peak for per-program MFU; NaN on backends without a table
@@ -322,6 +346,16 @@ class CompileRegistry:
             exe = _Executable(compiled, jitted, self.clock() - t0)
             with _AOT_LOCK:
                 exe = _AOT_CACHE.setdefault(global_key, exe)
+        # the HLO text render is not free: do it ONCE per executable and
+        # feed every consumer (collective ledger, anatomy ledger, dump)
+        hlo_text: str | None = None
+        if ((self.collectives and exe.collectives is None)
+                or (self.anatomy and exe.anatomy is None)
+                or (self.hlo_dir is not None and not cached)):
+            try:
+                hlo_text = exe.compiled.as_text()
+            except Exception:  # backend without as_text: absent, not 0s
+                hlo_text = None
         if self.collectives and exe.collectives is None:
             # lazy (a cache hit may come from a registry that never
             # parsed); a benign race would just parse twice
@@ -330,11 +364,20 @@ class CompileRegistry:
             )
 
             try:
-                exe.collectives = parse_hlo_collectives(
-                    exe.compiled.as_text()
-                )
-            except Exception:  # backend without as_text: absent, not 0s
+                exe.collectives = (parse_hlo_collectives(hlo_text)
+                                   if hlo_text is not None else {})
+            except Exception:  # {} = parse failed: absence, never zeros
                 exe.collectives = {}
+        if self.anatomy and exe.anatomy is None:
+            from solvingpapers_tpu.metrics.hlo_cost import parse_hlo_costs
+
+            try:
+                exe.anatomy = (parse_hlo_costs(hlo_text)
+                               if hlo_text is not None else {})
+            except Exception:  # same contract as the collective ledger
+                exe.anatomy = {}
+        if self.hlo_dir is not None and not cached and hlo_text is not None:
+            self._dump_hlo(program, key, hlo_text)
         sig = _SigStats(exe, cached)
         with self._lock:
             st.signatures[key] = sig
@@ -377,6 +420,11 @@ class CompileRegistry:
                     k: dict(v)
                     for k, v in exe.collectives["by_type"].items()
                 }
+            if exe.anatomy and exe.anatomy.get("ops"):
+                # per-op anatomy ledger: the offline trace-summary
+                # anatomy section joins on this one nested arg (empty
+                # parse = absent, matching the statusz contract)
+                ev["anatomy"] = exe.anatomy
             self.trace.instant("compile", "xla", "xla", **ev)
         if storm:
             if not st.storm_warned:
@@ -395,7 +443,58 @@ class CompileRegistry:
                 )
         return sig
 
+    def _dump_hlo(self, program: str, key, text: str) -> None:
+        """Write one compiled signature's HLO text to `hlo_dir`
+        atomically (tmp + rename — a reader or an uploader never sees a
+        torn file): ``<sanitized program>__<signature hash>.hlo.txt``.
+        Prometheus-style sanitized program names keep the files
+        shell/artifact safe; the hash keys the exact signature so two
+        prefill buckets never clobber each other."""
+        try:
+            os.makedirs(self.hlo_dir, exist_ok=True)
+            digest = hashlib.sha1(
+                repr(key).encode("utf-8", "replace")
+            ).hexdigest()[:12]
+            name = (f"{PrometheusTextWriter.sanitize(program)}"
+                    f"__{digest}.hlo.txt")
+            fd, tmp = tempfile.mkstemp(dir=self.hlo_dir,
+                                       prefix=".hlo_tmp_")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(text)
+                os.replace(tmp, os.path.join(self.hlo_dir, name))
+            except BaseException:
+                os.unlink(tmp)
+                raise
+        except OSError as e:
+            if not self._hlo_dump_warned:
+                self._hlo_dump_warned = True
+                warnings.warn(
+                    f"obs_hlo_dir: cannot dump compiled HLO to "
+                    f"{self.hlo_dir!r} ({e}) — continuing without dumps",
+                    stacklevel=3,
+                )
+
     # ------------------------------------------------------------- reading
+
+    def anatomy_stats(self) -> dict:
+        """Per-program anatomy ledger (programs whose registry was built
+        with ``anatomy=True`` and that parsed): {program:
+        parse_hlo_costs result} from the heaviest-bytes signature (the
+        steady-state variant — the collective_stats convention). A
+        program built without the flag, or whose as_text failed, is
+        simply absent — never a zero ledger."""
+        from solvingpapers_tpu.metrics.hlo_cost import best_anatomy
+
+        with self._lock:
+            out = {}
+            for name, st in self._programs.items():
+                best = best_anatomy(
+                    s.exe.anatomy for s in st.signatures.values()
+                )
+                if best is not None:
+                    out[name] = best
+        return out
 
     def collective_stats(self) -> dict:
         """Per-program collective ledger (programs whose registry was
@@ -527,6 +626,20 @@ class CompileRegistry:
                 }
                 for name, st in self._programs.items()
             }
+            # per-program anatomy (ledger of the heaviest-bytes parsed
+            # signature — hlo_cost.best_anatomy, ONE pick convention
+            # with anatomy_stats and the offline trace join): present
+            # IFF the registry parses anatomy and as_text worked — the
+            # statusz `programs.<name>.anatomy` surface the trace
+            # section and README document
+            from solvingpapers_tpu.metrics.hlo_cost import best_anatomy
+
+            for name, st in self._programs.items():
+                best = best_anatomy(
+                    s.exe.anatomy for s in st.signatures.values()
+                )
+                if best is not None:
+                    progs[name]["anatomy"] = best
         for d in progs.values():
             comm = d.pop("_comm")
             if comm >= 0:

@@ -307,10 +307,16 @@ def _traced_arm_fields(model, params, extra, requests, serve_cfg, max_new,
 
 
 def _obs_arm_fields(model, params, extra, requests, serve_cfg, max_new,
-                    params_for=None, reps: int = 4) -> dict:
+                    params_for=None, reps: int = 4,
+                    prefix: str = "obs") -> dict:
     """Compile-&-memory-observatory on vs off, same ABBA/mean pairing as
-    the tracer — `obs_overhead_pct` is the budget the registry's fenced
-    AOT dispatch is held to (< 2, matching the flight recorder's)."""
+    the tracer — `<prefix>_overhead_pct` is the budget the registry's
+    fenced AOT dispatch is held to (< 2, matching the flight
+    recorder's). ONE pairing implementation behind two field names:
+    `obs` (the PR-5 budget) and `anatomy` (the paged/kv-quant entries'
+    armed-anatomy budget — since the per-op HLO parse rides `xla_obs`
+    unconditionally, the armed configuration is identical; the distinct
+    name records WHICH surface the entry pinned its budget with)."""
     ocfg = dataclasses.replace(serve_cfg, xla_obs=True)
     mk_on, mk_off, _ = _paired_makespans(
         model, params, extra, requests, ocfg, serve_cfg, max_new,
@@ -319,13 +325,27 @@ def _obs_arm_fields(model, params, extra, requests, serve_cfg, max_new,
     on_rps = len(requests) / (sum(mk_on) / len(mk_on))
     off_rps = len(requests) / (sum(mk_off) / len(mk_off))
     return {
-        "obs_overhead_pct": round((1.0 - on_rps / off_rps) * 100.0, 2),
-        "obs_requests_per_sec": round(on_rps, 2),
+        f"{prefix}_overhead_pct": round(
+            (1.0 - on_rps / off_rps) * 100.0, 2
+        ),
+        f"{prefix}_requests_per_sec": round(on_rps, 2),
     }
 
 
+def _decode_step_wall_s(registry) -> float | None:
+    """Fenced per-call wall of the steady-state decode program from a
+    live CompileRegistry, or None before any decode ran — the measured
+    denominator `paged_decode_decomposition` attributes against."""
+    snap = registry.snapshot()
+    d = snap["programs"].get("decode_block")
+    if not d or not d["calls"] or d["run_time_s"] <= 0:
+        return None
+    return d["run_time_s"] / d["calls"]
+
+
 def _obs_probe(model, params, extra, warm_requests, serve_cfg, max_new,
-               status_port: int | None = None, params_for=None):
+               status_port: int | None = None, params_for=None,
+               obs_hlo_dir: str | None = None):
     """Run the warm trace through an observatory-enabled engine FIRST
     (before the plain warmup populates jax's jit cache) so the recorded
     `compile_time_s` is true cold-compile wall time, and read the
@@ -339,7 +359,8 @@ def _obs_probe(model, params, extra, warm_requests, serve_cfg, max_new,
     memory it exists to measure."""
     import sys
 
-    ocfg = dataclasses.replace(serve_cfg, xla_obs=True)
+    ocfg = dataclasses.replace(serve_cfg, xla_obs=True,
+                               obs_hlo_dir=obs_hlo_dir)
     if status_port is not None:
         ocfg = dataclasses.replace(ocfg, status_port=status_port)
     eng, _, _ = _run_engine_arm(
@@ -358,6 +379,12 @@ def _obs_probe(model, params, extra, warm_requests, serve_cfg, max_new,
         ),
         "peak_hbm_bytes": int(eng.ledger.projected_peak_bytes()),
     }
+    # the fenced decode-program per-call wall: the denominator the
+    # paged/kv-quant entries decompose into gather/dequant/scatter/
+    # attention shares (serve/kernel_bench.py)
+    step_wall = _decode_step_wall_s(eng.registry)
+    if step_wall is not None:
+        fields["decode_step_wall_s"] = round(step_wall, 6)
     if eng.status is not None:
         fields["status_port"] = eng.status.port
         print(f"[serve-bench] status endpoint live at "
@@ -436,6 +463,7 @@ def run_serve_bench(
     obs: bool = False,
     status_port: int | None = None,
     status_hold_s: float = 0.0,
+    obs_hlo_dir: str | None = None,
 ) -> dict:
     """Run both arms, return the BENCH-shaped result dict."""
     model, params, extra, vocab = build_serve_model(config)
@@ -471,7 +499,7 @@ def run_serve_bench(
     # endpoint up for the rest of the bench when --status-port is set
     probe_fields, probe_eng = _obs_probe(
         model, params, extra, warm, serve_cfg, max_new,
-        status_port=status_port,
+        status_port=status_port, obs_hlo_dir=obs_hlo_dir,
     )
     try:
         _run_engine_arm(model, params, extra, warm, serve_cfg, max_new)
@@ -836,6 +864,29 @@ def run_paged_bench(
             **_kv_entry_fields(engines["on"]),
             **probe_fields,
         }
+
+        # ---- 1b. decompose the paged decode tax ----------------------
+        # microbenched gather/scatter walls at THIS entry's shapes
+        # against the probe's fenced decode-program wall: the measured
+        # per-component baseline ROADMAP item 1's fused kernel is
+        # diffed against (serve/kernel_bench.py)
+        if "decode_step_wall_s" in probe_fields:
+            from solvingpapers_tpu.serve.kernel_bench import (
+                paged_decode_decomposition,
+            )
+
+            detail.update(paged_decode_decomposition(
+                model, n_slots=n_slots, max_len=max_len,
+                page_size=page_size, decode_block=decode_block,
+                step_wall_s=probe_fields["decode_step_wall_s"],
+                kv_quant=None, reps=3, seed=seed,
+            ))
+        # armed-anatomy overhead, ABBA-paired like every other
+        # instrumentation budget (<= 2%)
+        detail.update(_obs_arm_fields(
+            model, params, extra, requests, paged_cfg, max_new, reps=reps,
+            prefix="anatomy",
+        ))
 
         # ---- 2. capacity at equal HBM: 2x slots, lane-pool bytes -----
         cap_new = max(8, max_new // 4)  # shorter streams: the mixed-
@@ -1368,6 +1419,23 @@ def run_quant_bench(
             model, params, extra, warm, cap_obs, cap_new,
         )
         cap_temp = int(obs_cap_eng.registry.max_temp_bytes())
+        # decompose the QUANTIZED paged decode step at the capacity
+        # arm's exact shapes: the int8 gather+dequant+scatter shares of
+        # the fenced decode wall — the kv-quant half of the per-
+        # component baseline ROADMAP item 1 diffs against
+        cap_step_wall = _decode_step_wall_s(obs_cap_eng.registry)
+        decomp_fields: dict = {}
+        if cap_step_wall is not None:
+            from solvingpapers_tpu.serve.kernel_bench import (
+                paged_decode_decomposition,
+            )
+
+            decomp_fields = paged_decode_decomposition(
+                model, n_slots=cap_slots, max_len=max_len,
+                page_size=page_size, decode_block=decode_block,
+                step_wall_s=cap_step_wall, kv_quant="int8", reps=3,
+                seed=seed,
+            )
         _run_engine_arm(model, params, extra, warm, cap_cfg, cap_new)
         cap_eng, cap_handles, cap_mk = _run_engine_arm(
             model, params, extra, cap_requests, cap_cfg, cap_new,
@@ -1420,7 +1488,17 @@ def run_quant_bench(
             ),
             **_kv_entry_fields(quant_eng, agreement),
             **probe_fields,
+            # LAST: the shares' decode_step_wall_s is the capacity
+            # arm's quantized paged wall (what they decompose), not the
+            # probe's lane-pool one — decomp wins the key
+            **decomp_fields,
         }
+        # armed-anatomy overhead on the like-for-like quant arm, the
+        # same <= 2% ABBA budget as the paged entry's
+        detail.update(_obs_arm_fields(
+            model, params, extra, requests, quant_cfg, max_new, reps=reps,
+            prefix="anatomy",
+        ))
         if probe_eng is not None and status_hold_s > 0:
             time.sleep(status_hold_s)
     finally:

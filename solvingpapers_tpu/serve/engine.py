@@ -478,7 +478,17 @@ class ServeConfig:
     # calls are fenced for device-true run seconds (same contract and
     # paired-bench budget as `trace` — BENCH_serve.json
     # `obs_overhead_pct`); off = None registry, one branch per call site.
+    # The registry also parses every compiled program's HLO text into
+    # the per-op-category anatomy ledger (metrics/hlo_cost.py —
+    # gather/scatter/dot/convert/... flops + output-shape bytes, top-k
+    # heaviest ops), surfaced as /statusz `compile.programs.<name>.
+    # anatomy`, compile-event args on the flight recorder, and the
+    # trace-summary "anatomy" section. obs_hlo_dir optionally dumps
+    # each TRUE compile's HLO text (atomic tmp+rename, one file per
+    # signature, sanitized program names) so anatomy claims can be
+    # diffed offline.
     xla_obs: bool = False
+    obs_hlo_dir: str | None = None
     obs_storm_k: int = 8
     obs_storm_window_s: float = 60.0
     # device capacity override for the headroom estimate (bytes); None =
@@ -1644,6 +1654,12 @@ class ServeEngine:
                 storm_k=cfg.obs_storm_k,
                 storm_window_s=cfg.obs_storm_window_s,
                 clock=smetrics.now,
+                # the per-op anatomy ledger rides the observatory: the
+                # parse is compile-time-only, and the armed steady-state
+                # cost is held to the same paired-bench <= 2% budget
+                # (BENCH_serve.json anatomy_overhead_pct)
+                anatomy=True,
+                hlo_dir=cfg.obs_hlo_dir,
             )
             if not cfg.paged:
                 # the lane pool owns jitted splice/extract programs and

@@ -1,0 +1,569 @@
+"""Kernel microbench harness: the serving stack's hot inner ops, timed
+in isolation (`cli kernel-bench` / tools/bench_kernels.py ->
+BENCH_kernels.json).
+
+The serve-bench workloads measure END-TO-END throughput: the paged
+pool's 15-38% tax, the int8 pool's ~10% overhead. Those numbers bound
+the problem but cannot attribute it — ROADMAP item 1's fused
+paged-attention kernel needs to know how much of a decode step the
+`gather_lanes` page view costs BY ITSELF, at the bench's exact shapes,
+before and after the kernel lands. This module benches each hot op as
+its own fenced program, min-of-reps (the `probe_stage_costs`
+discipline: the op's cost gates a lockstep step, so the minimum is the
+signal and scheduling noise is not):
+
+    gather          the pool -> logical (S, max_len, ...) lane view.
+                    paged: `gather_lanes` (f32) / `quant_gather_lanes`
+                    (int8 pages + per-page scales dequantized on read);
+                    lane: the contiguous pool IS the view and the
+                    decode program reads it in place — f32 benches a
+                    per-leaf reduction (every byte read, nothing
+                    materialized: the honest floor the paged gather's
+                    read-AND-materialize is measured against), int8
+                    benches `quant_lanes_view` (dequant-on-read).
+    scatter         ONE write-back per slot. paged: a single
+                    `scatter_written_pages` window (the decode program
+                    pays (decode_block-1)//page_size + 2 of these per
+                    call — its post-scan write-back loop); lane:
+                    a vmapped per-slot one-token `dynamic_update_slice`
+                    (`quant_store_written` span=1 on int8 pools).
+    quant_roundtrip `quantize_tree` + `dequantize_tree` of the full
+                    lane view — the isolated cost of int8 storage
+                    (benched on f32 rows too: what the exact pool WOULD
+                    pay, the before/after of a kv_quant flip).
+    splice          prefix-cache segment traffic. lane: the
+                    splice/extract device copies (`_splice_program` /
+                    `_extract_program`, quantized twins on int8); paged:
+                    `gather_lane` + `scatter_lane_pages` — the per-slot
+                    page-window ops a prefix MISS pays (a paged HIT is a
+                    host-side refcount append, zero device programs —
+                    pinned by splice_programs_dispatched == 0 in the
+                    paged bench, so there is nothing to time).
+    sample          `fused_sample` on a mixed half-greedy/half-
+                    stochastic batch at the engine's (S, vocab) logits
+                    shape and sample_cap support.
+    spec_verify     the speculative 1+k verify window (`spec_verify`)
+                    over (S, k+1, vocab) logits — drafts, rejection
+                    sampling, commit counts.
+
+Every op family is benched over the FULL (pool layout x kv_quant) grid
+— including combinations the default engine would not pick — because
+the decomposition question is comparative: the int8 gather moves a
+quarter of the f32 bytes, the lane pool's gather is free, and only the
+grid shows both. One BENCH_kernels.json entry per grid cell, JSON-lines
+with `bench_provenance`, gated by tools/bench_check.py exactly like
+BENCH_serve.json.
+
+`paged_decode_decomposition` is the join the serve benches record: the
+microbenched gather/dequant/scatter walls against a MEASURED decode
+program wall (the compile registry's fenced run seconds per call),
+yielding `gather_share_pct` / `dequant_share_pct` / `scatter_share_pct`
+/ `attention_share_pct` — the last is the remainder (model forward:
+attention + MLP + sampling), i.e. the compute a fused kernel must keep.
+These fields are the honest before-numbers ROADMAP item 1's exit
+criteria diff against.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from solvingpapers_tpu.ops.quant import dequantize_tree, quantize_tree
+from solvingpapers_tpu.serve.kv_pool import (
+    _extract_program,
+    _quant_extract_program,
+    _quant_splice_program,
+    _splice_program,
+    gather_lane,
+    gather_lanes,
+    make_quant_store,
+    quant_gather_lane,
+    quant_gather_lanes,
+    quant_lanes_view,
+    quant_scatter_lane_pages,
+    quant_scatter_written_pages,
+    quant_store_written,
+    scatter_lane_pages,
+    scatter_written_pages,
+)
+from solvingpapers_tpu.serve.sampling import (
+    PackedSampling,
+    fused_sample,
+    slot_keys,
+)
+from solvingpapers_tpu.serve.spec import spec_verify
+
+OP_FAMILIES = ("gather", "scatter", "quant_roundtrip", "splice",
+               "sample", "spec_verify")
+
+POOL_LAYOUTS = ("lane", "paged")
+KV_QUANTS = (None, "int8")
+
+
+def _pytree_bytes(tree) -> int:
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def fenced_wall_s(fn, args, *, reps: int = 5, static_argnums=(),
+                  clock=time.monotonic) -> float:
+    """Min-of-reps fenced wall seconds of ``jit(fn)(*args)``: compile +
+    one warmup outside the timing, then `reps` fenced runs. Min, not
+    mean — an isolated op's cost is its floor; the serve benches' ABBA
+    pairing handles drift where a MEAN is the right estimator (see
+    bench.py `_paired_makespans`), but a microbench wants the op, not
+    the box."""
+    jitted = jax.jit(fn, static_argnums=static_argnums)
+    jax.block_until_ready(jitted(*args))  # compile + warm
+    best = math.inf
+    for _ in range(max(reps, 1)):
+        t0 = clock()
+        jax.block_until_ready(jitted(*args))
+        best = min(best, clock() - t0)
+    return best
+
+
+def _mixed_packed(n_slots: int) -> PackedSampling:
+    """Half-greedy / half-stochastic per-slot knobs — the mixed batch
+    the engine's fused sampler actually serves (all-greedy would ride
+    the argmax fast path and measure nothing)."""
+    half = np.arange(n_slots) % 2 == 0
+    return PackedSampling(
+        temperature=jnp.where(half, 0.0, 0.8).astype(jnp.float32),
+        top_p=jnp.full((n_slots,), 0.95, jnp.float32),
+        min_p=jnp.zeros((n_slots,), jnp.float32),
+        top_k=jnp.where(half, 0, 40).astype(jnp.int32),
+        need_lp=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def _lane_token_write(caches, lanes, pos):
+    """The lane pool's decode write site in isolation: each slot writes
+    ONE token (its lane-view column at `pos[s]`) back into the
+    contiguous pool — a vmapped batch-1 `dynamic_update_slice`, the
+    lane counterpart of one `scatter_written_pages` write-back window."""
+
+    def one(cleaf, laneleaf):
+        val = jax.vmap(
+            lambda lane, p: jax.lax.dynamic_slice_in_dim(lane, p, 1, axis=0)
+        )(laneleaf, pos)
+        return jax.vmap(
+            lambda c, v, p: jax.lax.dynamic_update_slice_in_dim(
+                c, v, p, axis=0)
+        )(cleaf, val, pos)
+
+    return jax.tree_util.tree_map(one, caches, lanes)
+
+
+def _write_positions(rng, n_slots: int, max_len: int, page_size: int):
+    """Seeded per-slot decode write positions: past the first page when
+    the lane is long enough (the steady-state regime), but NEVER an
+    empty numpy range — a one-page lane (max_len == page_size) draws
+    from [0, max_len - 1) instead of crashing inside rng.integers."""
+    lo = min(page_size, max(max_len - 2, 0))
+    return jnp.asarray(
+        rng.integers(lo, max(max_len - 1, lo + 1), size=n_slots,
+                     dtype=np.int32)
+    )
+
+
+def _paged_pool_ops(model, *, n_slots: int, max_len: int, page_size: int,
+                    kv_quant: str | None, decode_block: int = 16,
+                    seed: int = 0):
+    """The paged grid cell's arrays + gather / write-back-window
+    scatter / splice closures — ONE construction shared by `build_kernel_ops`
+    and `paged_decode_decomposition`, so the BENCH_kernels.json walls
+    and the `*_share_pct` decomposition are measured on IDENTICAL op
+    shapes (steady-state contiguous page tables, seeded positions).
+    `decode_block` bounds the int8 scatter's merge window exactly as
+    the engine passes it. Returns ``(ops, pool_tree, lane_view)``."""
+    rng = np.random.default_rng(seed)
+    ppl = max_len // page_size
+    n_pages = n_slots * ppl + 1  # lane-equivalent budget + trash page
+    lane_view = model.init_caches(n_slots, max_len)
+    # contiguous page-table rows (slot s owns pages [1 + s*ppl, ...)):
+    # the steady-state layout after in-order allocation
+    table = jnp.asarray(
+        1 + np.arange(n_slots * ppl, dtype=np.int32).reshape(n_slots, ppl)
+    )
+    pos = _write_positions(rng, n_slots, max_len, page_size)
+    eidx_row = jnp.zeros((n_slots,), jnp.int32)
+    row = table[0]
+    if kv_quant is not None:
+        store = make_quant_store(model, n_pages, page_size, page_size)
+        # the engine's decode write-back ALWAYS bounds the requantized
+        # window (engine.py: lo=pos0, hi=pos0+block) — on lossy compute
+        # dtypes that selects the old-code merge branch, which is the op
+        # the program actually runs; omitting lo/hi here would time the
+        # cheaper merge-free variant and understate the scatter wall
+        hi = pos + decode_block
+        ops = {
+            "gather": (
+                lambda s, t: quant_gather_lanes(s, t, eidx_row),
+                (store, table), (),
+            ),
+            "scatter": (
+                lambda s, ln, t, p: quant_scatter_written_pages(
+                    s, ln, t, p, lo=pos, hi=hi),
+                (store, lane_view, table, pos), (),
+            ),
+            "splice": (
+                lambda s, r: quant_scatter_lane_pages(
+                    s, quant_gather_lane(s, r, 0), r, 0, 0),
+                (store, row), (),
+            ),
+        }
+        return ops, store, lane_view
+    phys = model.init_caches(n_pages, page_size)
+    ops = {
+        "gather": (gather_lanes, (phys, table), ()),
+        "scatter": (scatter_written_pages,
+                    (phys, lane_view, table, pos), ()),
+        "splice": (
+            lambda ph, r: scatter_lane_pages(ph, gather_lane(ph, r), r, 0),
+            (phys, row), (),
+        ),
+    }
+    return ops, phys, lane_view
+
+
+def build_kernel_ops(model, *, pool: str, kv_quant: str | None,
+                     n_slots: int, max_len: int, page_size: int,
+                     quant_block: int, vocab: int, sample_cap: int = 64,
+                     spec_k: int = 4, decode_block: int = 16,
+                     seed: int = 0) -> dict:
+    """Build the six op-family closures for one (pool, kv_quant) grid
+    cell: {family: (fn, args, static_argnums)}. All inputs are seeded
+    device arrays at the cell's exact serving shapes; nothing here runs
+    or times anything."""
+    if pool not in POOL_LAYOUTS:
+        raise ValueError(f"pool must be one of {POOL_LAYOUTS}, got {pool!r}")
+    if max_len % page_size or max_len % quant_block:
+        raise ValueError(
+            f"max_len {max_len} must be a multiple of page_size "
+            f"{page_size} and quant_block {quant_block}"
+        )
+    quant = kv_quant is not None
+    rng = np.random.default_rng(seed)
+    key = jax.random.key(seed)
+    ops: dict = {}
+
+    roundtrip_block = page_size if pool == "paged" else quant_block
+    if pool == "paged":
+        # `lane_view` (the logical compute-dtype view the decode
+        # programs carry) and the seeded positions come from the ONE
+        # shared cell construction — nothing re-derived here
+        paged_ops, pool_tree, lane_view = _paged_pool_ops(
+            model, n_slots=n_slots, max_len=max_len, page_size=page_size,
+            kv_quant=kv_quant, decode_block=decode_block, seed=seed,
+        )
+        ops.update(paged_ops)
+    else:
+        lane_view = model.init_caches(n_slots, max_len)
+        pos = _write_positions(rng, n_slots, max_len, page_size)
+        eidx_row = jnp.zeros((n_slots,), jnp.int32)
+        if quant:
+            store = make_quant_store(model, n_slots, max_len, quant_block)
+            ops["gather"] = (
+                lambda s: quant_lanes_view(s, eidx_row), (store,), (),
+            )
+            ops["scatter"] = (
+                lambda s, ln, p: quant_store_written(s, ln, p, 1, eidx_row),
+                (store, lane_view, pos), (),
+            )
+            seg_len = max(quant_block,
+                          max_len // 2 // quant_block * quant_block)
+            ctl = jnp.asarray([0, 0], jnp.int32)
+            seg = _quant_extract_program(store, ctl, seg_len)
+            ops["splice"] = (
+                lambda s, sg, c: _quant_extract_program.__wrapped__(
+                    _quant_splice_program.__wrapped__(s, sg, c), c, seg_len),
+                (store, seg, ctl), (),
+            )
+            pool_tree = store
+        else:
+            caches = model.init_caches(n_slots, max_len)
+
+            # the contiguous pool IS the logical view: the lane decode
+            # program reads it IN PLACE, so its "gather" cost is a pure
+            # read — benched as a per-leaf reduction (touches every
+            # byte, materializes nothing; a jitted identity would
+            # measure a full pool COPY the real program never pays)
+            def _read_all(c):
+                return sum(
+                    jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree_util.tree_leaves(c)
+                )
+
+            ops["gather"] = (_read_all, (caches,), ())
+            ops["scatter"] = (_lane_token_write,
+                              (caches, lane_view, pos), ())
+            seg_len = max(page_size, max_len // 2 // page_size * page_size)
+            ctl = jnp.asarray([0, 0], jnp.int32)
+            seg = _extract_program(caches, ctl, seg_len)
+            ops["splice"] = (
+                lambda c, sg, t: _extract_program.__wrapped__(
+                    _splice_program.__wrapped__(c, sg, t), t, seg_len),
+                (caches, seg, ctl), (),
+            )
+            pool_tree = caches
+
+    view_dtype = jax.tree_util.tree_leaves(lane_view)[0].dtype
+    ops["quant_roundtrip"] = (
+        lambda v: dequantize_tree(
+            *quantize_tree(v, roundtrip_block), view_dtype),
+        (lane_view,), (),
+    )
+
+    cap = min(sample_cap, vocab)
+    packed = _mixed_packed(n_slots)
+    logits = jax.random.normal(key, (n_slots, vocab), jnp.float32) * 4.0
+    rngs = slot_keys(key, 0, jnp.arange(n_slots, dtype=jnp.int32),
+                     jnp.zeros(n_slots, jnp.int32))
+    ops["sample"] = (
+        lambda lg, pk, rg: fused_sample(lg, pk, rg, cap=cap),
+        (logits, packed, rngs), (),
+    )
+
+    big_l = spec_k + 1
+    spec_logits = jax.random.normal(
+        jax.random.fold_in(key, 1), (n_slots, big_l, vocab), jnp.float32
+    ) * 4.0
+    drafts = jnp.asarray(
+        rng.integers(0, vocab, size=(n_slots, spec_k), dtype=np.int32))
+    avail = jnp.full((n_slots,), spec_k, jnp.int32)
+    keys = jax.random.split(
+        jax.random.fold_in(key, 2), n_slots * big_l
+    ).reshape(n_slots, big_l)
+    ops["spec_verify"] = (
+        lambda lg, dr, av, pk, ks: spec_verify(lg, dr, av, pk, ks, cap=cap),
+        (spec_logits, drafts, avail, packed, keys), (),
+    )
+
+    assert set(ops) == set(OP_FAMILIES), sorted(ops)
+    ops["_view_bytes"] = _pytree_bytes(lane_view)
+    ops["_pool_bytes"] = _pytree_bytes(pool_tree)
+    # the pool's TRUE storage dtype: the unquantized grid rows are
+    # labeled "f32" for trajectory-key stability, but a bf16-compute
+    # model's exact pool stores bf16 — the entry must say so
+    ops["_kv_dtype"] = kv_quant or str(view_dtype)
+    return ops
+
+
+def bench_kernel_cell(model, *, pool: str, kv_quant: str | None,
+                      n_slots: int, max_len: int, page_size: int,
+                      quant_block: int, vocab: int, sample_cap: int = 64,
+                      spec_k: int = 4, decode_block: int = 16,
+                      reps: int = 5, seed: int = 0) -> dict:
+    """Time every op family for one grid cell: {family: wall seconds}
+    plus the view/pool byte facts the entry records."""
+    ops = build_kernel_ops(
+        model, pool=pool, kv_quant=kv_quant, n_slots=n_slots,
+        max_len=max_len, page_size=page_size, quant_block=quant_block,
+        vocab=vocab, sample_cap=sample_cap, spec_k=spec_k,
+        decode_block=decode_block, seed=seed,
+    )
+    out = {"_view_bytes": ops.pop("_view_bytes"),
+           "_pool_bytes": ops.pop("_pool_bytes"),
+           "_kv_dtype": ops.pop("_kv_dtype")}
+    for family in OP_FAMILIES:
+        fn, args, static = ops[family]
+        out[family] = fenced_wall_s(fn, args, reps=reps,
+                                    static_argnums=static)
+    return out
+
+
+def run_kernel_bench(
+    config: str = "gpt_shakespeare",
+    n_slots: int = 8,
+    max_len: int = 256,
+    page_size: int = 16,
+    quant_block: int = 16,
+    sample_cap: int = 64,
+    spec_k: int = 4,
+    decode_block: int = 16,
+    reps: int = 5,
+    seed: int = 0,
+) -> list[dict]:
+    """The full grid: one BENCH_kernels.json entry per (pool layout x
+    kv_quant) cell, every op family timed at the cell's serving shapes.
+
+    Entry headline (`value`) is the gather bandwidth in GB/s — logical
+    lane-view bytes over the gather wall, HIGHER IS BETTER so the
+    bench_check trajectory gate points the right way — with every
+    family's wall microseconds as `<family>_wall_us` detail fields
+    (lower-better, gated at matching scale). `detail.config` encodes the
+    shape knobs so bench_check's scale matching never compares two
+    different geometries."""
+    from solvingpapers_tpu.serve.bench import build_serve_model
+
+    model, _, _, vocab = build_serve_model(config)
+    grain = math.lcm(page_size, quant_block)
+    max_len = max_len // grain * grain
+    limit = getattr(model, "max_positions", None)
+    if limit is not None and max_len > limit:
+        max_len = limit // grain * grain
+    if max_len < grain:
+        raise ValueError(
+            f"max_len {max_len} cannot fit one page/quant-block grain "
+            f"{grain} under the model's position budget"
+        )
+    shape_tag = (f"{config}@s{n_slots}l{max_len}p{page_size}"
+                 f"b{quant_block}c{sample_cap}k{spec_k}")
+    entries = []
+    for pool in POOL_LAYOUTS:
+        for kv_quant in KV_QUANTS:
+            cell = bench_kernel_cell(
+                model, pool=pool, kv_quant=kv_quant, n_slots=n_slots,
+                max_len=max_len, page_size=page_size,
+                quant_block=quant_block, vocab=vocab,
+                sample_cap=sample_cap, spec_k=spec_k,
+                decode_block=decode_block, reps=reps, seed=seed,
+            )
+            dtype = kv_quant or "f32"
+            view_bytes = cell.pop("_view_bytes")
+            pool_bytes = cell.pop("_pool_bytes")
+            detail = {
+                "workload": f"kernels-{pool}-{dtype}",
+                "config": shape_tag,
+                "pool": pool,
+                "kv_quant": kv_quant,
+                # the pool's true storage dtype (a bf16-compute model's
+                # exact row stores bf16; the "f32" in the workload key
+                # is the grid label, not a dtype claim)
+                "kv_dtype": cell.pop("_kv_dtype"),
+                "n_slots": n_slots,
+                "max_len": max_len,
+                "page_size": page_size,
+                "quant_block": quant_block,
+                "sample_cap": sample_cap,
+                "spec_k": spec_k,
+                "decode_block": decode_block,
+                "reps": reps,
+                "lane_view_bytes": view_bytes,
+                "pool_bytes": pool_bytes,
+            }
+            for family in OP_FAMILIES:
+                detail[f"{family}_wall_us"] = round(cell[family] * 1e6, 2)
+            gather_gbps = view_bytes / cell["gather"] / 1e9
+            detail["gather_gbps"] = round(gather_gbps, 3)
+            # no `vs_baseline`: bench_check treats that key as a
+            # higher-better relative metric, and no ratio of two op
+            # walls points one way — the per-family _wall_us fields
+            # carry the gated trajectory instead
+            entries.append({
+                "metric": "kernel_gather_bandwidth",
+                "value": round(gather_gbps, 3),
+                "unit": (f"GB/s logical-lane-view gather "
+                         f"({pool} pool, {dtype})"),
+                "detail": detail,
+            })
+    return entries
+
+
+def paged_decode_decomposition(
+    model, *,
+    n_slots: int,
+    max_len: int,
+    page_size: int,
+    decode_block: int,
+    step_wall_s: float,
+    kv_quant: str | None = None,
+    reps: int = 5,
+    seed: int = 0,
+) -> dict:
+    """Decompose a MEASURED paged decode-program wall into its paged-
+    pool op shares: isolate-bench the gather / (dequant) / one-token
+    scatter at the program's exact shapes and express each as a
+    percentage of `step_wall_s` (the compile registry's fenced run
+    seconds per `decode_block` call).
+
+    Fields (all clamped to [0, 100]):
+
+        gather_share_pct     the page-table gather (int8: net of the
+                             dequant below — pure translation cost)
+        dequant_share_pct    dequantizing the gathered view (0.0 on f32
+                             pools — an honest zero, not an absence:
+                             the f32 entry's decomposition must say
+                             "no dequant" explicitly)
+        scatter_share_pct    the written-page scatter, x the program's
+                             (decode_block-1)//page_size + 2 write-back
+                             windows per call
+        attention_share_pct  the remainder — model forward (attention +
+                             MLP) + sampling, the compute a fused
+                             paged-attention kernel must KEEP while it
+                             kills the three above
+
+    The remainder is named "attention" because at serving shapes the
+    forward is attention-dominated and the ledger's dot category pins
+    the split; the microbenched ops are measured, the remainder is
+    arithmetic — stated so the before-numbers cannot overclaim.
+    """
+    if step_wall_s <= 0:
+        raise ValueError(f"step_wall_s must be > 0, got {step_wall_s}")
+    quant = kv_quant is not None
+    # the SAME cell construction the BENCH_kernels.json grid benches —
+    # the decomposition and the microbench cannot drift onto different
+    # op shapes
+    ops, _, lane_view = _paged_pool_ops(
+        model, n_slots=n_slots, max_len=max_len, page_size=page_size,
+        kv_quant=kv_quant, decode_block=decode_block, seed=seed,
+    )
+    view_dtype = jax.tree_util.tree_leaves(lane_view)[0].dtype
+    gather_fn, gather_args, _ = ops["gather"]
+    scatter_fn, scatter_args, _ = ops["scatter"]
+    t_gather = fenced_wall_s(gather_fn, gather_args, reps=reps)
+    t_scatter1 = fenced_wall_s(scatter_fn, scatter_args, reps=reps)
+    t_dequant = 0.0
+    if quant:
+        # the dequant cost in isolation: int8 payload + scales at the
+        # gathered view's shape, multiplied back to compute dtype
+        lane_store = make_quant_store(model, n_slots, max_len, page_size)
+        t_dequant = fenced_wall_s(
+            lambda q, s: dequantize_tree(q, s, view_dtype),
+            (lane_store.q, lane_store.scale), reps=reps,
+        )
+    # the paged decode program scatters back WINDOWS, not tokens: the
+    # write-back loop after the scan runs (block-1)//page + 2 clipped
+    # scatter_written_pages calls per decode_block call (engine.py
+    # `_paged_decode_program` — positions [p, p+block) touch at most
+    # that many pages), NOT one scatter per committed token
+    n_scatters = (decode_block - 1) // page_size + 2
+    t_scatter = t_scatter1 * n_scatters
+    dequant = min(t_dequant, t_gather)
+    gather = max(t_gather - dequant, 0.0) if quant else t_gather
+    g = 100.0 * gather / step_wall_s
+    d = 100.0 * dequant / step_wall_s if quant else 0.0
+    sc = 100.0 * t_scatter / step_wall_s
+    # the shares PARTITION the step by construction: the isolated
+    # microbench walls and the step wall come from different runs, so
+    # on a noisy host their raw sum can exceed 100 — rescale the
+    # measured components proportionally (disclosed, never silent)
+    # instead of letting a required CI assert fail on box noise
+    total = g + d + sc
+    clamped = total > 100.0
+    if clamped:
+        scale = 100.0 / total
+        g, d, sc = g * scale, d * scale, sc * scale
+    att = max(100.0 - g - d - sc, 0.0)
+    out = {
+        "decode_step_wall_s": round(step_wall_s, 6),
+        "gather_wall_s": round(t_gather, 6),
+        "dequant_wall_s": round(t_dequant, 6),
+        "scatter_wall_s": round(t_scatter, 6),
+        "gather_share_pct": round(g, 2),
+        "dequant_share_pct": round(d, 2) if quant else 0.0,
+        "scatter_share_pct": round(sc, 2),
+        "attention_share_pct": round(att, 2),
+    }
+    if clamped:
+        # present iff it happened (the serve/preemptions discipline)
+        out["decomposition_clamped"] = True
+    return out

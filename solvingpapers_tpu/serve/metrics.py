@@ -124,6 +124,7 @@ Compile & memory observatory gauges (metrics/xla_obs.py; present iff
 from __future__ import annotations
 
 import time
+import warnings
 
 from solvingpapers_tpu.metrics.hist import LogHistogram
 from solvingpapers_tpu.metrics.writer import MetricsWriter, Ring
@@ -184,6 +185,11 @@ class ServeMetrics:
         # only when the engine enables it, so the key surface stays
         # "present iff the observatory is on".
         self._gauge_providers: list = []
+        # providers that already raised once (warned; their keys are
+        # skipped that snapshot, the provider stays registered so a
+        # transient failure self-heals) — id()-keyed, ids stay valid
+        # because the provider list holds strong refs
+        self._provider_warned: set[int] = set()
 
     def add_gauge_provider(self, provider) -> None:
         """Attach a zero-arg callable returning {metric_name: float};
@@ -370,7 +376,26 @@ class ServeMetrics:
                 for k, v in hist.percentiles().items():
                     out[f"serve/{name}_{k}"] = v
         for provider in self._gauge_providers:
-            out.update(provider())
+            # one broken provider must not kill the whole scrape: every
+            # /metrics pull, /statusz document and textfile write runs
+            # through here, and the providers read live engine state
+            # (pool gauges, registry locks) that can legitimately raise
+            # mid-teardown. Warn ONCE per provider, skip its keys, keep
+            # every healthy provider's gauges flowing.
+            try:
+                out.update(provider())
+            except Exception as e:  # noqa: BLE001 — scrape isolation
+                if id(provider) not in self._provider_warned:
+                    self._provider_warned.add(id(provider))
+                    name = getattr(provider, "__qualname__", None) or repr(
+                        provider
+                    )
+                    warnings.warn(
+                        f"gauge provider {name} raised "
+                        f"{type(e).__name__}: {e} — its keys are skipped "
+                        "(warning once; other providers keep reporting)",
+                        stacklevel=2,
+                    )
         return out
 
     def _latency_hists(self):

@@ -1,0 +1,179 @@
+"""Kernel microbench harness tests (serve/kernel_bench.py).
+
+The contracts under test:
+  * the full (pool layout x kv_quant) grid runs at tiny shapes and
+    every op family produces a FINITE, positive wall time — the CI
+    smoke's gate, held in tier-1 too;
+  * entries are BENCH-shaped JSON (json round trip, workload keys per
+    grid cell, shape-encoding config tag, _wall_us detail per family);
+  * `paged_decode_decomposition` yields shares in [0, 100] that sum to
+    <= 100 + rounding, an honest 0.0 dequant share on f32 pools, and a
+    positive dequant share on int8 pools when measurable;
+  * cli kernel-bench writes JSON-lines that bench_check can load and
+    classify (the BENCH_kernels.json gate's plumbing).
+"""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve.kernel_bench import (
+    KV_QUANTS,
+    OP_FAMILIES,
+    POOL_LAYOUTS,
+    bench_kernel_cell,
+    fenced_wall_s,
+    paged_decode_decomposition,
+    run_kernel_bench,
+)
+
+pytestmark = pytest.mark.fast
+
+GPT_TINY = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                     n_heads=2, dropout=0.0)
+SHAPES = dict(n_slots=2, max_len=32, page_size=8, quant_block=8,
+              sample_cap=16, spec_k=2)
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    return GPT(GPT_TINY)
+
+
+def test_fenced_wall_is_finite_positive():
+    wall = fenced_wall_s(lambda a: a * 2.0, (jnp.ones((8, 8)),), reps=2)
+    assert math.isfinite(wall) and wall > 0
+
+
+def test_every_family_times_on_every_grid_cell(gpt_tiny):
+    for pool in POOL_LAYOUTS:
+        for kv_quant in KV_QUANTS:
+            cell = bench_kernel_cell(
+                gpt_tiny, pool=pool, kv_quant=kv_quant,
+                vocab=GPT_TINY.vocab_size, reps=1, **SHAPES,
+            )
+            for family in OP_FAMILIES:
+                wall = cell[family]
+                assert math.isfinite(wall) and wall > 0, (
+                    pool, kv_quant, family, wall)
+            assert cell["_view_bytes"] > 0 and cell["_pool_bytes"] > 0
+
+
+def test_run_kernel_bench_entry_shape(monkeypatch, gpt_tiny):
+    # reuse the module-scope model: run_kernel_bench would otherwise
+    # rebuild via the config registry (compile cost for nothing here)
+    import solvingpapers_tpu.serve.bench as bench_mod
+
+    monkeypatch.setattr(
+        bench_mod, "build_serve_model",
+        lambda config: (gpt_tiny, None, None, GPT_TINY.vocab_size),
+    )
+    entries = run_kernel_bench(config="gpt_tiny", reps=1, **{
+        k: v for k, v in SHAPES.items() if k != "sample_cap"
+    }, sample_cap=16)
+    assert len(entries) == len(POOL_LAYOUTS) * len(KV_QUANTS)
+    workloads = {e["detail"]["workload"] for e in entries}
+    assert workloads == {
+        f"kernels-{p}-{d or 'f32'}"
+        for p in POOL_LAYOUTS for d in (None, "int8")
+    }
+    for e in entries:
+        line = json.dumps(e)  # BENCH files are JSON-lines
+        back = json.loads(line)
+        det = back["detail"]
+        assert back["value"] > 0
+        assert det["config"].startswith("gpt_tiny@")
+        for family in OP_FAMILIES:
+            assert det[f"{family}_wall_us"] > 0
+        assert det["gather_gbps"] > 0
+        assert det["pool"] in POOL_LAYOUTS
+        # true storage dtype recorded (grid label "f32" is not a dtype
+        # claim — a bf16-compute model's exact pool stores bf16)
+        if det["kv_quant"]:
+            assert det["kv_dtype"] == det["kv_quant"]
+        else:
+            assert det["kv_dtype"] and det["kv_dtype"] != "int8"
+        # kernel entries carry no vs_baseline: bench_check would gate
+        # it higher-better, and no ratio of op walls points one way
+        assert "vs_baseline" not in back
+
+
+def test_paged_decomposition_shares(gpt_tiny):
+    for kv_quant in (None, "int8"):
+        d = paged_decode_decomposition(
+            gpt_tiny, n_slots=2, max_len=32, page_size=8, decode_block=4,
+            step_wall_s=0.05, kv_quant=kv_quant, reps=1,
+        )
+        shares = [d["gather_share_pct"], d["dequant_share_pct"],
+                  d["scatter_share_pct"], d["attention_share_pct"]]
+        for s in shares:
+            assert 0.0 <= s <= 100.0, d
+        assert sum(shares) <= 100.0 + 0.1, d
+        if kv_quant is None:
+            # an honest explicit zero, not an absence
+            assert d["dequant_share_pct"] == 0.0
+            assert d["dequant_wall_s"] == 0.0
+        assert d["decode_step_wall_s"] == 0.05
+        assert "decomposition_clamped" not in d
+    # a step wall smaller than the isolated op walls (noisy host, or a
+    # nonsense denominator): the measured components rescale to a
+    # 100% partition and the clamp is DISCLOSED, never silent
+    tiny = paged_decode_decomposition(
+        gpt_tiny, n_slots=2, max_len=32, page_size=8, decode_block=4,
+        step_wall_s=1e-7, reps=1,
+    )
+    assert tiny["decomposition_clamped"] is True
+    assert tiny["attention_share_pct"] == 0.0
+    assert abs(tiny["gather_share_pct"] + tiny["dequant_share_pct"]
+               + tiny["scatter_share_pct"] - 100.0) <= 0.1
+    with pytest.raises(ValueError):
+        paged_decode_decomposition(
+            gpt_tiny, n_slots=2, max_len=32, page_size=8, decode_block=4,
+            step_wall_s=0.0,
+        )
+
+
+def test_bench_check_classifies_kernel_fields():
+    from tools.bench_check import classify, classify_entry_field
+
+    assert classify("gather_wall_us") == ("rel", False)
+    assert classify("sample_wall_us") == ("rel", False)
+    assert classify("gather_gbps") == ("rel", True)
+    # shares are geometry-dependent: absolute pp band, matching scale
+    # only (a tiny-shape smoke must not gate against full-scale medians)
+    assert classify("gather_share_pct") == ("pct_scaled", False)
+    assert classify("dequant_share_pct") == ("pct_scaled", False)
+    assert classify("anatomy_overhead_pct") == ("pct", False)
+    # the remainder share GROWS as the taxes die — deliberately ungated
+    assert classify("attention_share_pct") is None
+    assert classify_entry_field("entry.value") == ("rel", True)
+
+
+def test_cli_kernel_bench_writes_jsonlines(monkeypatch, tmp_path, gpt_tiny,
+                                           capsys):
+    import solvingpapers_tpu.serve.bench as bench_mod
+    from solvingpapers_tpu.cli import main as cli_main
+    from tools.bench_check import load_entries, workload_of
+
+    monkeypatch.setattr(
+        bench_mod, "build_serve_model",
+        lambda config: (gpt_tiny, None, None, GPT_TINY.vocab_size),
+    )
+    out = tmp_path / "BENCH_kernels.json"
+    rc = cli_main([
+        "kernel-bench", "--config", "gpt_tiny", "--slots", "2",
+        "--max-len", "32", "--page-size", "8", "--kv-quant-block", "8",
+        "--sample-cap", "16", "--spec-k", "2", "--reps", "1",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    entries = load_entries(str(out))
+    assert len(entries) == 4
+    for e in entries:
+        assert e["schema_version"] >= 2
+        assert e["provenance"]["timestamp"] > 0
+        assert workload_of(e).startswith("kernels-")

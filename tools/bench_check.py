@@ -62,6 +62,8 @@ BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
 # kind: "pct" = absolute percentage-point band — overheads hover near 0
 #       and are the one family comparable ACROSS scales (an 8-request
 #       smoke's tracing overhead still means something);
+#       "pct_scaled" = absolute pp band, gated on matching scale (the
+#       decomposition shares: geometry-dependent fractions of a wall);
 #       "rate" = absolute band on a [0, 1]-ish value, gated on matching
 #       scale (a smoke's agreement/acceptance reflects its own shorter
 #       training/scale, not the committed measurement's);
@@ -69,23 +71,38 @@ BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
 #       throughput/latency/bytes)
 _RULES: tuple[tuple[tuple[str, ...], str, bool], ...] = (
     (("_overhead_pct", "overhead_pct"), "pct", False),
+    # decomposition shares (gather/dequant/scatter share of the paged
+    # decode wall): absolute pp bands but ONLY at matching scale
+    # ("pct_scaled") — unlike instrumentation overheads, a share of the
+    # decode wall shifts with decode_block/page_size geometry, so a
+    # tiny-shape smoke must not gate against the full-scale median.
+    # ROADMAP item 1's kernel driving gather_share_pct DOWN is an
+    # improvement and never flags; creeping back up at the same scale
+    # does. attention_share_pct is the REMAINDER (goes UP as the taxes
+    # die), so it is deliberately ungated: gating it would fail the
+    # build on exactly the improvement the decomposition exists to
+    # deliver.
+    (("attention_share_pct",), None, False),
+    (("_share_pct",), "pct_scaled", False),
     (("agreement_rate", "acceptance_rate", "hit_rate", "attainment",
       "goodput_ratio"), "rate", True),
     (("requests_per_sec", "tokens_per_sec", "tokens_per_step",
       "speedup", "peak_active_slots", "streams_survived",
-      "goodput_ladder_ratio"), "rel", True),
+      "goodput_ladder_ratio", "_gbps"), "rel", True),
     (("ttft", "itl_", "_itl", "e2e_", "compile_time_s",
-      "fault_recovery_s"), "rel", False),
+      "fault_recovery_s", "_wall_us", "_wall_s"), "rel", False),
     (("hbm_bytes", "pool_bytes", "temp_bytes"), "rel", False),
 )
 
 
 def classify(field: str):
     """(kind, higher_is_better) for a gated detail field, or None for
-    fields the gate ignores (counts, knobs, paths, nested dicts)."""
+    fields the gate ignores (counts, knobs, paths, nested dicts, and
+    rule rows whose kind is None — explicit ungated names that would
+    otherwise match a later pattern)."""
     for patterns, kind, higher in _RULES:
         if any(p in field for p in patterns):
-            return kind, higher
+            return None if kind is None else (kind, higher)
     return None
 
 
@@ -196,7 +213,7 @@ def compare_entry(candidate: dict, history: list[dict], *,
             continue
         base = statistics.median(base_vals)
         compared += 1
-        if kind == "pct":
+        if kind in ("pct", "pct_scaled"):
             delta = value - base
             bad = delta > pct_tolerance if not higher \
                 else -delta > pct_tolerance

@@ -1,0 +1,60 @@
+"""Kernel microbenchmarks -> BENCH_kernels.json.
+
+Thin wrapper over `python -m solvingpapers_tpu.cli kernel-bench` (one
+parser, one call site — the two entry points cannot drift, the
+tools/bench_serve.py pattern) that defaults --out to BENCH_kernels.json.
+
+The harness (serve/kernel_bench.py) times the serving stack's hot inner
+ops IN ISOLATION — fenced, min-of-reps, at real serving shapes — over
+the full (pool layout x kv_quant) grid:
+
+    gather           pool -> logical lane view (`gather_lanes`, the
+                     paged tax's headline op; int8 rows dequantize on
+                     read; the lane pool's f32 row is the in-place
+                     per-leaf READ the lane program actually does —
+                     every byte touched, nothing materialized)
+    scatter          one decode token's write-back per slot
+    quant_roundtrip  quantize+dequantize of the full lane view
+    splice           prefix-cache segment traffic (lane splice/extract
+                     copies; paged per-slot page-window ops)
+    sample           `fused_sample` on a mixed batch
+    spec_verify      the speculative 1+k verify window
+
+BENCH_kernels.json is JSON-lines, one entry per grid cell (4 per run:
+{lane, paged} x {f32, int8}), each carrying `bench_provenance` exactly
+like BENCH_serve.json and gated by tools/bench_check.py
+(`--history BENCH_kernels.json`): the headline `value` is the gather
+bandwidth in GB/s (higher-better), the per-family `<family>_wall_us`
+detail fields are lower-better at matching scale.
+
+Usage: python tools/bench_kernels.py [--config gpt_shakespeare]
+       [--slots 8] [--max-len 256] [--page-size 16] [--reps 5]
+       [--out BENCH_kernels.json] (any `cli kernel-bench` flag passes
+       through; set JAX_PLATFORMS in the environment)
+
+These numbers are the measured per-component baseline ROADMAP item 1's
+fused paged-attention kernel is diffed against — the serve benches join
+them with the compile registry's fenced decode wall into the
+gather/dequant/scatter/attention `*_share_pct` decomposition on the
+paged and kv-quant BENCH_serve.json entries.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from solvingpapers_tpu.cli import main as cli_main
+
+    argv = list(sys.argv[1:])
+    if not any(a == "--out" or a.startswith("--out=") for a in argv):
+        argv += ["--out", "BENCH_kernels.json"]
+    return cli_main(["kernel-bench", *argv])
+
+
+if __name__ == "__main__":
+    sys.exit(main())
