@@ -498,9 +498,18 @@ def cmd_serve(args) -> int:
         slo_targets=slo_targets,
         degrade=args.degrade,
         fault_step_deadline_s=args.step_deadline,
+        journal_path=args.journal,
+        journal_strict=args.journal_strict,
     )
     engine = ServeEngine(model, params, serve_cfg,
                          extra_variables=extra or None, detokenize=decode)
+    if args.journal:
+        # crash-safe warm restart: replay the journal's unfinished
+        # entries BEFORE the front door starts stepping — recovered
+        # greedy/seeded streams continue token-exactly
+        resumed = engine.recover()
+        print(f"[serve] journal {args.journal}: recovered "
+              f"{len(resumed)} in-flight request(s)", file=sys.stderr)
     server = ApiServer(engine, encode=encode, decode=decode,
                        token_table=table, model_name=args.config)
     print(f"[serve] {args.config} on http://{server.host}:{server.port} "
@@ -540,17 +549,18 @@ def cmd_serve_bench(args) -> int:
         )
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
-            args.speculative, args.slo, args.chaos,
+            args.speculative, args.slo, args.chaos, args.journal,
             args.kv_quant is not None)) > 1:
         print("--shared-prefix, --sampling, --paged, --http, "
-              "--speculative, --slo, --chaos and --kv-quant are "
-              "separate workloads; pick one per run",
+              "--speculative, --slo, --chaos, --journal and --kv-quant "
+              "are separate workloads; pick one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
         bench_provenance,
         run_chaos_bench,
         run_http_bench,
+        run_journal_bench,
         run_paged_bench,
         run_prefix_bench,
         run_quant_bench,
@@ -594,7 +604,7 @@ def cmd_serve_bench(args) -> int:
     )
     if args.obs_hlo_dir:
         if any((args.shared_prefix, args.sampling, args.paged, args.http,
-                args.speculative, args.slo, args.chaos,
+                args.speculative, args.slo, args.chaos, args.journal,
                 args.kv_quant is not None)):
             # say so instead of silently dropping the flag — a user
             # waiting on dumps should not debug an empty directory
@@ -648,6 +658,20 @@ def cmd_serve_bench(args) -> int:
             mean_interarrival_s=mean_ia,
             seed=args.seed,
             stall_s=args.chaos_stall,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.journal:
+        result = run_journal_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=n_slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=mean_ia,
+            seed=args.seed,
+            kill_step=args.journal_kill_step,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
         )
@@ -1084,6 +1108,22 @@ def main(argv=None) -> int:
                               "degradation on vs off), plus the ABBA-"
                               "paired armed-but-quiet fault_overhead_pct "
                               "(serve/bench.py run_chaos_bench)")
+    p_serve.add_argument("--journal", action="store_true",
+                         help="durability workload instead: ABBA-paired "
+                              "journal-on vs journal-off req/s on the "
+                              "Poisson trace (journal_overhead_pct, "
+                              "<= 2%% budget — fsync batched per step) "
+                              "plus a kill-and-recover arm: abandon the "
+                              "engine mid-decode, replay the journal "
+                              "through a fresh one, and record "
+                              "recovery_wall_s / recovered_requests / "
+                              "recovered_token_exact (serve/bench.py "
+                              "run_journal_bench)")
+    p_serve.add_argument("--journal-kill-step", type=int, default=None,
+                         help="[--journal] engine step at which the "
+                              "kill-and-recover arm abandons the first "
+                              "engine (default: a mid-decode point "
+                              "derived from the workload)")
     p_serve.add_argument("--chaos-stall", type=float, default=0.75,
                          help="[--chaos] injected step-stall seconds; "
                               "the watchdog deadline is set BELOW it "
@@ -1293,6 +1333,20 @@ def main(argv=None) -> int:
                        help="watchdog: flag engine steps exceeding this "
                             "absolute wall deadline in seconds "
                             "(serve/watchdog_stalls + anomaly dump)")
+    p_srv.add_argument("--journal", default=None, metavar="PATH",
+                       help="request write-ahead journal "
+                            "(ServeConfig.journal_path): fsync'd JSONL "
+                            "of submit/commit/finish events; an "
+                            "existing file is REPLAYED on boot "
+                            "(engine.recover) so a crashed server's "
+                            "in-flight streams resume token-exactly, "
+                            "and SSE clients reconnect with "
+                            "Last-Event-ID")
+    p_srv.add_argument("--journal-strict", action="store_true",
+                       help="[--journal] journal I/O failures kill "
+                            "serving instead of degrading to "
+                            "journal-off with a warning (for "
+                            "deployments that REQUIRE durability)")
     p_srv.add_argument("--trace", action="store_true",
                        help="flight recorder on (ServeConfig.trace): "
                             "HTTP accept/parse/handoff/drain spans join "

@@ -15,9 +15,12 @@ first-line inspection surface with zero dependencies:
                names and dedupe rules cannot drift between the pull and
                push paths
     /statusz   one JSON document: engine snapshot, slot occupancy,
-               compile registry, memory ledger, mesh observatory
-               (collective ledger + pipeline-bubble report) — whatever
-               the owner's `statusz_fn` assembles
+               health state machine (fault plan + degradation ladder),
+               write-ahead journal (records/bytes/fsyncs, live set,
+               recovered_requests — present iff journaled), compile
+               registry, memory ledger, mesh observatory (collective
+               ledger + pipeline-bubble report) — whatever the owner's
+               `statusz_fn` assembles
 
 `StatusServer` is a `ThreadingHTTPServer` on a daemon thread bound to
 127.0.0.1 by default (inspection surface, not an API — front it with a

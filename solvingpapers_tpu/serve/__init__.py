@@ -6,7 +6,10 @@ n-gram/MTP draft-and-verify with lossless rejection sampling) +
 grammar-constrained JSON decoding (JsonStepper) + OpenAI-compatible
 HTTP front door (ApiServer) + latency metrics + fault tolerance
 (serve/faults.py: seeded fault injection, supervised step loop with
-per-request blast-radius isolation, SLO-driven degradation ladder)."""
+per-request blast-radius isolation, SLO-driven degradation ladder) +
+durable serving (serve/journal.py: request write-ahead journal,
+crash-safe warm restart via ServeEngine.recover, SSE stream
+resumption over Last-Event-ID)."""
 
 from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
@@ -17,6 +20,7 @@ from solvingpapers_tpu.serve.faults import (
     InjectedFault,
 )
 from solvingpapers_tpu.serve.grammar import JsonStepper
+from solvingpapers_tpu.serve.journal import Journal, JournalEntry, JournalError
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
     PagedKVPool,
@@ -38,6 +42,9 @@ __all__ = [
     "FaultSpec",
     "InjectedFault",
     "JsonStepper",
+    "Journal",
+    "JournalEntry",
+    "JournalError",
     "serve_api",
     "ServeConfig",
     "ServeEngine",

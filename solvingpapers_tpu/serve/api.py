@@ -27,7 +27,18 @@ no client can block the engine.
 Cancellation is disconnect-driven: the SSE writer maps a broken pipe —
 or a half-closed socket, probed between events — to `engine.cancel`,
 freeing the slot at the next block boundary; `timeout_s` maps to
-`submit(deadline_s=)`. Admission pressure maps to HTTP: a full waiting
+`submit(deadline_s=)`.
+
+Stream resumption (serve/journal.py): every SSE chunk carries an
+``id: <request id>:<token offset>`` field; a client that lost its
+connection POSTs again with ``Last-Event-ID`` set to the last id it
+saw, and the server replays the committed tokens past that offset and
+re-attaches the connection to the live tail — from the in-process
+registry, from the engine's recovered set after a crash-restart
+(`ServeEngine.recover`), or from the write-ahead journal's record of a
+finished stream. `GET /v1/requests/<id>` likewise falls back to the
+journal (marked ``source: "journal"``) for requests evicted from the
+bounded registry or served by a previous process incarnation. Admission pressure maps to HTTP: a full waiting
 queue (or the paged pool's page-budget gate rejecting) answers 503 +
 Retry-After, invalid requests answer 400 with the OpenAI error
 envelope (serve/openai.py) — never a traceback over a socket.
@@ -478,16 +489,61 @@ class ApiServer:
         with self._timeline_lock:
             rec = self._timelines.get(rid)
         if rec is None:
+            # journal fallback: a request evicted from the bounded
+            # registry (or served by a PREVIOUS process incarnation)
+            # still has its full record in the write-ahead journal —
+            # reconstruct what it holds, marked source "journal"
+            doc = self._journal_timeline(rid)
+            if doc is not None:
+                self._send_json(h, 200, doc, {"X-Request-Id": rid})
+                return
             self._send_json(h, 404, {"error": {
                 "message": f"no timeline for request id {rid!r} (unknown, "
-                           f"or evicted past the last "
-                           f"{self.timeline_cap} requests)",
+                           f"evicted past the last "
+                           f"{self.timeline_cap} requests with no journal "
+                           "record, or aged out of the journal's finished "
+                           "window)",
                 "type": "invalid_request_error", "param": None,
                 "code": "request_not_found",
             }})
             return
         self._send_json(h, 200, self._assemble_timeline(rec),
                         {"X-Request-Id": rid})
+
+    def _journal_timeline(self, rid: str) -> dict | None:
+        """`GET /v1/requests/<id>` from the journal alone: no HTTP
+        phases (the connection that carried the request may predate
+        this process), but the durable facts — prompt/completion
+        sizes, the committed token ids themselves, outcome, usage —
+        are all reconstructible. `source: "journal"` marks the
+        provenance; a live recovered request reports its current
+        committed state."""
+        if self.engine.journal is None:
+            return None
+        entry = self.engine.journal.lookup(rid)
+        if entry is None:
+            return None
+        recovered = rid in getattr(self.engine, "_recovered", {})
+        if entry.finished:
+            state = "finished"
+        elif recovered:
+            state = "active"
+        else:
+            state = "journaled"
+        return {
+            "request_id": rid,
+            "source": "journal",
+            "state": state,
+            "recovered": recovered,
+            "finish_reason": entry.finish_reason,
+            "tokens": list(entry.tokens),
+            "usage": entry.usage,
+            "facts": {
+                "prompt_tokens": len(entry.prompt),
+                "completion_tokens": len(entry.tokens),
+                "grammar": entry.grammar,
+            },
+        }
 
     def _assemble_timeline(self, rec: dict) -> dict:
         """One JSON timeline from the HTTP record + the engine Request's
@@ -568,6 +624,30 @@ class ApiServer:
             self._send(h, 404, "not found\n", "text/plain")
             return
         self._bump("requests")
+        # stream resumption: a reconnect presents the last SSE event id
+        # it saw ("<request id>:<token offset>") instead of a new job —
+        # replay the already-committed tokens (live request, a recovered
+        # one after a restart, or the journal's record of a finished
+        # stream) and re-attach to the live tail
+        lei = (h.headers.get("Last-Event-ID") or "").strip()
+        if lei:
+            try:
+                self._drain_body(h)
+                self._resume_stream(h, lei, chat)
+            except ApiError as e:
+                self._send_error(h, e)
+            except (BrokenPipeError, ConnectionResetError):
+                self._bump("disconnects")
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self._send_json(h, 500, {"error": {
+                        "message": f"{type(e).__name__}: {e}",
+                        "type": "internal_error", "param": None,
+                        "code": None,
+                    }})
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+            return
         # honor the client's X-Request-Id (sane values only), else mint:
         # the id rides the engine Request, the trace, the response
         # header, and GET /v1/requests/<id> — one identity end to end
@@ -591,6 +671,186 @@ class ApiServer:
                 }}, rid_headers)
             except (BrokenPipeError, ConnectionResetError):
                 pass
+
+    @staticmethod
+    def _check_resume_offset(offset: int, committed: int, rid: str) -> None:
+        """Reject a resume offset past the committed prefix instead of
+        silently clamping: fsync batches per step, so after a hard
+        crash a client can hold tokens the journal never made durable —
+        replaying from the clamp would hand it that span a SECOND time
+        with no signal. 409 tells it to restart (or re-request inside
+        the committed prefix) explicitly."""
+        if offset > committed:
+            raise ApiError(
+                f"Last-Event-ID offset {offset} exceeds the {committed} "
+                f"committed token(s) recoverable for request {rid!r} — "
+                "the tail past the last durable commit was lost with "
+                "the crash; resume from within the committed prefix or "
+                "restart the stream",
+                status=409, code="resume_offset_beyond_committed",
+            )
+
+    def _sse_open(self, h, trace_id: str):
+        """Send the SSE response headers and return THE event writer
+        (one framing implementation for live streams, re-attached
+        resumes and journal-only replays): each chunk is an optional
+        ``id: <trace_id>:<eid>`` resume cursor + a ``data:`` line, and
+        the fault plane's ``sse_write`` site pokes per event
+        (socket_reset/stall specs apply to replayed streams exactly
+        like live ones). FaultPlan.poke serializes internally —
+        handler threads and the engine loop share one plan across
+        lock domains."""
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("X-Request-Id", trace_id)
+        h.end_headers()
+
+        def event(obj, eid: int | None = None) -> None:
+            faults = getattr(self.engine, "_faults", None)
+            if faults is not None:
+                for spec in faults.poke("sse_write"):
+                    self.engine.metrics.record_fault_injected()
+                    tr = self.engine.trace
+                    if tr is not None:
+                        # same instant the engine's _poke_site stamps,
+                        # so counters and timeline agree on injections
+                        tr.instant("fault_injected", "engine", "http",
+                                   site="sse_write", kind=spec.kind,
+                                   slot=spec.slot)
+                    if spec.kind == "socket_reset":
+                        raise ConnectionResetError(
+                            "injected socket reset at sse_write"
+                        )
+                    if spec.kind == "stall":
+                        time.sleep(spec.stall_s)
+            payload = b""
+            if eid is not None:
+                payload += f"id: {trace_id}:{eid}\n".encode()
+            payload += b"data: " + json.dumps(obj).encode() + b"\n\n"
+            h.wfile.write(payload)
+            h.wfile.flush()
+
+        return event
+
+    @staticmethod
+    def _drain_body(h) -> None:
+        """Consume (and discard) any request body: a resume reconnect
+        needs only the Last-Event-ID header, but the bytes must still
+        be read off the socket before the SSE response streams back."""
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+        except ValueError:
+            n = 0
+        if 0 < n <= (8 << 20):
+            h.rfile.read(n)
+
+    def _resume_stream(self, h, lei: str, chat: bool) -> None:
+        """Resume a stream from its last delivered SSE event id.
+
+        The id is ``<request id>:<token offset>`` (exactly what the
+        server stamped on the `id:` field of every chunk). Sources, in
+        order: the live request registry (same process), the engine's
+        recovered set (`ServeEngine.recover` after a restart), then the
+        write-ahead journal's record of a finished stream. Committed
+        tokens past the offset replay immediately; a still-live request
+        re-attaches this connection to its tail (the previous
+        connection's bridge is abandoned — last reconnect wins, like
+        the X-Request-Id contract)."""
+        rid, _, off_s = lei.rpartition(":")
+        # ASCII digits only: str.isdigit() accepts exotic Unicode
+        # digits that int() then rejects, which would turn a malformed
+        # header into a 500 instead of this 400
+        if not rid or not (off_s.isascii() and off_s.isdigit()):
+            raise ApiError(
+                f"malformed Last-Event-ID {lei!r} — expected "
+                "\"<request id>:<token offset>\" as stamped on the "
+                "stream's id: fields", param="Last-Event-ID",
+            )
+        offset = int(off_s)
+        with self._timeline_lock:
+            rec = self._timelines.get(rid)
+        req = rec["req"] if rec is not None else None
+        if req is None:
+            req = getattr(self.engine, "_recovered", {}).get(rid)
+        if req is not None:
+            self._check_resume_offset(offset, len(req.tokens), rid)
+            new_rec = {
+                "trace_id": rid, "req": req, "chat": chat, "stream": True,
+                "t_accept": smetrics.now(), "t_body": smetrics.now(),
+                "t_parsed": smetrics.now(), "t_done": None,
+                "disconnected": False,
+            }
+            bridge = _Stream(self.engine.config.stream_queue)
+            if not req.done:
+                # re-attach: the engine reads stream_cb at each notify,
+                # so the flip is one reference write; a notification
+                # racing the flip is absorbed by the drain loop's
+                # req.done / token-count polling
+                req.stream_cb = bridge
+            # prime one event so the replay of already-committed tokens
+            # does not wait out the loop's 0.5s poll
+            bridge(req, 0, req.done)
+            self._bump("streams")
+            rid_out = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+            self._stream_response(h, req, bridge, rid_out, chat, new_rec,
+                                  start=offset)
+            return
+        entry = (self.engine.journal.lookup(rid)
+                 if self.engine.journal is not None else None)
+        if entry is None:
+            raise ApiError(
+                f"no resumable stream for request id {rid!r} (unknown, "
+                "or aged out of the journal's finished window)",
+                status=404, code="request_not_found",
+            )
+        # journal-only replay: the stream has no live engine object
+        # (finished, or a restart that never ran recover()) — replay the
+        # committed record and close it out honestly
+        self._check_resume_offset(offset, len(entry.tokens), rid)
+        self._bump("streams")
+        rid_out = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        event = self._sse_open(h, rid)
+
+        # ONE delta implementation (_delta): render the already-seen
+        # prefix, then diff — a non-prefix-stable detokenizer resends
+        # the full text instead of slicing garbage
+        rendered = ""
+        if offset:
+            _, rendered = self._delta(entry.tokens, offset, "")
+        delta, _ = self._delta(entry.tokens, len(entry.tokens), rendered)
+        upto = len(entry.tokens)
+        if chat:
+            event(oai.chat_chunk(rid_out, self.model_name, None,
+                                 role=True), eid=offset)
+            if delta:
+                event(oai.chat_chunk(rid_out, self.model_name, delta),
+                      eid=upto)
+        elif delta:
+            event(oai.completion_chunk(rid_out, self.model_name, delta),
+                  eid=upto)
+        reason = entry.finish_reason if entry.finished else "error"
+        if not entry.finished:
+            event(oai.error_event(
+                "stream is not live on this server (it was journaled "
+                "but not recovered) — committed tokens above are "
+                "complete as delivered"))
+        usage = entry.usage or {
+            "prompt_tokens": len(entry.prompt),
+            "completion_tokens": len(entry.tokens),
+        }
+        usage = {**usage, "total_tokens":
+                 usage.get("prompt_tokens", 0)
+                 + usage.get("completion_tokens", 0)}
+        if chat:
+            event(oai.chat_chunk(rid_out, self.model_name, None,
+                                 reason=reason, usage=usage), eid=upto)
+        else:
+            event(oai.completion_chunk(rid_out, self.model_name, "",
+                                       reason=reason, usage=usage),
+                  eid=upto)
+        h.wfile.write(b"data: [DONE]\n\n")
+        h.wfile.flush()
 
     @staticmethod
     def _read_body(h) -> dict:
@@ -664,12 +924,23 @@ class ApiServer:
                 np.asarray(prompt_ids, np.int32),
                 max_new_tokens=max_tokens, params=params,
                 deadline_s=timeout_s, grammar=grammar, stream_cb=bridge,
+                # the engine journals under this id, so a restarted
+                # server can answer Last-Event-ID reconnects and
+                # /v1/requests/<id> for it
+                trace_id=trace_id,
             )
         except ValueError as e:
             code = ("context_length_exceeded"
                     if "exceeds the engine capacity" in str(e) else None)
             raise ApiError(str(e), code=code) from None
-        req.trace_id = trace_id
+        if req.trace_id is not None and req.trace_id != trace_id:
+            # the engine re-keyed a duplicate still-live X-Request-Id to
+            # protect the journal (two streams must not merge commits):
+            # the client must be told the id its stream is actually
+            # addressable by — SSE cursors, the echoed header, the
+            # registry entry and post-restart resume all use it (same
+            # contract as minting over a malformed header)
+            trace_id = req.trace_id
         rec = {
             "trace_id": trace_id, "req": req, "chat": chat,
             "stream": stream, "t_accept": t_accept, "t_body": t_body,
@@ -772,46 +1043,33 @@ class ApiServer:
                         req=req.id, events=events)
 
     def _stream_response(self, h, req, bridge, rid: str,
-                         chat: bool, rec: dict) -> None:
-        h.send_response(200)
-        h.send_header("Content-Type", "text/event-stream")
-        h.send_header("Cache-Control", "no-cache")
-        h.send_header("X-Request-Id", rec["trace_id"])
-        h.end_headers()
-
-        def event(obj) -> None:
-            # fault-plane site: the SSE write boundary (socket_reset
-            # specs break the connection here, exercising the
-            # disconnect-cancel path without a real flaky client).
-            # FaultPlan.poke serializes internally — handler threads
-            # and the engine loop share one plan across lock domains.
-            faults = getattr(self.engine, "_faults", None)
-            if faults is not None:
-                for spec in faults.poke("sse_write"):
-                    self.engine.metrics.record_fault_injected()
-                    tr = self.engine.trace
-                    if tr is not None:
-                        # same instant the engine's _poke_site stamps,
-                        # so counters and timeline agree on injections
-                        tr.instant("fault_injected", "engine", "http",
-                                   site="sse_write", kind=spec.kind,
-                                   slot=spec.slot)
-                    if spec.kind == "socket_reset":
-                        raise ConnectionResetError(
-                            "injected socket reset at sse_write"
-                        )
-                    if spec.kind == "stall":
-                        time.sleep(spec.stall_s)
-            h.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
-            h.wfile.flush()
-
+                         chat: bool, rec: dict, start: int = 0) -> None:
+        """`start` > 0 is a Last-Event-ID reconnect: tokens[:start] were
+        already delivered to this client — replay resumes from there
+        (the committed prefix re-renders so text deltas stay exact).
+        Event framing (id: resume cursors + data: lines + the
+        sse_write fault site) is `_sse_open`'s — one writer for live
+        streams and journal replays."""
+        event = self._sse_open(h, rec["trace_id"])
         self._bump_active(1)
-        emitted = 0
+        emitted = start
         events = 0
         rendered = ""
+        if start > 0:
+            _, rendered = self._delta(req.tokens, start, "")
+
+        def cancel_if_mine() -> None:
+            # last reconnect wins: a Last-Event-ID re-attach flips
+            # req.stream_cb to ITS bridge — an abandoned pre-reconnect
+            # handler noticing its own dead socket afterwards must not
+            # cancel the stream out from under the live client
+            if not req.done and req.stream_cb is bridge:
+                self.loop.cancel(req)
+
         try:
             if chat:
-                event(oai.chat_chunk(rid, self.model_name, None, role=True))
+                event(oai.chat_chunk(rid, self.model_name, None, role=True),
+                      eid=emitted)
             while True:
                 try:
                     _, finished = bridge.q.get(timeout=0.5)
@@ -819,7 +1077,7 @@ class ApiServer:
                     if req.done:
                         finished = True  # cb raced the queue; finish now
                     elif self._disconnected(h):
-                        self.loop.cancel(req)
+                        cancel_if_mine()
                         self._mark_disconnect(req, rec)
                         return
                     else:
@@ -835,18 +1093,18 @@ class ApiServer:
                 # whole stream before the first EPIPE), and the peek is
                 # two syscalls against a network round trip of tokens
                 if self._disconnected(h):
-                    if not req.done:
-                        self.loop.cancel(req)
+                    cancel_if_mine()
                     self._mark_disconnect(req, rec)
                     return
                 upto = len(req.tokens)
                 if upto > emitted:
                     delta, rendered = self._delta(req.tokens, upto, rendered)
                     if chat:
-                        event(oai.chat_chunk(rid, self.model_name, delta))
+                        event(oai.chat_chunk(rid, self.model_name, delta),
+                              eid=upto)
                     else:
                         event(oai.completion_chunk(rid, self.model_name,
-                                                   delta))
+                                                   delta), eid=upto)
                     emitted = upto
                     events += 1
                 if finished:
@@ -864,12 +1122,13 @@ class ApiServer:
                     if chat:
                         event(oai.chat_chunk(rid, self.model_name, None,
                                              reason=req.finish_reason,
-                                             usage=usage))
+                                             usage=usage), eid=emitted)
                     else:
                         event(oai.completion_chunk(rid, self.model_name,
                                                    "",
                                                    reason=req.finish_reason,
-                                                   usage=usage))
+                                                   usage=usage),
+                              eid=emitted)
                     h.wfile.write(b"data: [DONE]\n\n")
                     h.wfile.flush()
                     self._mark_done(req, rec, events=events + 1)
@@ -877,8 +1136,7 @@ class ApiServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             # client went away mid-stream: free the slot at the next
             # block boundary and count the disconnect
-            if not req.done:
-                self.loop.cancel(req)
+            cancel_if_mine()
             self._mark_disconnect(req, rec)
         except Exception as e:  # noqa: BLE001 — server-side failure
             # AFTER the 200 + SSE headers went out: the status line is
@@ -886,8 +1144,7 @@ class ApiServer:
             # chunk with finish_reason "error" + [DONE] (best-effort —
             # the socket may be the thing that broke), then release the
             # engine side
-            if not req.done:
-                self.loop.cancel(req)
+            cancel_if_mine()
             try:
                 payload = (b"data: " + json.dumps(oai.error_event(
                     f"{type(e).__name__}: {e}")).encode() + b"\n\n")

@@ -65,6 +65,8 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
+import tempfile
 import time
 
 import jax
@@ -2006,18 +2008,22 @@ def run_slo_bench(
 
 
 def chaos_fault_plan(n_slots: int, seed: int = 0,
-                     stall_s: float = 0.05) -> tuple:
+                     stall_s: float = 0.05,
+                     journal: bool = False) -> tuple:
     """The seeded chaos schedule `run_chaos_bench` drives: two slot
     poisons (NaN + Inf — the quarantine path, both finite-guard codes),
     one synthetic XlaRuntimeError and one prefill OOM (the
     rebuild-and-recompute path), and one step stall (the watchdog).
-    Deterministic given (n_slots, seed): the same schedule replays
-    bit-identically across the ladder-on and ladder-off arms, which is
-    what makes their goodput comparison a controlled experiment."""
+    With `journal`, one ``io_error`` at the ``journal_write`` site —
+    the degraded-journal path (serving survives, durability is lost
+    and says so). Deterministic given (n_slots, seed): the same
+    schedule replays bit-identically across the ladder-on and
+    ladder-off arms, which is what makes their goodput comparison a
+    controlled experiment."""
     rng = np.random.default_rng(seed)
     slots = rng.permutation(n_slots)
     v = sorted(int(x) for x in rng.integers(8, 48, size=4))
-    return (
+    plan = (
         dict(site="prefill", kind="oom", visit=int(rng.integers(3, 8))),
         dict(site="decode", kind="nan", visit=v[0], slot=int(slots[0])),
         dict(site="decode", kind="inf", visit=v[1],
@@ -2025,6 +2031,10 @@ def chaos_fault_plan(n_slots: int, seed: int = 0,
         dict(site="decode", kind="xla_error", visit=v[2]),
         dict(site="decode", kind="stall", visit=v[3], stall_s=stall_s),
     )
+    if journal:
+        plan += (dict(site="journal_write", kind="io_error",
+                      visit=int(rng.integers(6, 24))),)
+    return plan
 
 
 def _run_chaos_arm(model, params, extra, requests, serve_cfg, max_new,
@@ -2145,7 +2155,13 @@ def run_chaos_bench(
     )
     max_prompt = max(len(p) for _, p in requests)
     max_len = -(-(max_prompt + max_new) // 16) * 16  # page multiple
-    plan = chaos_fault_plan(n_slots, seed=seed, stall_s=stall_s)
+    # the chaos arms run JOURNALED with an injected journal_write
+    # io_error in the schedule: the soak deterministically exercises
+    # the degraded-journal path (serving survives losing its journal;
+    # the entry records that the degrade actually fired)
+    plan = chaos_fault_plan(n_slots, seed=seed, stall_s=stall_s,
+                            journal=True)
+    journal_dir = tempfile.mkdtemp(prefix="serve_chaos_journal_")
     base_cfg = ServeConfig(
         n_slots=n_slots,
         max_len=max_len,
@@ -2164,8 +2180,12 @@ def run_chaos_bench(
     chaos_cfg = dataclasses.replace(
         ref_cfg, fault_plan=plan,
         fault_step_deadline_s=max(0.25, 0.75 * stall_s),
+        journal_path=os.path.join(journal_dir, "chaos_off.jsonl"),
     )
-    ladder_cfg = dataclasses.replace(chaos_cfg, degrade=True)
+    ladder_cfg = dataclasses.replace(
+        chaos_cfg, degrade=True,
+        journal_path=os.path.join(journal_dir, "chaos_on.jsonl"),
+    )
 
     def params_for(i: int) -> SamplingParams:
         return SamplingParams(slo=SLO_CLASS_CYCLE[i % len(SLO_CLASS_CYCLE)])
@@ -2254,6 +2274,10 @@ def run_chaos_bench(
                 off_snap.get("serve/fault_recovery_s", 0.0), 4),
             "watchdog_stalls": int(
                 off_snap.get("serve/watchdog_stalls", 0)),
+            # the injected journal_write io_error must have degraded
+            # the journal WITHOUT taking any stream down (streams_
+            # survived above counts through the same arm)
+            "journal_degraded_exercised": bool(off_eng._journal_degraded),
             **leak_fields,
             "ladder_zero_leak": on_leaks["zero_leak"],
             "goodput_ladder_on": round(goodput_on, 2),
@@ -2267,6 +2291,190 @@ def run_chaos_bench(
                 (1.0 - armed_rps / plain_rps) * 100.0, 2),
             "armed_requests_per_sec": round(armed_rps, 2),
             "plain_requests_per_sec": round(plain_rps, 2),
+            **_kv_entry_fields(ref_eng),
+            **probe_fields,
+        },
+    }
+
+
+def _journal_params_for(i: int) -> SamplingParams | None:
+    """The kill-and-recover arm's per-request sampling cycle: greedy
+    plus two SEEDED stochastic shapes — every stream is replayable
+    (seeded chains fold only (seed, sample index)), so the recovered-vs-
+    uninterrupted comparison covers stochastic sampling, not just
+    argmax."""
+    if i % 3 == 1:
+        return SamplingParams(temperature=0.8, top_p=0.9, seed=1000 + i)
+    if i % 3 == 2:
+        return SamplingParams(temperature=1.2, top_k=8, seed=2000 + i)
+    return None
+
+
+def run_journal_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    seed: int = 0,
+    reps: int = 4,
+    kill_step: int | None = None,
+    journal_dir: str | None = None,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """`cli serve-bench --journal`: the durability workload.
+
+    Two arms, one entry:
+
+    * overhead — ABBA-paired journal-on vs journal-off req/s on the
+      Poisson trace (`journal_overhead_pct`; budget <= 2%%: records are
+      buffered writes, fsync is batched ONCE per engine step).
+    * kill-and-recover — every request submitted up front through a
+      journaled engine; the engine is ABANDONED mid-decode (after a
+      third of the requests finish, or at `kill_step`), a FRESH engine
+      opens the same journal, `recover()` requeues the live set, and
+      the drain completes every stream. `recovered_token_exact` pins
+      every stream — finished-before-kill AND recovered — byte-
+      identical to an uninterrupted reference run (greedy + seeded
+      stochastic mix); `recovery_wall_s` is engine-construction ->
+      last recovered finish; `zero_leak` holds after the drain.
+    """
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    max_len = -(-(max_prompt + max_new) // 16) * 16
+    jdir = journal_dir or tempfile.mkdtemp(prefix="serve_journal_bench_")
+    base_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_len,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        seed=seed,
+    )
+    jcfg = dataclasses.replace(
+        base_cfg, journal_path=os.path.join(jdir, "overhead.jsonl")
+    )
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, base_cfg, max_new,
+        status_port=status_port,
+    )
+
+    # reference arm FIRST: the uninterrupted token oracle for the
+    # kill-and-recover comparison, and — like the chaos bench's — the
+    # plain-path jit warmup (the observatory probe populates only the
+    # registry's AOT executables, so without this the paired arm's
+    # first run would eat the cold compile and bias whichever side
+    # drew it). All requests up front: recovery exactness is
+    # per-request and independent of arrival timing.
+    upfront = [(0.0, p) for _, p in requests]
+    ref_eng, ref_handles, _ = _run_engine_arm(
+        model, params, extra, upfront, base_cfg, max_new,
+        params_for=_journal_params_for,
+    )
+
+    # ---- overhead arm: journal-on vs journal-off, ABBA + mean
+    mk_on, mk_off, on_eng = _paired_makespans(
+        model, params, extra, requests, jcfg, base_cfg, max_new,
+        reps=reps,
+    )
+    on_rps = n_requests / (sum(mk_on) / len(mk_on))
+    off_rps = n_requests / (sum(mk_off) / len(mk_off))
+    jstats = on_eng.journal.stats()
+
+    # ---- kill-and-recover arm
+    kcfg = dataclasses.replace(
+        base_cfg, journal_path=os.path.join(jdir, "recover.jsonl")
+    )
+    eng_a = ServeEngine(model, params, kcfg, extra_variables=extra)
+    handles = [
+        eng_a.submit(p, max_new_tokens=max_new,
+                     params=_journal_params_for(i))
+        for i, (_, p) in enumerate(requests)
+    ]
+    finish_target = max(1, n_requests // 3)
+    steps = 0
+    while eng_a.has_work():
+        eng_a.step()
+        steps += 1
+        done = sum(1 for h in handles if h.done)
+        if kill_step is not None:
+            if steps >= kill_step:
+                break
+        elif done >= finish_target and done < n_requests:
+            break
+    finished_before = sum(1 for h in handles if h.done)
+    live_at_kill = n_requests - finished_before
+    # ABANDON eng_a (the in-process stand-in for a SIGKILL: no close,
+    # no drain — only what the journal already flushed survives; the
+    # CI crash-recovery smoke does the real SIGKILL through cli serve)
+    del eng_a
+
+    t0 = time.monotonic()
+    eng_b = ServeEngine(model, params, kcfg, extra_variables=extra)
+    resumed = eng_b.recover()
+    eng_b.run()
+    recovery_wall_s = time.monotonic() - t0
+    assert all(r.done for r in resumed), "recovery drained unfinished"
+    by_rid = {r.trace_id: r for r in resumed}
+    exact = True
+    for h, r in zip(handles, ref_handles):
+        stream = (by_rid[h.trace_id].tokens if h.trace_id in by_rid
+                  else h.tokens)
+        if stream != r.tokens:
+            exact = False
+            break
+    leak_fields = _zero_leak_fields(eng_b)
+
+    if status_hold_s > 0 and probe_eng is not None:
+        time.sleep(status_hold_s)
+    if probe_eng is not None:
+        probe_eng.close()
+    return {
+        "metric": "serve_journal_recovered_requests",
+        "value": len(resumed),
+        "unit": (f"in-flight requests recovered token-exactly after a "
+                 f"mid-decode kill ({live_at_kill} live at kill)"),
+        "vs_baseline": round(len(resumed) / live_at_kill, 4)
+        if live_at_kill else 1.0,
+        "detail": {
+            "config": config,
+            "workload": "journal",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "reps": reps,
+            "journal_overhead_pct": round(
+                (1.0 - on_rps / off_rps) * 100.0, 2),
+            "journal_on_requests_per_sec": round(on_rps, 2),
+            "journal_off_requests_per_sec": round(off_rps, 2),
+            "journal_records": jstats["records"],
+            "journal_bytes": jstats["bytes_written"],
+            "journal_fsyncs": jstats["fsyncs"],
+            "journal_fsync_s": jstats["fsync_s"],
+            "journal_rotations": jstats["rotations"],
+            "kill_after_steps": steps,
+            "finished_before_kill": finished_before,
+            "live_at_kill": live_at_kill,
+            "recovered_requests": len(resumed),
+            "recovery_wall_s": round(recovery_wall_s, 4),
+            "recovered_token_exact": exact,
+            **leak_fields,
             **_kv_entry_fields(ref_eng),
             **probe_fields,
         },

@@ -120,6 +120,17 @@ arms the deterministic seeded fault-injection plane (None-pattern off),
 `ServeConfig.degrade` the SLO/ledger-driven degradation ladder (shed
 prefix leaves -> hold speculation -> load-shed admissions by class with
 jittered Retry-After; hysteresis both ways).
+
+Durable serving (`serve/journal.py`, opt-in via
+`ServeConfig.journal_path`): a request write-ahead journal records
+submit/commit/finish events (commits once per decode-block boundary,
+fsync batched once per step) with atomic live-set compaction; on boot,
+`ServeEngine.recover()` replays unfinished entries through the
+preemption-resume machinery — greedy and seeded plain-path streams
+continue TOKEN-EXACT across a process kill — and the HTTP front door
+resumes SSE streams from `Last-Event-ID`. Journal I/O failures degrade
+to journal-off with one warning (serving outlives its durability
+plane) unless `journal_strict` escalates them.
 """
 
 from __future__ import annotations
@@ -128,6 +139,8 @@ import contextlib
 import dataclasses
 import functools
 import time
+import uuid
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -148,6 +161,7 @@ from solvingpapers_tpu.serve.faults import (
     classify_failure,
 )
 from solvingpapers_tpu.serve.grammar import encode_allow
+from solvingpapers_tpu.serve.journal import Journal, JournalError
 from solvingpapers_tpu.serve.kv_pool import (
     TRASH_PAGE,
     KVSlotPool,
@@ -424,6 +438,37 @@ class ServeConfig:
     fault_retry_backoff_s: float = 0.05
     fault_recover_backoff_s: float = 0.25
     fault_step_deadline_s: float | None = None
+    # Request write-ahead journal (serve/journal.py, opt-in via
+    # journal_path — the None-pattern, like the tracer and the fault
+    # plane): an fsync'd append-only JSONL journal recording submit
+    # (prompt ids + full SamplingParams incl. seed + SLO class +
+    # arrival), commit (committed token ids, once per decode-block
+    # boundary riding the host-mirror drain — never per token) and
+    # finish (reason + usage) events, with atomic tmp+rename live-set
+    # compaction so the file stays O(active requests). On boot,
+    # `ServeEngine.recover()` replays unfinished entries through the
+    # preemption-resume machinery: greedy/seeded recovered streams are
+    # TOKEN-EXACT vs an uninterrupted run (seeded chains fold only
+    # (seed, sample index)). fsync is batched once per engine step, so
+    # a SIGKILL loses at most one step's records.
+    #   journal_path     JSONL journal file; an existing file is LOADED
+    #                    (the recovery source), then appended. None =
+    #                    off: one `is not None` branch per hook.
+    #   journal_strict   journal I/O failures (disk full; injected via
+    #                    the fault plane's journal_write/io_error site)
+    #                    normally degrade to journal-off with a single
+    #                    warning and the serve/journal_degraded gauge —
+    #                    serving survives, durability is lost and SAYS
+    #                    so. strict=True propagates the failure instead
+    #                    (a deployment that REQUIRES durability fails
+    #                    loudly rather than silently serving without it).
+    #   journal_rotate_bytes / journal_rotate_finished   compaction
+    #                    triggers: rewrite to the live set once this
+    #                    many bytes / finish records accumulate.
+    journal_path: str | None = None
+    journal_strict: bool = False
+    journal_rotate_bytes: int = 4 << 20
+    journal_rotate_finished: int = 256
     # Degradation ladder (serve/faults.py DegradationLadder, opt-in):
     # under sustained pressure — paged-pool page exhaustion
     # (pages_free below degrade_free_page_frac of the budget),
@@ -1590,6 +1635,30 @@ class ServeEngine:
                 f"the watchdog), got {cfg.fault_step_deadline_s}"
             )
         self._faults = FaultPlan.from_config(cfg.fault_plan)
+        # request write-ahead journal (serve/journal.py; see the
+        # ServeConfig knob block). None-pattern off; opening an existing
+        # path LOADS it — `recover()` is the boot step that replays it.
+        if cfg.journal_strict and cfg.journal_path is None:
+            raise ValueError(
+                "journal_strict escalates journal I/O failures, which "
+                "needs journal_path set — without a journal the knob "
+                "would silently do nothing"
+            )
+        self.journal = None
+        self._journal_degraded = False
+        self._recovered_total = 0
+        # trace_id -> live recovered Request: the HTTP front door's
+        # Last-Event-ID reconnect surface after a restart (entries drop
+        # when the dict is rebuilt on the next recover(); bounded by the
+        # live set at recovery time)
+        self._recovered: dict[str, Request] = {}
+        if cfg.journal_path is not None:
+            self.journal = Journal(
+                cfg.journal_path,
+                rotate_bytes=cfg.journal_rotate_bytes,
+                rotate_finished=cfg.journal_rotate_finished,
+            )
+            self.metrics.add_gauge_provider(self._journal_gauges)
         # per-slot logits-poison row: rides the LAST row/element of every
         # packed control transfer (all-zero = bitwise no-op inside the
         # programs), written by the plan's decode-site pokes and cleared
@@ -1757,6 +1826,7 @@ class ServeEngine:
         deadline_s: float | None = None,
         grammar=None,
         stream_cb=None,
+        trace_id: str | None = None,
     ) -> Request:
         """Enqueue one request; returns its live handle immediately.
 
@@ -1783,6 +1853,12 @@ class ServeEngine:
         deadlines, stop strings without a `detokenize` callable, a
         grammar alongside an explicit eos_id, and a budget too small
         for the grammar's shortest complete document.
+
+        `trace_id` is the request's durable identity (the HTTP front
+        door passes its X-Request-Id): it keys the write-ahead journal
+        record and the Last-Event-ID resume surface. With the journal
+        on and no id supplied, one is minted — a journaled request must
+        always be addressable after a restart.
         """
         arr = np.asarray(prompt)
         # size first: np.asarray([]) defaults to float64, and leading with
@@ -1873,6 +1949,7 @@ class ServeEngine:
             grammar=grammar,
             stream_cb=stream_cb,
         )
+        req.trace_id = trace_id
         if deadline_s is not None:
             req.deadline = req.submit_time + deadline_s
         # fault boundary: an unhealthy engine is draining — it must not
@@ -1916,6 +1993,10 @@ class ServeEngine:
         else:
             if req.deadline is not None:
                 self._waiting_deadlines += 1
+            # journal AFTER acceptance: a rejected request has no
+            # durable life to replay (the write-ahead contract is
+            # "accepted work survives", not "every knock on the door")
+            self._journal_submit(req)
             if self.trace is not None:
                 self.trace.instant("submit", "request", "queue", req=req.id,
                                    ts=req.submit_time, prompt_len=prompt.size)
@@ -2074,6 +2155,13 @@ class ServeEngine:
             # would collapse the median until every real step looks slow
             if self._mon is not None and (n_admitted or decode_slots):
                 self._mon.observe_step(dur)
+        # the journal's batched durability point: ONE fsync per step
+        # covering every record the step appended (submit records ride
+        # the next step's sync — a kill loses at most one step's worth,
+        # the same boundary tokens commit to streams at). Gated on
+        # dirty so idle polls never touch the fault-plane visit counter.
+        if self.journal is not None and self.journal.dirty:
+            self._journal_op(self.journal.sync)
         self._step_idx += 1
         return finished
 
@@ -2159,7 +2247,7 @@ class ServeEngine:
                                    slot=spec.slot)
             if spec.kind == "stall":
                 time.sleep(spec.stall_s)
-            elif spec.kind in ("xla_error", "oom"):
+            elif spec.kind in ("xla_error", "oom", "io_error"):
                 raise InjectedFault(spec.kind, site)
             elif spec.kind in ("nan", "inf"):
                 k = FAULT_NAN if spec.kind == "nan" else FAULT_INF
@@ -2249,6 +2337,205 @@ class ServeEngine:
         self._failed_since = None
         self._consec_failures = 0
         self._backoff = self.config.fault_recover_backoff_s
+
+    # ----------------------------------------------- write-ahead journal
+
+    def _journal_op(self, fn, *args) -> None:
+        """Run one journal operation inside the durability-failure
+        boundary: the fault plane's ``journal_write`` site pokes first
+        (an ``io_error`` spec raises here, exactly where a real disk
+        failure would), and any I/O failure degrades the engine to
+        journal-off with ONE warning and the serve/journal_degraded
+        gauge — serving must survive losing its journal — unless
+        `journal_strict` deliberately lets the failure propagate."""
+        if self.journal is None or self._journal_degraded:
+            return
+        try:
+            self._poke_site("journal_write")
+            fn(*args)
+        except (JournalError, OSError, InjectedFault) as exc:
+            if isinstance(exc, InjectedFault) and exc.kind != "io_error":
+                raise
+            if self.config.journal_strict:
+                raise
+            self._journal_degraded = True
+            warnings.warn(
+                f"write-ahead journal failed ({type(exc).__name__}: "
+                f"{exc}) — degrading to journal-off: serving continues, "
+                "crash recovery and stream resumption are LOST from "
+                "here (set ServeConfig.journal_strict to fail loudly "
+                "instead)",
+                stacklevel=2,
+            )
+            if self.trace is not None:
+                self.trace.instant("journal_degraded", "engine", "engine",
+                                   error=str(exc)[:200])
+
+    def _journal_submit(self, req: Request) -> None:
+        if self.journal is None:
+            return
+        if req.trace_id is None or self.journal.is_live(req.trace_id):
+            # a journaled request must be addressable after a restart —
+            # and a client RE-USING a still-live X-Request-Id must not
+            # merge two streams' commits into one journal record (the
+            # in-memory registry keeps its documented last-wins
+            # behavior; the duplicate gets a fresh durable id)
+            req.trace_id = uuid.uuid4().hex
+        # grammar steppers are host state the journal cannot replay:
+        # such a request is journaled for INSPECTION but flagged, and
+        # recovery finishes it "error" instead of resuming it
+        self._journal_op(
+            self.journal.append_submit, req.trace_id, req.prompt,
+            req.max_new_tokens, req.eos_id,
+            dataclasses.asdict(req.params), req.submit_time,
+            req.grammar is not None,
+            None if req.deadline is None
+            else max(req.deadline - req.submit_time, 1e-3),
+        )
+
+    def _journal_commit(self, req: Request, tokens) -> None:
+        if self.journal is not None and len(tokens):
+            self._journal_op(self.journal.append_commit, req.trace_id,
+                             tokens)
+
+    def _journal_finish(self, req: Request) -> None:
+        if self.journal is not None:
+            self._journal_op(self.journal.append_finish, req.trace_id,
+                             req.finish_reason or "unknown", {
+                                 "prompt_tokens": int(req.prompt.size),
+                                 "completion_tokens": len(req.tokens),
+                             })
+
+    def _journal_gauges(self) -> dict[str, float]:
+        """Journal gauges riding every metrics snapshot (registered iff
+        `journal_path` — the present-iff-enabled key-surface contract
+        of the paged/spec/observatory gauges)."""
+        s = self.journal.stats()
+        return {
+            "serve/journal_records": float(s["records"]),
+            "serve/journal_bytes": float(s["bytes_written"]),
+            "serve/journal_fsync_s": s["fsync_s"],
+            "serve/journal_live": float(s["live"]),
+            "serve/journal_degraded": float(self._journal_degraded),
+            "serve/recovered_requests": float(self._recovered_total),
+        }
+
+    def recover(self) -> list[Request]:
+        """Replay the journal's unfinished entries through the
+        preemption-resume machinery: each live entry becomes a WAITING
+        request carrying its committed tokens; admission re-prefills
+        prompt + committed[:-1], discards the resampled token and
+        continues decoding — TOKEN-EXACT vs an uninterrupted run for
+        greedy streams (any configuration) and seeded stochastic
+        streams on the plain decode path (seeded chains fold only
+        (seed, sample index); tests/test_journal.py pins it across
+        pools and kv_quant; under speculation stochastic streams are
+        distribution-exact, the live-preemption contract). Call ONCE
+        at boot, before the first step.
+
+        Entries the new engine cannot honor resume-exactly — grammar
+        requests (host stepper state), stop-string requests on an
+        engine without `detokenize`, kv_exact without sidecar lanes, a
+        prompt beyond this engine's capacity, or an unparseable params
+        record — finish ``"error"`` in the journal instead of being
+        silently dropped. An entry whose committed stream already
+        satisfies a stop condition (the crash landed between its final
+        commit and its finish record) is finished with that reason.
+        Returns the requests actually requeued (oldest first); the
+        journal is compacted to exactly that live set."""
+        if self.journal is None:
+            raise ValueError(
+                "recover() replays the write-ahead journal, which needs "
+                "ServeConfig.journal_path set"
+            )
+        limit = getattr(self.model, "max_positions", None)
+        cap = min(self.config.max_len, limit or self.config.max_len)
+        resumed: list[Request] = []
+        for e in self.journal.live_entries():
+            usage = {"prompt_tokens": len(e.prompt),
+                     "completion_tokens": len(e.tokens)}
+            err = None
+            params = None
+            if e.grammar:
+                err = "grammar stepper state is not journaled"
+            else:
+                try:
+                    p = dict(e.params)
+                    p["stop_token_ids"] = tuple(
+                        p.get("stop_token_ids") or ())
+                    p["stop"] = tuple(p.get("stop") or ())
+                    params = SamplingParams(**p)
+                except (TypeError, ValueError) as exc:
+                    err = f"unreplayable params: {exc}"
+            if err is None:
+                if len(e.prompt) < 1 or \
+                        len(e.prompt) + e.max_new_tokens > cap:
+                    err = f"beyond this engine's capacity {cap}"
+                elif params.stop and self.detokenize is None:
+                    err = "stop strings need a detokenize callable"
+                elif (params.kv_exact and self._quant
+                      and not self.config.kv_exact_lanes):
+                    err = "kv_exact needs exact sidecar lanes"
+                elif params.slo is not None and (
+                    self._slo is None or params.slo not in self._slo.targets
+                ):
+                    # the SLO class is accounting, not semantics: keep
+                    # the stream, drop the untracked tag
+                    params = dataclasses.replace(params, slo=None)
+            if err is not None:
+                warnings.warn(
+                    f"journal entry {e.rid} cannot be recovered ({err}) "
+                    "— finishing it \"error\"", stacklevel=2,
+                )
+                self._journal_op(self.journal.append_finish, e.rid,
+                                 "error", usage)
+                continue
+            req = Request(
+                prompt=np.asarray(e.prompt, np.int32),
+                max_new_tokens=e.max_new_tokens,
+                eos_id=e.eos_id, params=params,
+            )
+            req.trace_id = e.rid
+            req.tokens = [int(t) for t in e.tokens]
+            if e.deadline_s is not None:
+                # absolute deadlines cannot cross a restart (monotonic
+                # clocks reset), so the recovered request re-arms its
+                # ORIGINAL relative budget from now — bounded again,
+                # not unbounded
+                req.deadline = req.submit_time + e.deadline_s
+            reason = (self._stop_reason(req, req.tokens[-1])
+                      if req.tokens else None)
+            if (reason is None and req.tokens and params.stop
+                    and self._stop_string_at(req, 0) is not None):
+                # commits are written AFTER stop-string truncation, so
+                # a committed stream never extends past a match — any
+                # match here means the stream was complete at the crash
+                reason = "stop"
+            if reason is not None:
+                # the crash landed between the final commit and its
+                # finish record: the stream is already complete
+                req.state = FINISHED
+                req.finish_reason = reason
+                req.finish_time = smetrics.now()
+                self._journal_op(self.journal.append_finish, e.rid,
+                                 reason, usage)
+                continue
+            resumed.append(req)
+        # oldest ends at the queue head: FIFO order survives the crash
+        for req in reversed(resumed):
+            self.scheduler.requeue_front(req)
+            if req.deadline is not None:
+                self._waiting_deadlines += 1
+        self._recovered = {r.trace_id: r for r in resumed}
+        self._recovered_total = len(resumed)
+        # compact to exactly the live set (and make it durable): a
+        # recovered journal starts O(active), not O(crash history)
+        self._journal_op(self.journal.compact)
+        self._journal_op(self.journal.sync)
+        if self.trace is not None:
+            self.trace.instant("journal_recover", "engine", "engine",
+                               resumed=len(resumed))
+        return resumed
 
     def _rebuild_pool(self, requeue: bool) -> None:
         """Replace the device pool with fresh buffers after a systemic
@@ -2495,6 +2782,13 @@ class ServeEngine:
             d["health"]["fault_plan"] = self._faults.stats()
         if self._ladder is not None:
             d["health"]["ladder"] = self._ladder.stats()
+        if self.journal is not None:
+            d["journal"] = {
+                **self.journal.stats(),
+                "strict": self.config.journal_strict,
+                "degraded": self._journal_degraded,
+                "recovered_requests": self._recovered_total,
+            }
         if self._paged:
             d["kv_pages"] = {
                 "page_size": self.pool.page_size,
@@ -2566,6 +2860,11 @@ class ServeEngine:
             self.step()
         if self.has_work():
             self.force_drain("cancelled")
+        if self.journal is not None and self.journal.dirty:
+            # make the drain's finish records durable before the
+            # process goes away (the journal stays open — the engine
+            # itself stays usable after close())
+            self._journal_op(self.journal.sync)
         self.stop_profile()
         if self.status is not None:
             self.status.close()
@@ -3115,6 +3414,9 @@ class ServeEngine:
             req.grammar.advance(first)
         if req.params.logprobs:
             req.logprobs.append(float(logprob))
+        # the first token is a one-token commit at the admission
+        # boundary (decode blocks commit the rest block-by-block)
+        self._journal_commit(req, (first,))
         self.metrics.record_first_token(req, now, prefilled=suffix)
         if tr is not None:
             # lifecycle spans stamped from the request's OWN timestamps:
@@ -3392,6 +3694,10 @@ class ServeEngine:
                         del req.logprobs[kk + 1:]
                     appended -= last - kk
                     reason = "stop"
+            # one commit per request per speculative block (same
+            # boundary as the plain block's — the drafts' variable
+            # commit counts are invisible to the journal)
+            self._journal_commit(req, req.tokens[base:])
             self.metrics.record_tokens(
                 req, appended, now - self._last_emit[slot], now
             )
@@ -3569,6 +3875,10 @@ class ServeEngine:
                         del req.logprobs[k + 1:]
                     appended -= last - k
                     reason = "stop"
+            # ONE commit record per request per block, riding the same
+            # host-mirror drain that appended the tokens (the journal's
+            # granularity is the engine's — never per token)
+            self._journal_commit(req, req.tokens[base:])
             self.metrics.record_tokens(
                 req, appended, now - self._last_emit[slot], now
             )
@@ -3616,6 +3926,7 @@ class ServeEngine:
             # finish-boundary count IS the peak)
             req.pages_held = max(req.pages_held,
                                  int(self.pool.n_alloc[req.slot]))
+        self._journal_finish(req)
         self.metrics.record_finish(req, now)
         if self._slo is not None:
             req.slo_result = self._slo.observe(req, now)
@@ -3661,6 +3972,7 @@ class ServeEngine:
         req.state = FINISHED
         req.finish_reason = reason
         req.finish_time = now
+        self._journal_finish(req)
         self.metrics.record_finish(req, now)
         if self._slo is not None:
             req.slo_result = self._slo.observe(req, now)

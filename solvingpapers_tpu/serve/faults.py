@@ -13,7 +13,9 @@ correctness-under-failure layer, in three pieces the engine composes:
   through the hot path — ``prefill`` (admission dispatch), ``decode``
   (the decode/spec block dispatch), ``scatter`` (the post-block output
   fetch / paged scatter boundary), ``prefix_splice`` (prefix-cache
-  reuse), ``sse_write`` (the HTTP front door's event writer) — and each
+  reuse), ``sse_write`` (the HTTP front door's event writer),
+  ``journal_write`` (the write-ahead journal's append/fsync boundary,
+  serve/journal.py) — and each
   visit of a site advances a per-site counter; a `FaultSpec` fires at an
   exact visit index, so a fault schedule replays bit-identically
   run-to-run. KINDS: ``nan``/``inf`` poison one slot's logits inside
@@ -21,8 +23,10 @@ correctness-under-failure layer, in three pieces the engine composes:
   transfer — exercising the traced finite-logits guard), ``xla_error``/
   ``oom`` raise a synthetic `InjectedFault` the failure classifier
   treats exactly like a real `XlaRuntimeError` / RESOURCE_EXHAUSTED,
-  ``stall`` sleeps the step past the watchdog deadline, and
-  ``socket_reset`` breaks an SSE write mid-stream. Every recovery path
+  ``stall`` sleeps the step past the watchdog deadline,
+  ``socket_reset`` breaks an SSE write mid-stream, and ``io_error``
+  fails a journal write (exercising the degrade-to-journal-off path —
+  or, under `journal_strict`, the loud failure). Every recovery path
   below is therefore testable on CPU in tier-1.
 
 * `classify_failure` — the failure taxonomy the engine's supervised
@@ -65,8 +69,9 @@ __all__ = [
 ]
 
 FAULT_SITES = ("prefill", "decode", "scatter", "prefix_splice",
-               "sse_write")
-FAULT_KINDS = ("nan", "inf", "xla_error", "oom", "stall", "socket_reset")
+               "sse_write", "journal_write")
+FAULT_KINDS = ("nan", "inf", "xla_error", "oom", "stall", "socket_reset",
+               "io_error")
 
 # fault-row codes the compiled programs decode (0 = clean slot); the
 # poison is applied with jnp.where, so an all-zero row is bitwise a
@@ -88,8 +93,12 @@ class InjectedFault(RuntimeError):
     the point: the recovery path under test is the production one)."""
 
     def __init__(self, kind: str, site: str):
-        tag = ("RESOURCE_EXHAUSTED: injected device OOM"
-               if kind == "oom" else "injected XlaRuntimeError")
+        if kind == "oom":
+            tag = "RESOURCE_EXHAUSTED: injected device OOM"
+        elif kind == "io_error":
+            tag = "injected journal I/O error"
+        else:
+            tag = "injected XlaRuntimeError"
         super().__init__(f"{tag} at site {site!r}")
         self.kind = kind
         self.site = site
@@ -99,13 +108,20 @@ def classify_failure(exc: BaseException) -> str:
     """The taxonomy the supervised step boundary switches on:
     ``"systemic"`` for device-runtime failures (injected or real XLA
     runtime errors / OOM — the pool may hold donated garbage, so the
-    remedy is rebuild-and-recompute), ``"host"`` for everything else
-    (a host-side bug; the pool was never touched, but the step's
-    outcome is unknown — treated with the same rebuild remedy, the
-    conservative choice)."""
+    remedy is rebuild-and-recompute), ``"io"`` for host I/O failures
+    (OSError, the journal's JournalError, or an injected ``io_error``
+    — the DEVICE pool is untouched, so the remedy is degrade-the-
+    durability-plane, not rebuild; the engine's journal boundary
+    handles these before they ever reach the step boundary unless
+    `journal_strict` deliberately lets them escape), ``"host"`` for
+    everything else (a host-side bug; the pool was never touched, but
+    the step's outcome is unknown — treated with the same rebuild
+    remedy, the conservative choice)."""
     if isinstance(exc, InjectedFault):
-        return "systemic"
+        return "io" if exc.kind == "io_error" else "systemic"
     name = type(exc).__name__
+    if isinstance(exc, OSError) or "JournalError" in name:
+        return "io"
     text = f"{name}: {exc}"
     if any(m in text for m in _SYSTEMIC_MARKERS):
         return "systemic"
@@ -146,12 +162,19 @@ class FaultSpec:
             raise ValueError(
                 "socket_reset only makes sense at the sse_write site"
             )
-        if self.kind in ("xla_error", "oom") and self.site == "sse_write":
+        if self.kind in ("xla_error", "oom") and self.site in (
+            "sse_write", "journal_write"
+        ):
             raise ValueError(
                 f"{self.kind} is a device-runtime failure and needs an "
-                "engine site (the sse_write hook only acts on "
-                "socket_reset/stall — the spec would fire and count as "
+                "engine site (the sse_write/journal_write hooks only act "
+                "on their own kinds — the spec would fire and count as "
                 "injected while exercising nothing)"
+            )
+        if self.kind == "io_error" and self.site != "journal_write":
+            raise ValueError(
+                "io_error models a journal write/fsync failure and only "
+                "makes sense at the journal_write site"
             )
         if self.kind in ("nan", "inf") and self.site not in (
             "prefill", "decode"

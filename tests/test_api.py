@@ -99,9 +99,13 @@ def _stream_events(srv, body, timeout=120):
             buf += chunk
         frame, buf = buf.split(b"\n\n", 1)
         frame = frame.strip()
-        if not frame.startswith(b"data: "):
+        # SSE frames are field lines: chunks now lead with an
+        # ``id: <rid>:<offset>`` resume cursor before their data line
+        data_lines = [ln for ln in frame.split(b"\n")
+                      if ln.startswith(b"data: ")]
+        if not data_lines:
             continue  # heartbeat comments
-        payload = frame[6:]
+        payload = data_lines[-1][6:]
         if payload == b"[DONE]":
             s.close()
             events.append("DONE")

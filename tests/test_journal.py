@@ -1,0 +1,599 @@
+"""Durable-serving tests (serve/journal.py + engine recovery wiring +
+the HTTP resume surface in serve/api.py).
+
+Contracts under test. Journal mechanics: submit/commit/finish records
+round-trip through load; a torn final line (crash mid-write) is
+tolerated while mid-file corruption raises; compaction keeps the file
+O(live) under sustained finished traffic; concurrent writers never
+tear or interleave a record. Crash recovery: killing the engine at
+EVERY block boundary of a randomized schedule and replaying the
+journal through a fresh engine yields token-exact streams vs an
+uninterrupted run (greedy + seeded stochastic, both pools, spec on,
+kv_quant on) with `assert_no_leaks` after each restart's drain.
+Failure policy: an injected ``journal_write``/``io_error`` degrades to
+journal-off with ONE warning while every stream survives; strict mode
+propagates instead. HTTP: SSE chunks carry ``id:`` fields, a
+``Last-Event-ID`` reconnect replays exactly the missing tail, and
+`GET /v1/requests/<id>` falls back to the journal (source "journal")
+for requests evicted from the bounded registry.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_no_leaks
+from solvingpapers_tpu.serve import (
+    Journal,
+    JournalError,
+    ServeConfig,
+    ServeEngine,
+)
+from solvingpapers_tpu.serve.sampling import SamplingParams
+
+
+def _gpt_tiny():
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32,
+                          n_layers=2, n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _gpt_tiny()
+    return _MODEL
+
+
+def _prompts(n, seed=0, size=8):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, size=size).astype(np.int32)
+            for _ in range(n)]
+
+
+def _cfg(**kw):
+    base = dict(n_slots=3, max_len=32, decode_block=4, bucket=8,
+                max_prefills_per_step=3)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _params_for(i):
+    """Greedy + seeded stochastic cycle: every stream replayable."""
+    if i % 3 == 1:
+        return SamplingParams(temperature=0.8, top_p=0.9, seed=100 + i)
+    if i % 3 == 2:
+        return SamplingParams(temperature=1.3, top_k=8, seed=200 + i)
+    return None
+
+
+def _run_all(model, params, prompts, cfg, max_new=10, params_for=None):
+    eng = ServeEngine(model, params, cfg)
+    hs = [eng.submit(p, max_new_tokens=max_new,
+                     params=params_for(i) if params_for else None)
+          for i, p in enumerate(prompts)]
+    eng.run()
+    return eng, hs
+
+
+# --------------------------------------------------------- journal unit
+
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    j.append_submit("a", [1, 2, 3], 8, None,
+                    {"temperature": 0.0}, 1.5)
+    j.append_submit("b", [4], 4, 7, {"seed": 3}, 2.0, grammar=True)
+    j.append_commit("a", [9, 10])
+    j.append_commit("a", [11])
+    j.append_finish("b", "eos", {"prompt_tokens": 1,
+                                 "completion_tokens": 0})
+    j.sync()
+    j.close()
+    # crash-torn tail: a partial record without its newline
+    with open(path, "a") as f:
+        f.write('{"kind":"commit","rid":"a","tok')
+    j2 = Journal(path)
+    live = j2.live_entries()
+    assert [e.rid for e in live] == ["a"]
+    assert live[0].tokens == [9, 10, 11]
+    assert live[0].params == {"temperature": 0.0}
+    assert live[0].max_new_tokens == 8 and live[0].arrival == 1.5
+    fin = j2.lookup("b")
+    assert fin is not None and fin.finished and fin.finish_reason == "eos"
+    assert fin.grammar
+    j2.close()
+    # mid-file corruption is NOT a crash tail: it must raise
+    lines = open(path).read().splitlines()
+    lines[0] = "garbage{{{"
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(JournalError, match="corrupt"):
+        Journal(path)
+
+
+def test_journal_compaction_keeps_file_o_live(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, rotate_finished=8)
+    j.append_submit("live", [1, 2], 16, None, {}, 0.0)
+    j.append_commit("live", [5])
+    for i in range(40):
+        rid = f"r{i}"
+        j.append_submit(rid, [1], 4, None, {}, float(i))
+        j.append_commit(rid, [2, 3])
+        j.append_finish(rid, "length")
+    assert j.rotations >= 4
+    # the FILE holds only the live set (+ the records since the last
+    # rotation) — far below the 40 finished requests' record count
+    n_lines = sum(1 for _ in open(path))
+    assert n_lines <= 3 * 8 + 2
+    # finished entries within the keep window still look up on the
+    # LIVE instance (the in-memory window; rotation drops them from
+    # disk — that is the compaction contract)
+    assert j.lookup("r39") is not None and j.lookup("r39").finished
+    j.close()
+    j2 = Journal(path)
+    live = j2.live_entries()
+    assert [e.rid for e in live] == ["live"]
+    assert live[0].tokens == [5]  # committed tokens folded into compaction
+    assert j2.lookup("r39") is None  # compacted away on disk
+    j2.close()
+
+
+def test_journal_concurrent_writers_never_tear(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, rotate_finished=64)
+    n_threads, n_each = 6, 120
+
+    def writer(t):
+        for i in range(n_each):
+            rid = f"t{t}-{i}"
+            j.append_submit(rid, [t, i], 4, None, {"seed": i}, float(i))
+            j.append_commit(rid, [1, 2, 3])
+            j.append_finish(rid, "length")
+            if i % 7 == 0:
+                j.sync()
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    j.sync()
+    j.close()
+    # every line parses whole (no torn/interleaved records), and the
+    # reconstructed state balances: everything finished
+    kinds = []
+    for line in open(path):
+        rec = json.loads(line)  # raises on any torn record
+        kinds.append(rec["kind"])
+    j2 = Journal(path)
+    assert not j2.live_entries()
+    assert j2.records == 0  # loader rebuilds state, counters are per-run
+    j2.close()
+
+
+# ---------------------------------------------------- crash recovery
+
+
+def _combined_streams(handles, resumed_by_rid):
+    """Full per-request streams after a crash+recover: a handle that
+    finished pre-kill keeps its tokens; a live one's stream continues
+    in the recovered request object (same committed prefix)."""
+    out = []
+    for h in handles:
+        r = resumed_by_rid.get(h.trace_id)
+        out.append((r.tokens if r is not None else h.tokens))
+    return out
+
+
+def _crash_recover_exact(cfg_kw, n_req=5, max_new=10, kill_steps=(2,),
+                         params_for=_params_for):
+    model, params = _model()
+    prompts = _prompts(n_req)
+    ref_cfg = _cfg(**cfg_kw)
+    _, ref = _run_all(model, params, prompts, ref_cfg, max_new,
+                      params_for)
+    for k in kill_steps:
+        import tempfile
+
+        path = os.path.join(tempfile.mkdtemp(), "j.jsonl")
+        jcfg = _cfg(journal_path=path, **cfg_kw)
+        eng = ServeEngine(model, params, jcfg)
+        hs = [eng.submit(p, max_new_tokens=max_new,
+                         params=params_for(i) if params_for else None)
+              for i, p in enumerate(prompts)]
+        for _ in range(k):
+            if eng.has_work():
+                eng.step()
+        del eng  # SIGKILL stand-in: no close, no drain
+        eng2 = ServeEngine(model, params, jcfg)
+        resumed = eng2.recover()
+        eng2.run()
+        by_rid = {r.trace_id: r for r in resumed}
+        streams = _combined_streams(hs, by_rid)
+        for i, (got, want) in enumerate(zip(streams, ref)):
+            assert got == want.tokens, (
+                f"kill@{k}: stream {i} diverged after recovery"
+            )
+        assert_no_leaks(eng2)
+
+
+def test_recovery_token_exact_every_block_boundary_lane():
+    """Kill the engine at EVERY block boundary of a randomized
+    schedule (mixed greedy + seeded stochastic): recovery must be
+    token-exact at each of them, with zero leaks after each drain."""
+    model, params = _model()
+    prompts = _prompts(5, seed=3)
+    ref_cfg = _cfg()
+    _, ref = _run_all(model, params, prompts, ref_cfg, 10, _params_for)
+    # total steps an uninterrupted drain takes bounds the kill points
+    total = max(len(r.tokens) for r in ref) // ref_cfg.decode_block + 8
+    _crash_recover_exact({}, n_req=5, max_new=10,
+                         kill_steps=range(1, total))
+
+
+def test_recovery_token_exact_paged_pool():
+    _crash_recover_exact(dict(paged=True, page_size=8, prefix_page=8),
+                         kill_steps=(1, 3))
+
+
+def test_recovery_token_exact_speculative():
+    """Greedy streams under speculation: draft-and-verify is lossless
+    for greedy (exact argmax match), so recovery — which realigns the
+    draft windows at the resume point — stays token-exact. Seeded
+    STOCHASTIC streams under speculation are distribution-exact but
+    not replay-exact across a realignment (the committed value at a
+    position depends on which window element it was — the same
+    contract live paged preemption has), so they are deliberately not
+    pinned here; spec-off stochastic exactness is pinned above."""
+    _crash_recover_exact(dict(speculative="ngram", spec_k=2,
+                              spec_rounds=2), kill_steps=(1, 2),
+                         params_for=None)
+
+
+def test_recovery_token_exact_kv_quant():
+    _crash_recover_exact(dict(kv_quant="int8", kv_quant_block=8),
+                         kill_steps=(1, 3))
+
+
+def test_recovery_edge_cases(tmp_path):
+    """Entries the new engine cannot resume finish "error" instead of
+    vanishing; a stream complete at the crash boundary finishes with
+    its real reason; recover() without a journal raises."""
+    model, params = _model()
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    # grammar request: journaled, not replayable
+    j.append_submit("g", [1, 2], 8, None, {}, 0.0, grammar=True)
+    # complete-at-crash: committed stream already hit its budget
+    j.append_submit("done", [1, 2], 3, None, {}, 0.0)
+    j.append_commit("done", [4, 5, 6])
+    # oversized for this engine's capacity
+    j.append_submit("big", list(range(30)), 30, None, {}, 0.0)
+    # live, resumable
+    j.append_submit("ok", [1, 2, 3], 4, None, {"seed": 9,
+                                               "temperature": 1.0}, 0.0)
+    j.append_commit("ok", [7])
+    j.sync()
+    j.close()
+    eng = ServeEngine(model, params, _cfg(journal_path=path))
+    with pytest.warns(UserWarning, match="cannot be recovered"):
+        resumed = eng.recover()
+    assert [r.trace_id for r in resumed] == ["ok"]
+    assert resumed[0].tokens == [7]
+    assert eng.journal.lookup("g").finish_reason == "error"
+    assert eng.journal.lookup("big").finish_reason == "error"
+    assert eng.journal.lookup("done").finish_reason == "length"
+    eng.run()
+    assert resumed[0].done and len(resumed[0].tokens) == 4
+    assert_no_leaks(eng)
+    # journal-off engines cannot recover
+    eng2 = ServeEngine(model, params, _cfg())
+    with pytest.raises(ValueError, match="journal_path"):
+        eng2.recover()
+
+
+def test_recovered_streams_visible_in_gauges_and_statusz(tmp_path):
+    model, params = _model()
+    path = str(tmp_path / "j.jsonl")
+    cfg = _cfg(journal_path=path)
+    eng = ServeEngine(model, params, cfg)
+    eng.submit(_prompts(1)[0], max_new_tokens=8)
+    eng.step()
+    del eng
+    eng2 = ServeEngine(model, params, cfg)
+    resumed = eng2.recover()
+    assert len(resumed) == 1
+    snap = eng2.metrics.snapshot()
+    assert snap["serve/recovered_requests"] == 1.0
+    assert snap["serve/journal_degraded"] == 0.0
+    assert snap["serve/journal_live"] == 1.0
+    doc = eng2.statusz()
+    assert doc["journal"]["recovered_requests"] == 1
+    assert doc["journal"]["live"] == 1
+    eng2.run()
+    assert_no_leaks(eng2)
+    # journal-off: the key surface stays clean (present-iff-enabled)
+    eng3 = ServeEngine(model, params, _cfg())
+    snap3 = eng3.metrics.snapshot()
+    assert not any(k.startswith("serve/journal") for k in snap3)
+    assert "journal" not in eng3.statusz()
+
+
+# --------------------------------------------------- failure policy
+
+
+def test_journal_io_error_degrades_not_kills(tmp_path):
+    """An injected journal_write io_error flips the engine to
+    journal-off with ONE warning; every stream finishes normally and
+    the degraded gauge reports it."""
+    model, params = _model()
+    prompts = _prompts(4)
+    plan = [dict(site="journal_write", kind="io_error", visit=2)]
+    cfg = _cfg(journal_path=str(tmp_path / "j.jsonl"), fault_plan=plan)
+    eng = ServeEngine(model, params, cfg)
+    with pytest.warns(UserWarning, match="degrading to journal-off"):
+        hs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+    assert all(h.done and h.finish_reason == "length" for h in hs)
+    assert eng._journal_degraded
+    snap = eng.metrics.snapshot()
+    assert snap["serve/journal_degraded"] == 1.0
+    assert snap["serve/fault_injected"] >= 1.0
+    assert eng.statusz()["journal"]["degraded"] is True
+    assert_no_leaks(eng)
+    # streams match the journal-free engine's (greedy determinism)
+    _, ref = _run_all(model, params, prompts, _cfg(), 8)
+    assert [h.tokens for h in hs] == [r.tokens for r in ref]
+
+
+def test_journal_strict_propagates(tmp_path):
+    model, params = _model()
+    plan = [dict(site="journal_write", kind="io_error", visit=0)]
+    cfg = _cfg(journal_path=str(tmp_path / "j.jsonl"), fault_plan=plan,
+               journal_strict=True)
+    eng = ServeEngine(model, params, cfg)
+    from solvingpapers_tpu.serve.faults import InjectedFault
+
+    with pytest.raises(InjectedFault, match="journal I/O"):
+        eng.submit(_prompts(1)[0], max_new_tokens=4)
+
+
+def test_journal_fault_spec_validation():
+    from solvingpapers_tpu.serve.faults import (
+        FaultSpec,
+        InjectedFault,
+        classify_failure,
+    )
+
+    FaultSpec(site="journal_write", kind="io_error", visit=0)
+    with pytest.raises(ValueError, match="journal_write"):
+        FaultSpec(site="decode", kind="io_error", visit=0)
+    with pytest.raises(ValueError, match="device-runtime"):
+        FaultSpec(site="journal_write", kind="oom", visit=0)
+    assert classify_failure(
+        InjectedFault("io_error", "journal_write")) == "io"
+    assert classify_failure(OSError(28, "No space left")) == "io"
+    assert classify_failure(JournalError("disk gone")) == "io"
+    assert classify_failure(InjectedFault("oom", "decode")) == "systemic"
+
+
+def test_journal_strict_without_path_rejected():
+    model, params = _model()
+    with pytest.raises(ValueError, match="journal_strict"):
+        ServeEngine(model, params, _cfg(journal_strict=True))
+
+
+# ------------------------------------------------------- HTTP surface
+
+
+def _sse(url, body=None, headers=None, timeout=120):
+    import urllib.request
+
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        rid = r.headers.get("X-Request-Id")
+        cur = None
+        for raw in r:
+            line = raw.decode().rstrip("\n")
+            if line.startswith("id: "):
+                cur = line[4:]
+            elif line.startswith("data: "):
+                if line[6:] == "[DONE]":
+                    break
+                events.append((cur, json.loads(line[6:])))
+    return rid, events
+
+
+@pytest.fixture(scope="module")
+def journal_server(tmp_path_factory):
+    from solvingpapers_tpu.serve.api import ApiServer
+
+    model, params = _model()
+    path = str(tmp_path_factory.mktemp("j") / "serve.jsonl")
+    cfg = _cfg(api_port=0, journal_path=path, n_slots=2, max_len=48)
+    eng = ServeEngine(model, params, cfg)
+    srv = ApiServer(
+        eng, decode=lambda ids: "".join(chr(97 + i % 26) for i in ids),
+        model_name="gpt-tiny",
+    )
+    yield srv, eng
+    srv.close()
+
+
+def test_sse_ids_and_last_event_id_resume(journal_server):
+    """Every SSE chunk carries an ``id: <rid>:<offset>`` field; a
+    reconnect presenting Last-Event-ID replays exactly the missing
+    tail (text beyond the offset), and the combined text equals the
+    full stream's."""
+    srv, eng = journal_server
+    body = {"prompt": [1, 2, 3, 4], "max_tokens": 12, "stream": True}
+    rid, events = _sse(srv.url("/v1/completions"), body,
+                       {"X-Request-Id": "jrn-sse-1"})
+    assert rid == "jrn-sse-1"
+    ids = [i for i, _ in events]
+    assert all(i is not None and i.startswith("jrn-sse-1:") for i in ids)
+    assert ids[-1] == "jrn-sse-1:12"
+    full = "".join(e["choices"][0].get("text", "") for _, e in events)
+    # reconnect claiming we saw only 5 tokens
+    rid2, ev2 = _sse(srv.url("/v1/completions"), {},
+                     {"Last-Event-ID": "jrn-sse-1:5"})
+    tail = "".join(e["choices"][0].get("text", "") for _, e in ev2)
+    entry = eng.journal.lookup("jrn-sse-1")
+    dec = srv.decode
+    assert dec(entry.tokens[:5]) + tail == dec(entry.tokens) == full
+    assert ev2[-1][1]["choices"][0]["finish_reason"] == "length"
+    # malformed Last-Event-ID -> 400, unknown -> 404
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _sse(srv.url("/v1/completions"), {}, {"Last-Event-ID": "nope"})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _sse(srv.url("/v1/completions"), {},
+             {"Last-Event-ID": "ghost:3"})
+    assert ei.value.code == 404
+
+
+def test_requests_endpoint_journal_fallback(journal_server):
+    """A request evicted from the bounded in-memory registry still
+    answers GET /v1/requests/<id> from the journal, marked
+    source="journal" and carrying the committed tokens."""
+    import urllib.request
+
+    srv, eng = journal_server
+    body = {"prompt": [5, 6, 7], "max_tokens": 6, "stream": True}
+    _sse(srv.url("/v1/completions"), body,
+         {"X-Request-Id": "jrn-evicted"})
+    # registry doc first (normal path, no source marker)
+    with urllib.request.urlopen(
+        srv.url("/v1/requests/jrn-evicted")
+    ) as r:
+        doc = json.loads(r.read())
+    assert "source" not in doc and doc["state"] == "finished"
+    # evict from the registry -> journal fallback
+    with srv._timeline_lock:
+        srv._timelines.clear()
+    with urllib.request.urlopen(
+        srv.url("/v1/requests/jrn-evicted")
+    ) as r:
+        doc = json.loads(r.read())
+    assert doc["source"] == "journal"
+    assert doc["state"] == "finished"
+    assert doc["finish_reason"] == "length"
+    assert len(doc["tokens"]) == 6
+    assert doc["usage"]["completion_tokens"] == 6
+    assert doc["facts"]["prompt_tokens"] == 3
+
+
+def test_resume_after_restart_replays_recovered_stream(tmp_path):
+    """The cross-process resume shape, in-process: journaled engine
+    dies mid-stream; a fresh engine + server on the same journal
+    recovers; a Last-Event-ID reconnect on the NEW server replays the
+    committed prefix past the client's offset and streams the live
+    tail to [DONE] — byte-identical to an uninterrupted run."""
+    from solvingpapers_tpu.serve.api import ApiServer, EngineLoop
+
+    model, params = _model()
+    dec = lambda ids: "".join(chr(97 + i % 26) for i in ids)  # noqa: E731
+    prompt = np.asarray([3, 1, 4, 1, 5], np.int32)
+    ref_eng = ServeEngine(model, params, _cfg())
+    ref = ref_eng.submit(prompt, max_new_tokens=12)
+    ref_eng.run()
+
+    path = str(tmp_path / "j.jsonl")
+    cfg = _cfg(api_port=0, journal_path=path)
+    eng = ServeEngine(model, params, cfg)
+    req = eng.submit(prompt, max_new_tokens=12, trace_id="restart-1")
+    eng.step()  # first block committed + fsynced
+    assert 0 < len(req.tokens) < 12
+    seen = len(req.tokens)
+    del eng  # crash
+
+    eng2 = ServeEngine(model, params, cfg)
+    resumed = eng2.recover()
+    assert [r.trace_id for r in resumed] == ["restart-1"]
+    srv = ApiServer(eng2, decode=dec,
+                    loop=EngineLoop(eng2))
+    try:
+        _, ev = _sse(srv.url("/v1/completions"), {},
+                     {"Last-Event-ID": f"restart-1:{seen}"})
+        tail = "".join(e["choices"][0].get("text", "") for _, e in ev)
+        assert dec(ref.tokens[:seen]) + tail == dec(ref.tokens)
+        assert ev[-1][1]["choices"][0]["finish_reason"] == "length"
+        assert ev[-1][0] == "restart-1:12"
+    finally:
+        srv.close()
+    assert resumed[0].tokens == ref.tokens
+
+
+def test_recovery_duplicate_rid_deadline_and_stop_string(tmp_path):
+    """Post-review contracts: a client re-using a LIVE request id gets
+    a fresh durable id (two streams never merge commits into one
+    journal record); a journaled deadline re-arms its original
+    relative budget at recovery; a committed stream that already
+    completed a stop-STRING match finishes "stop" at recovery instead
+    of resuming past it."""
+    model, params = _model()
+
+    def dec(ids):
+        return "".join(chr(97 + i % 26) for i in ids)
+
+    path = str(tmp_path / "j.jsonl")
+    cfg = _cfg(journal_path=path)
+    eng = ServeEngine(model, params, cfg, detokenize=dec)
+    a = eng.submit(_prompts(1)[0], max_new_tokens=20, trace_id="dup")
+    b = eng.submit(_prompts(2)[1], max_new_tokens=20, trace_id="dup")
+    assert a.trace_id == "dup" and b.trace_id != "dup"
+    assert eng.journal.is_live("dup") and eng.journal.is_live(b.trace_id)
+    c = eng.submit(_prompts(1)[0], max_new_tokens=20, deadline_s=30.0,
+                   trace_id="ddl")
+    eng.step()
+    assert not c.done
+    # stop-string-complete entry, as a prior process would have left
+    # it: committed tokens decode to text containing the stop string,
+    # the finish record lost to the crash
+    eng.journal.append_submit(
+        "stopped", [1, 2], 8, None,
+        {"stop": ["ab"], "temperature": 0.0}, 0.0)
+    eng.journal.append_commit("stopped", [0, 1])
+    eng.journal.sync()
+    del eng
+
+    eng2 = ServeEngine(model, params, cfg, detokenize=dec)
+    resumed = eng2.recover()
+    by_rid = {r.trace_id: r for r in resumed}
+    assert set(by_rid) == {"dup", b.trace_id, "ddl"}
+    # the deadline re-armed its ORIGINAL relative budget from recovery
+    ddl = by_rid["ddl"]
+    assert ddl.deadline is not None
+    assert abs((ddl.deadline - ddl.submit_time) - 30.0) < 1e-6
+    assert by_rid["dup"].deadline is None
+    # the stop-string-complete stream finished without resuming
+    done = eng2.journal.lookup("stopped")
+    assert done.finished and done.finish_reason == "stop"
+    assert done.tokens == [0, 1]
+    eng2.run()
+    assert all(r.done for r in resumed)
+    assert_no_leaks(eng2)

@@ -56,7 +56,8 @@ SCALE_KEYS = ("config", "n_requests", "n_slots", "max_new_tokens",
 # booleans that must never regress to False
 BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
                "survivors_token_exact", "zero_leak", "ladder_zero_leak",
-               "slots_clean")
+               "slots_clean", "recovered_token_exact",
+               "journal_degraded_exercised")
 
 # name-pattern -> (kind, higher_is_better); first match wins.
 # kind: "pct" = absolute percentage-point band — overheads hover near 0
@@ -88,7 +89,7 @@ _RULES: tuple[tuple[tuple[str, ...], str, bool], ...] = (
       "goodput_ratio"), "rate", True),
     (("requests_per_sec", "tokens_per_sec", "tokens_per_step",
       "speedup", "peak_active_slots", "streams_survived",
-      "goodput_ladder_ratio", "_gbps"), "rel", True),
+      "recovered_requests", "goodput_ladder_ratio", "_gbps"), "rel", True),
     (("ttft", "itl_", "_itl", "e2e_", "compile_time_s",
       "fault_recovery_s", "_wall_us", "_wall_s"), "rel", False),
     (("hbm_bytes", "pool_bytes", "temp_bytes"), "rel", False),

@@ -40,11 +40,19 @@ delivered inside their latency targets),
 
 and with `--chaos --append` for the fault-tolerance soak (one seeded
 fault schedule — NaN/Inf slot poisons, synthetic XlaRuntimeError + OOM,
-a step stall — through a fault-free reference, a ladder-off chaos arm
-and a degradation-ladder arm: streams_survived, survivor
-token-exactness, fault_recovery_s, the zero-leak drain invariant,
-goodput ladder-on vs ladder-off, and the ABBA-paired armed-but-quiet
-fault_overhead_pct).
+a step stall, a journal_write io_error — through a fault-free
+reference, a ladder-off chaos arm and a degradation-ladder arm:
+streams_survived, survivor token-exactness, fault_recovery_s, the
+zero-leak drain invariant, goodput ladder-on vs ladder-off, the
+degraded-journal path, and the ABBA-paired armed-but-quiet
+fault_overhead_pct),
+
+and with `--journal --append` for the durability workload (ABBA-paired
+journal-on vs journal-off req/s — journal_overhead_pct, fsync batched
+per step — plus a kill-and-recover arm: abandon a journaled engine
+mid-decode, replay the journal through a fresh one, and record
+recovery_wall_s / recovered_requests / recovered_token_exact with the
+zero-leak drain invariant).
 
 Every entry records the `kv_dtype` / `kv_pool_bytes` /
 `greedy_agreement_rate` triple (exact pools report their compute dtype
