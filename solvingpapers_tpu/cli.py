@@ -501,20 +501,41 @@ def cmd_serve(args) -> int:
         journal_path=args.journal,
         journal_strict=args.journal_strict,
     )
-    engine = ServeEngine(model, params, serve_cfg,
-                         extra_variables=extra or None, detokenize=decode)
-    if args.journal:
-        # crash-safe warm restart: replay the journal's unfinished
-        # entries BEFORE the front door starts stepping — recovered
-        # greedy/seeded streams continue token-exactly
-        resumed = engine.recover()
-        print(f"[serve] journal {args.journal}: recovered "
-              f"{len(resumed)} in-flight request(s)", file=sys.stderr)
-    server = ApiServer(engine, encode=encode, decode=decode,
-                       token_table=table, model_name=args.config)
+    n_replicas = max(1, args.replicas)
+    engines = []
+    for i in range(n_replicas):
+        rep_cfg = serve_cfg
+        if args.journal and n_replicas > 1:
+            # each replica needs its own write-ahead journal — a shared
+            # file would interleave records from independent engines
+            rep_cfg = dataclasses.replace(
+                serve_cfg, journal_path=f"{args.journal}.r{i}"
+            )
+        eng = ServeEngine(model, params, rep_cfg,
+                          extra_variables=extra or None, detokenize=decode)
+        if rep_cfg.journal_path:
+            # crash-safe warm restart: replay the journal's unfinished
+            # entries BEFORE the front door starts stepping — recovered
+            # greedy/seeded streams continue token-exactly
+            resumed = eng.recover()
+            print(f"[serve] journal {rep_cfg.journal_path}: recovered "
+                  f"{len(resumed)} in-flight request(s)", file=sys.stderr)
+        engines.append(eng)
+    if n_replicas > 1:
+        from solvingpapers_tpu.serve.fleet import FleetRouter
+
+        router = FleetRouter(engines)
+        server = ApiServer(encode=encode, decode=decode,
+                           token_table=table, model_name=args.config,
+                           router=router)
+        fleet_note = f" — fleet of {n_replicas} replicas"
+    else:
+        server = ApiServer(engines[0], encode=encode, decode=decode,
+                           token_table=table, model_name=args.config)
+        fleet_note = ""
     print(f"[serve] {args.config} on http://{server.host}:{server.port} "
           f"— POST /v1/completions /v1/chat/completions, "
-          f"GET /healthz /metrics /statusz", file=sys.stderr)
+          f"GET /healthz /metrics /statusz{fleet_note}", file=sys.stderr)
 
     stop = threading.Event()
 
@@ -550,15 +571,16 @@ def cmd_serve_bench(args) -> int:
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
             args.speculative, args.slo, args.chaos, args.journal,
-            args.kv_quant is not None)) > 1:
+            args.fleet, args.kv_quant is not None)) > 1:
         print("--shared-prefix, --sampling, --paged, --http, "
-              "--speculative, --slo, --chaos, --journal and --kv-quant "
-              "are separate workloads; pick one per run",
+              "--speculative, --slo, --chaos, --journal, --fleet and "
+              "--kv-quant are separate workloads; pick one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
         bench_provenance,
         run_chaos_bench,
+        run_fleet_bench,
         run_http_bench,
         run_journal_bench,
         run_paged_bench,
@@ -605,7 +627,7 @@ def cmd_serve_bench(args) -> int:
     if args.obs_hlo_dir:
         if any((args.shared_prefix, args.sampling, args.paged, args.http,
                 args.speculative, args.slo, args.chaos, args.journal,
-                args.kv_quant is not None)):
+                args.fleet, args.kv_quant is not None)):
             # say so instead of silently dropping the flag — a user
             # waiting on dumps should not debug an empty directory
             print("--obs-hlo-dir only dumps from the Poisson workload's "
@@ -658,6 +680,20 @@ def cmd_serve_bench(args) -> int:
             mean_interarrival_s=mean_ia,
             seed=args.seed,
             stall_s=args.chaos_stall,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.fleet:
+        result = run_fleet_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=n_slots,
+            max_new=max_new,
+            decode_block=decode_block,
+            prompt_lens=tuple(prompt_lens),
+            mean_interarrival_s=mean_ia,
+            n_replicas=args.fleet_replicas,
+            seed=args.seed,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
         )
@@ -1119,6 +1155,22 @@ def main(argv=None) -> int:
                               "recovery_wall_s / recovered_requests / "
                               "recovered_token_exact (serve/bench.py "
                               "run_journal_bench)")
+    p_serve.add_argument("--fleet", action="store_true",
+                         help="fleet workload instead: the Poisson trace "
+                              "through a multi-replica FleetRouter — "
+                              "router_overhead_pct (ABBA-paired 1-replica "
+                              "router vs bare engine, pure routing tax), "
+                              "fleet token-exactness vs a single-engine "
+                              "reference, and a mid-decode drain arm: "
+                              "drain replica r0 with streams live, adopt "
+                              "them on the peer, record migration_wall_s "
+                              "/ migrated_streams / migrated_token_exact "
+                              "and zero-leak on BOTH replicas "
+                              "(serve/bench.py run_fleet_bench)")
+    p_serve.add_argument("--fleet-replicas", type=int, default=2,
+                         help="[--fleet] replica count for the exactness "
+                              "and drain arms (the overhead arm is "
+                              "always 1 replica, like-for-like)")
     p_serve.add_argument("--journal-kill-step", type=int, default=None,
                          help="[--journal] engine step at which the "
                               "kill-and-recover arm abandons the first "
@@ -1347,6 +1399,14 @@ def main(argv=None) -> int:
                             "serving instead of degrading to "
                             "journal-off with a warning (for "
                             "deployments that REQUIRE durability)")
+    p_srv.add_argument("--replicas", type=int, default=1,
+                       help="serve a FLEET of N identical engine "
+                            "replicas behind one port (serve/fleet.py "
+                            "FleetRouter): prefix-affinity + SLO-aware "
+                            "routing, merged /metrics, fleet /statusz; "
+                            "with --journal each replica journals to "
+                            "PATH.rN and FleetRouter.drain can migrate "
+                            "live streams between replicas")
     p_srv.add_argument("--trace", action="store_true",
                        help="flight recorder on (ServeConfig.trace): "
                             "HTTP accept/parse/handoff/drain spans join "

@@ -125,14 +125,28 @@ class LogHistogram:
 
     def merge_from(self, other: "LogHistogram") -> "LogHistogram":
         """Fold `other`'s observations into self (exact: bucket counts
-        add; layouts must match)."""
+        add; layouts must match).
+
+        Safe against a LIVE `other` (the fleet /metrics path merges
+        replicas that are still recording): the shard's buckets are
+        copied ONCE and the merged count derived FROM that copy, so an
+        observation landing mid-merge is wholly present or wholly
+        absent from the bucket/count pair — never torn across them
+        (`add` updates counts before count, so reading count instead
+        could disagree with the buckets in either direction). `sum`
+        is a single read and may miss the same in-flight observation
+        the buckets missed — the ordinary scrape-boundary skew. For
+        quiescent shards this is byte-identical to the naive fold, so
+        the exact-merge contract (merge-of-shards == shard-of-merged)
+        is unchanged."""
         if other.layout != self.layout:
             raise ValueError(
                 f"cannot merge histograms with different layouts: "
                 f"{self.layout} vs {other.layout}"
             )
-        self.counts += other.counts
-        self.count += other.count
+        shard = other.counts.copy()
+        self.counts += shard
+        self.count += int(shard.sum())
         self.sum += other.sum
         self.min = min(self.min, other.min)
         self.max = max(self.max, other.max)

@@ -193,12 +193,31 @@ class PrometheusTextWriter(MetricsWriter):
             return "-Inf"
         return repr(float(v))
 
+    @staticmethod
+    def _label_str(labels: Mapping[str, object] | None) -> str:
+        """``{k="v",...}`` for a constant-label set ("" for none).
+        Names are sanitized like metric names; values get the text-
+        format escapes (backslash, quote, newline) — a replica id or
+        model name with an odd character must not corrupt the scrape."""
+        if not labels:
+            return ""
+        parts = []
+        for k, v in labels.items():
+            k = PrometheusTextWriter.sanitize(str(k))
+            v = (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                 .replace("\n", "\\n"))
+            parts.append(f'{k}="{v}"')
+        return "{" + ",".join(parts) + "}"
+
     @classmethod
     def render(cls, step: int, metrics: Mapping[str, float],
-               prefix: str = "") -> str:
+               prefix: str = "",
+               labels: Mapping[str, object] | None = None) -> str:
         """The exposition-format text for one metric set — shared by the
         textfile `write()` path and the live `/metrics` HTTP endpoint
         (metrics/http.py), so names and dedupe rules cannot drift.
+        `labels` stamps a constant label set (``replica="r0"``) on every
+        rendered series; see `render_sets` for the multi-set contract.
 
         Dedupes by SANITIZED name (last write wins): two keys that
         collapse to one name ("serve/ttft" vs "serve.ttft") would emit
@@ -209,39 +228,74 @@ class PrometheusTextWriter(MetricsWriter):
         values claim their ``_bucket``/``_sum``/``_count`` derived names
         ahead of any gauge that would collide with them.
         """
-        gauges: dict[str, str] = {}
-        hists: dict[str, LogHistogram] = {}
-        for k, v in metrics.items():
-            name = prefix + cls.sanitize(k)
-            if isinstance(v, LogHistogram):
-                hists[name] = v
-            else:
-                gauges[name] = cls._fmt(float(v))
+        return cls.render_sets([(step, labels, metrics)], prefix=prefix)
+
+    @classmethod
+    def render_sets(cls, sets, prefix: str = "") -> str:
+        """One exposition from several ``(step, labels, metrics)`` sets
+        — the fleet surface (serve/fleet.py): the merged set carries no
+        labels while each replica's set carries ``replica="rN"``, and a
+        metric NAME appears once with ONE ``# TYPE`` header over all of
+        its labeled series (the text format rejects a name whose series
+        are split across groups). Dedupe is by (sanitized name, label
+        set) with last write winning — the single-set contract extended
+        pointwise; a name that is a histogram in ANY set claims the name
+        and its ``_bucket``/``_sum``/``_count`` derivations across ALL
+        sets (gauge series under those names are dropped, same
+        histogram-wins rule as `render`). Each set gets its own
+        ``last_step{labels}`` staleness rider unless it shipped one.
+        """
+        # name -> {label_str -> formatted value | LogHistogram}; plain
+        # dicts keep first-seen name order and last-write series values
+        gauges: dict[str, dict[str, str]] = {}
+        hists: dict[str, dict[str, LogHistogram]] = {}
+        for step, labels, metrics in sets:
+            ls = cls._label_str(labels)
+            rider = f"{prefix}last_step"
+            saw_rider = False
+            for k, v in metrics.items():
+                name = prefix + cls.sanitize(k)
+                saw_rider = saw_rider or name == rider
+                if isinstance(v, LogHistogram):
+                    hists.setdefault(name, {})[ls] = v
+                else:
+                    gauges.setdefault(name, {})[ls] = cls._fmt(float(v))
+            if not saw_rider:
+                # setdefault on the SERIES: the rider must never clobber
+                # a user gauge another set already placed at this name +
+                # label set, and a later user gauge still overwrites it
+                gauges.setdefault(rider, {}).setdefault(
+                    ls, str(int(step)))
         reserved = {
             f"{h}{suffix}"
             for h in hists for suffix in ("_bucket", "_sum", "_count")
         }
-        for name in reserved & set(gauges):
+        for name in (reserved | set(hists)) & set(gauges):
             del gauges[name]  # the histogram's series win the collision
-        gauges.setdefault(f"{prefix}last_step", str(int(step)))
         lines = []
-        for name, value in gauges.items():
+        for name, series in gauges.items():
             lines.append(f"# TYPE {name} gauge")
-            lines.append(f"{name} {value}")
-        for name, h in hists.items():
+            for ls, value in series.items():
+                lines.append(f"{name}{ls} {value}")
+        for name, series in hists.items():
             lines.append(f"# TYPE {name} histogram")
-            # ONE cumulative pass feeds both the buckets and _count, so
-            # the +Inf bucket == _count invariant (which OpenMetrics
-            # parsers and histogram_quantile enforce) holds even when a
-            # serving thread records into the live histogram mid-render
-            # — a concurrently-added observation is wholly absent from
-            # this scrape rather than torn across its series
-            cums = h.cumulative_counts()
-            for le, cum in zip(h.bucket_bounds(), cums):
-                label = "+Inf" if le == float("inf") else repr(float(le))
-                lines.append(f'{name}_bucket{{le="{label}"}} {cum}')
-            lines.append(f"{name}_sum {cls._fmt(h.sum)}")
-            lines.append(f"{name}_count {cums[-1] if cums else 0}")
+            for ls, h in series.items():
+                # ONE cumulative pass feeds both the buckets and _count,
+                # so the +Inf bucket == _count invariant (which
+                # OpenMetrics parsers and histogram_quantile enforce)
+                # holds even when a serving thread records into the live
+                # histogram mid-render — a concurrently-added
+                # observation is wholly absent from this scrape rather
+                # than torn across its series
+                cums = h.cumulative_counts()
+                base = ls[1:-1] + "," if ls else ""
+                for le, cum in zip(h.bucket_bounds(), cums):
+                    label = ("+Inf" if le == float("inf")
+                             else repr(float(le)))
+                    lines.append(
+                        f'{name}_bucket{{{base}le="{label}"}} {cum}')
+                lines.append(f"{name}_sum{ls} {cls._fmt(h.sum)}")
+                lines.append(f"{name}_count{ls} {cums[-1] if cums else 0}")
         return "\n".join(lines) + "\n"
 
     def write(self, step: int, metrics: Mapping[str, float]) -> None:

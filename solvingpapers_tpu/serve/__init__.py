@@ -9,10 +9,18 @@ HTTP front door (ApiServer) + latency metrics + fault tolerance
 per-request blast-radius isolation, SLO-driven degradation ladder) +
 durable serving (serve/journal.py: request write-ahead journal,
 crash-safe warm restart via ServeEngine.recover, SSE stream
-resumption over Last-Event-ID)."""
+resumption over Last-Event-ID) + fleet serving (serve/fleet.py:
+multi-replica FleetRouter with prefix-affinity + SLO-aware routing,
+merged fleet metrics, journal-backed zero-drop stream migration via
+FleetRouter.drain)."""
 
 from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+from solvingpapers_tpu.serve.fleet import (
+    FleetRouter,
+    MigrationReport,
+    Replica,
+)
 from solvingpapers_tpu.serve.faults import (
     DegradationLadder,
     FaultPlan,
@@ -40,7 +48,10 @@ __all__ = [
     "EngineLoop",
     "FaultPlan",
     "FaultSpec",
+    "FleetRouter",
     "InjectedFault",
+    "MigrationReport",
+    "Replica",
     "JsonStepper",
     "Journal",
     "JournalEntry",

@@ -43,6 +43,16 @@ queue (or the paged pool's page-budget gate rejecting) answers 503 +
 Retry-After, invalid requests answer 400 with the OpenAI error
 envelope (serve/openai.py) — never a traceback over a socket.
 
+Fleet mode (serve/fleet.py): constructed with a `FleetRouter`, the same
+surface fronts N replicas — admissions route by prefix affinity /
+SLO burn / load with ranked retry on a full replica (`X-Replica-Id`
+says where a request landed), the 503 capacity probe and Retry-After
+rung reflect the FLEET view, `/metrics` serves the merged + per-replica
+labeled exposition, `/statusz` grows a ``fleet`` section, and a drained
+replica's SSE streams close WITHOUT a terminal chunk — the reconnect-
+with-Last-Event-ID signal; the cursor resolves on the adopting peer
+(blocking responses ride the migration transparently instead).
+
 Request tracing rides every completion: the front door honors an
 `X-Request-Id` header (minting one when absent or malformed), echoes it
 on the response, stamps it on the engine `Request`, and — when the
@@ -287,9 +297,23 @@ class ApiServer:
     # older entry — last-wins, like the header contract implies.
     timeline_cap = 1024
 
-    def __init__(self, engine, *, encode=None, decode=None,
+    def __init__(self, engine=None, *, encode=None, decode=None,
                  token_table=None, model_name: str = "solvingpapers",
-                 loop=None):
+                 loop=None, router=None):
+        # fleet mode (serve/fleet.py FleetRouter): the front door keeps
+        # its single submit/SSE surface and routes through the router —
+        # replica 0 stays `self.engine`/`self.loop` as the config /
+        # vocab / grammar / fault-plane source (every replica serves
+        # the same model), while admissions, capacity, health, metrics
+        # and statusz consult the fleet views
+        self.router = router
+        if router is not None:
+            if engine is None:
+                engine = router.replicas[0].engine
+            if loop is None:
+                loop = router.replicas[0].loop
+        if engine is None:
+            raise ValueError("ApiServer needs an engine or a router")
         cfg = engine.config
         self.engine = engine
         self.encode = encode
@@ -417,11 +441,64 @@ class ApiServer:
         (integer seconds; the base grows with the degradation rung, so
         a deeper squeeze pushes retries further out) plus the current
         rung itself — client observability into WHY it was shed."""
-        rung = getattr(self.engine, "degradation_rung", 0)
+        src = self.router if self.router is not None else self.engine
+        rung = getattr(src, "degradation_rung", 0)
         with self._retry_lock:
             retry = self._retry_rng.randint(1 + rung, 4 + rung)
         return {"Retry-After": str(retry),
                 "X-Degradation-Rung": str(rung)}
+
+    def _engines(self) -> list:
+        """Every engine this front door fronts (fleet or single) — the
+        scan set for recovered-request and journal lookups: after a
+        drain migration the stream's record lives on a PEER replica."""
+        if self.router is not None:
+            return [r.engine for r in self.router.replicas]
+        return [self.engine]
+
+    def _find_recovered(self, rid: str):
+        """The recovered/adopted Request for `rid` on ANY replica, or
+        None — the Last-Event-ID resolution step between the live
+        registry and the journal fallback. When both a drained
+        replica's "migrated" husk and a peer's adopted request carry
+        the id, the adopted one wins: its token list is the stream."""
+        best = None
+        for eng in self._engines():
+            req = getattr(eng, "_recovered", {}).get(rid)
+            if req is None:
+                continue
+            if req.finish_reason != "migrated":
+                return req
+            best = best or req
+        return best
+
+    def _journal_lookup(self, rid: str):
+        """The best journal record for `rid` across the fleet: a LIVE
+        entry anywhere wins outright (the stream is still running —
+        e.g. adopted by a peer but not yet recovered into a Request);
+        among finished entries, a real outcome beats the drained
+        replica's ``"migrated"`` tombstone (the adopting replica's
+        record is the one whose tokens are the stream's truth)."""
+        best = None
+        for eng in self._engines():
+            entry = (eng.journal.lookup(rid)
+                     if eng.journal is not None else None)
+            if entry is None:
+                continue
+            if not entry.finished:
+                return entry
+            if best is None or (best.finish_reason == "migrated"
+                                and entry.finish_reason != "migrated"):
+                best = entry
+        return best
+
+    def _loop_for(self, req):
+        """The EngineLoop that owns `req` — the router's owner map in
+        fleet mode (a migrated stream's cancel must land on the replica
+        actually decoding it), `self.loop` otherwise."""
+        if self.router is not None:
+            return self.router.owner_loop(req)
+        return self.loop
 
     def _send_error(self, h, err: ApiError,
                     headers: dict | None = None) -> None:
@@ -444,23 +521,44 @@ class ApiServer:
                 # wire mapping (metrics/http.py healthz_response — the
                 # status-port endpoint uses the same one, so the two
                 # /healthz surfaces can never diverge); a dead engine
-                # loop is unhealthy regardless of what the engine says
-                state = getattr(self.engine, "health", "healthy")
-                if self.loop.error is not None:
-                    state = "unhealthy"
+                # loop is unhealthy regardless of what the engine says.
+                # Fleet mode serves the ROUTER's view: healthy while any
+                # admitting replica is (the router steers around the
+                # rest — one sick replica must not fail the fleet out
+                # of an external balancer's rotation)
+                if self.router is not None:
+                    state = self.router.health
+                else:
+                    state = getattr(self.engine, "health", "healthy")
+                    if self.loop.error is not None:
+                        state = "unhealthy"
                 code, body = healthz_response(state)
                 self._send(h, code, body, "text/plain")
             elif path == "/metrics":
-                with self.loop.lock:
-                    # prom_snapshot: latency histograms render as native
-                    # _bucket/_sum/_count series on this pull path
-                    step, snap = (self.engine._step_idx,
-                                  self.engine.metrics.prom_snapshot())
-                self._send(h, 200, PrometheusTextWriter.render(step, snap),
-                           "text/plain; version=0.0.4")
+                # prom_snapshot: latency histograms render as native
+                # _bucket/_sum/_count series on this pull path. Fleet
+                # mode: ONE exposition with the unlabeled merged series
+                # (exact LogHistogram merge) + replica="rN"-labeled
+                # per-replica series (render_sets keeps one # TYPE per
+                # name across the label sets)
+                if self.router is not None:
+                    text = PrometheusTextWriter.render_sets(
+                        self.router.prom_sets())
+                else:
+                    with self.loop.lock:
+                        step, snap = (self.engine._step_idx,
+                                      self.engine.metrics.prom_snapshot())
+                    text = PrometheusTextWriter.render(step, snap)
+                self._send(h, 200, text, "text/plain; version=0.0.4")
             elif path == "/statusz":
                 with self.loop.lock:
                     doc = self.engine.statusz()
+                if self.router is not None:
+                    # replica 0's engine doc stays the backbone (same
+                    # keys as single-engine serving — dashboards keep
+                    # working); the fleet section adds the per-replica
+                    # occupancy/health/rung table + routing counters
+                    doc["fleet"] = self.router.statusz()
                 self._send_json(h, 200, doc)
             elif path == "/v1/models":
                 self._send_json(h, 200, {
@@ -518,12 +616,10 @@ class ApiServer:
         are all reconstructible. `source: "journal"` marks the
         provenance; a live recovered request reports its current
         committed state."""
-        if self.engine.journal is None:
-            return None
-        entry = self.engine.journal.lookup(rid)
+        entry = self._journal_lookup(rid)
         if entry is None:
             return None
-        recovered = rid in getattr(self.engine, "_recovered", {})
+        recovered = self._find_recovered(rid) is not None
         if entry.finished:
             state = "finished"
         elif recovered:
@@ -690,7 +786,7 @@ class ApiServer:
                 status=409, code="resume_offset_beyond_committed",
             )
 
-    def _sse_open(self, h, trace_id: str):
+    def _sse_open(self, h, trace_id: str, replica: str | None = None):
         """Send the SSE response headers and return THE event writer
         (one framing implementation for live streams, re-attached
         resumes and journal-only replays): each chunk is an optional
@@ -704,6 +800,8 @@ class ApiServer:
         h.send_header("Content-Type", "text/event-stream")
         h.send_header("Cache-Control", "no-cache")
         h.send_header("X-Request-Id", trace_id)
+        if replica is not None:
+            h.send_header("X-Replica-Id", replica)
         h.end_headers()
 
         def event(obj, eid: int | None = None) -> None:
@@ -772,14 +870,27 @@ class ApiServer:
             rec = self._timelines.get(rid)
         req = rec["req"] if rec is not None else None
         if req is None:
-            req = getattr(self.engine, "_recovered", {}).get(rid)
+            req = self._find_recovered(rid)
+        if req is not None and req.finish_reason == "migrated" \
+                and self.router is not None:
+            # the registry's object is the DRAINED replica's husk; the
+            # peer's adopted request (same id, same committed prefix,
+            # still decoding) is the stream the cursor belongs to
+            adopted = self._find_recovered(rid)
+            if adopted is not None and adopted is not req:
+                req = adopted
+                if rec is not None:
+                    rec["req"] = req
         if req is not None:
             self._check_resume_offset(offset, len(req.tokens), rid)
+            owner = (self.router.owner(rid)
+                     if self.router is not None else None)
             new_rec = {
                 "trace_id": rid, "req": req, "chat": chat, "stream": True,
                 "t_accept": smetrics.now(), "t_body": smetrics.now(),
                 "t_parsed": smetrics.now(), "t_done": None,
                 "disconnected": False,
+                "replica": owner.rid if owner is not None else None,
             }
             bridge = _Stream(self.engine.config.stream_queue)
             if not req.done:
@@ -796,8 +907,7 @@ class ApiServer:
             self._stream_response(h, req, bridge, rid_out, chat, new_rec,
                                   start=offset)
             return
-        entry = (self.engine.journal.lookup(rid)
-                 if self.engine.journal is not None else None)
+        entry = self._journal_lookup(rid)
         if entry is None:
             raise ApiError(
                 f"no resumable stream for request id {rid!r} (unknown, "
@@ -878,7 +988,10 @@ class ApiServer:
         if self.closing.is_set():
             raise ApiError("server is shutting down", status=503,
                            err_type="server_error", code="shutting_down")
-        if self.loop.error is not None:
+        if self.router is None and self.loop.error is not None:
+            # fleet mode has no single fatal loop: a dead replica just
+            # stops admitting and the router routes around it (only an
+            # empty candidate set 503s, below)
             raise ApiError(
                 "engine loop failed — the server needs a restart "
                 f"({type(self.loop.error).__name__})", status=503,
@@ -906,9 +1019,17 @@ class ApiServer:
                 f"({cfg.api_max_connections}) — retry shortly",
                 status=503, err_type="server_error", code="overloaded",
             )
-        if self.engine.scheduler.capacity_left == 0:
+        # the backpressure probe consults FLEET-wide queue room when a
+        # router fronts several replicas: one busy replica must not 503
+        # a request a peer has capacity for (the router also retries
+        # ranked candidates on a host-side queue-full rejection below)
+        capacity = (self.router.capacity_left if self.router is not None
+                    else self.engine.scheduler.capacity_left)
+        if capacity == 0:
             raise ApiError(
-                "waiting queue is full — retry shortly", status=503,
+                "waiting queue is full"
+                + (" fleet-wide" if self.router is not None else "")
+                + " — retry shortly", status=503,
                 err_type="server_error", code="overloaded",
             )
         grammar = (JsonStepper(self.token_table, cache=self._grammar_cache)
@@ -919,16 +1040,34 @@ class ApiServer:
         # submit_time inside the locked engine call, so the gap between
         # here and there IS the submit-lock handoff
         t_parsed = smetrics.now()
+        replica = None
         try:
-            req = self.loop.submit(
-                np.asarray(prompt_ids, np.int32),
-                max_new_tokens=max_tokens, params=params,
-                deadline_s=timeout_s, grammar=grammar, stream_cb=bridge,
-                # the engine journals under this id, so a restarted
-                # server can answer Last-Event-ID reconnects and
-                # /v1/requests/<id> for it
-                trace_id=trace_id,
-            )
+            if self.router is not None:
+                # prefix-affinity + SLO-burn + least-loaded routing,
+                # with ranked retry on a full replica queue
+                replica, req = self.router.submit(
+                    np.asarray(prompt_ids, np.int32),
+                    max_new_tokens=max_tokens, params=params,
+                    deadline_s=timeout_s, grammar=grammar,
+                    stream_cb=bridge, trace_id=trace_id,
+                )
+                if req is None:
+                    raise ApiError(
+                        "no replica is admitting (fleet draining or "
+                        "unhealthy) — retry shortly", status=503,
+                        err_type="server_error", code="engine_unhealthy",
+                    )
+            else:
+                req = self.loop.submit(
+                    np.asarray(prompt_ids, np.int32),
+                    max_new_tokens=max_tokens, params=params,
+                    deadline_s=timeout_s, grammar=grammar,
+                    stream_cb=bridge,
+                    # the engine journals under this id, so a restarted
+                    # server can answer Last-Event-ID reconnects and
+                    # /v1/requests/<id> for it
+                    trace_id=trace_id,
+                )
         except ValueError as e:
             code = ("context_length_exceeded"
                     if "exceeds the engine capacity" in str(e) else None)
@@ -945,6 +1084,9 @@ class ApiServer:
             "trace_id": trace_id, "req": req, "chat": chat,
             "stream": stream, "t_accept": t_accept, "t_body": t_body,
             "t_parsed": t_parsed, "t_done": None, "disconnected": False,
+            # which replica admitted it (fleet mode) — the
+            # X-Replica-Id response header, for debugging routing
+            "replica": replica.rid if replica is not None else None,
         }
         with self._timeline_lock:
             self._timelines[trace_id] = rec
@@ -976,21 +1118,26 @@ class ApiServer:
                     code="engine_unhealthy",
                 )
             elif why.startswith("shed:"):
+                shed_eng = (replica.engine if replica is not None
+                            else self.engine)
                 err = ApiError(
                     f"admissions for SLO class {why[5:]!r} are being "
                     f"load-shed (degradation rung "
-                    f"{getattr(self.engine, 'degradation_rung', 0)}) — "
+                    f"{getattr(shed_eng, 'degradation_rung', 0)}) — "
                     "retry after the hinted delay",
                     status=503, err_type="server_error", code="overloaded",
                 )
             else:
                 err = ApiError(
-                    "waiting queue is full — retry shortly", status=503,
+                    "waiting queue is full"
+                    + (" fleet-wide" if self.router is not None else "")
+                    + " — retry shortly", status=503,
                     err_type="server_error", code="overloaded",
                 )
-            self._send_json(h, 503, err.body(), {
-                **self._retry_headers(), "X-Request-Id": trace_id,
-            })
+            headers = {**self._retry_headers(), "X-Request-Id": trace_id}
+            if rec["replica"] is not None:
+                headers["X-Replica-Id"] = rec["replica"]
+            self._send_json(h, 503, err.body(), headers)
             return
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         if stream:
@@ -1050,7 +1197,8 @@ class ApiServer:
         Event framing (id: resume cursors + data: lines + the
         sse_write fault site) is `_sse_open`'s — one writer for live
         streams and journal replays."""
-        event = self._sse_open(h, rec["trace_id"])
+        event = self._sse_open(h, rec["trace_id"],
+                               replica=rec.get("replica"))
         self._bump_active(1)
         emitted = start
         events = 0
@@ -1062,9 +1210,11 @@ class ApiServer:
             # last reconnect wins: a Last-Event-ID re-attach flips
             # req.stream_cb to ITS bridge — an abandoned pre-reconnect
             # handler noticing its own dead socket afterwards must not
-            # cancel the stream out from under the live client
+            # cancel the stream out from under the live client. The
+            # owner lookup routes the cancel to the replica actually
+            # decoding (it may have migrated since admission).
             if not req.done and req.stream_cb is bridge:
-                self.loop.cancel(req)
+                self._loop_for(req).cancel(req)
 
         try:
             if chat:
@@ -1108,6 +1258,22 @@ class ApiServer:
                     emitted = upto
                     events += 1
                 if finished:
+                    if req.finish_reason == "migrated":
+                        # fleet drain: the stream CONTINUES on a peer
+                        # replica — close WITHOUT a terminal chunk or
+                        # [DONE] (an unterminated SSE stream is the
+                        # standard "reconnect with your Last-Event-ID"
+                        # signal; the cursor resolves on the adopting
+                        # replica through the recovered-set path,
+                        # token-exact from exactly this offset). The
+                        # committed prefix was fully delivered above:
+                        # force_drain froze the token list before the
+                        # entries were snapshotted for adoption.
+                        h.wfile.write(b": migrated - reconnect with "
+                                      b"Last-Event-ID\n\n")
+                        h.wfile.flush()
+                        self._mark_done(req, rec, events=events)
+                        return
                     if req.finish_reason == "error":
                         # SSE error protocol: a quarantined / engine-
                         # failed stream ends with a STRUCTURED error
@@ -1167,21 +1333,41 @@ class ApiServer:
                            chat: bool, rec: dict) -> None:
         self._bump_active(1)
         try:
-            while not req.done:
-                try:
-                    _, finished = bridge.q.get(timeout=0.5)
-                    if finished:
-                        break
-                except queue.Empty:
-                    if self._disconnected(h):
-                        self.loop.cancel(req)
-                        self._mark_disconnect(req, rec)
-                        return
+            while True:
+                while not req.done:
+                    try:
+                        _, finished = bridge.q.get(timeout=0.5)
+                        if finished and req.done:
+                            break
+                    except queue.Empty:
+                        if self._disconnected(h):
+                            self._loop_for(req).cancel(req)
+                            self._mark_disconnect(req, rec)
+                            return
+                if req.finish_reason != "migrated" or self.router is None:
+                    break
+                # fleet drain mid-request: no bytes have gone out on a
+                # blocking response, so the migration is TRANSPARENT —
+                # pick up the adopted request on the peer and keep
+                # waiting (its committed prefix is this one's; SSE
+                # clients get the reconnect protocol instead)
+                nxt = self._find_recovered(req.trace_id)
+                if nxt is None or nxt is req:
+                    break  # adoption failed: report the husk honestly
+                req = nxt
+                rec["req"] = req
+                owner = self.router.owner(req.trace_id)
+                rec["replica"] = owner.rid if owner is not None else None
+                if not req.done:
+                    req.stream_cb = bridge
+                bridge(req, 0, req.done)  # re-prime past the 0.5s poll
             if self.decode is not None:
                 text = self.decode(list(req.tokens))
             else:
                 text = "".join(str(t) + " " for t in req.tokens)
             headers = {"X-Request-Id": rec["trace_id"]}
+            if rec.get("replica") is not None:
+                headers["X-Replica-Id"] = rec["replica"]
             if req.finish_reason == "error":
                 # no bytes have gone out on a blocking response: the
                 # honest status is a 500 with the structured envelope,
@@ -1215,9 +1401,14 @@ class ApiServer:
         deadline = time.monotonic() + cfg.drain_timeout_s
         while self._active > 0 and time.monotonic() < deadline:
             time.sleep(0.01)
-        self.loop.close(drain_timeout_s=max(
-            0.0, deadline - time.monotonic()))
-        self.engine.close()
+        if self.router is not None:
+            # every replica's loop + engine, sharing the drain budget
+            self.router.close(drain_timeout_s=max(
+                0.0, deadline - time.monotonic()))
+        else:
+            self.loop.close(drain_timeout_s=max(
+                0.0, deadline - time.monotonic()))
+            self.engine.close()
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

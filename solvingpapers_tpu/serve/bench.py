@@ -2479,3 +2479,261 @@ def run_journal_bench(
             **probe_fields,
         },
     }
+
+
+def _run_fleet_arm(model, params, extra, requests, serve_cfg, max_new,
+                   n_replicas, params_for=None, journal_dir=None):
+    """The Poisson trace through a manually-stepped `FleetRouter`:
+    submissions route through `router.submit` (the full ranking —
+    health gate, burn gate, prefix probe under each replica's lock,
+    least-loaded sort), steps run inline under each replica's loop
+    lock. Manual stepping keeps the arm single-threaded like
+    `_run_engine_arm`, so a fleet-vs-bare pairing isolates the ROUTER
+    tax (ranking + lock traffic), not thread-scheduler noise. Returns
+    ``(router, handles, makespan)``."""
+    from solvingpapers_tpu.serve.fleet import FleetRouter
+
+    engines = []
+    for i in range(n_replicas):
+        cfg = serve_cfg
+        if journal_dir is not None:
+            cfg = dataclasses.replace(
+                serve_cfg,
+                journal_path=os.path.join(journal_dir, f"r{i}.jsonl"),
+            )
+        engines.append(
+            ServeEngine(model, params, cfg, extra_variables=extra))
+    router = FleetRouter(engines, start=False)
+    pending = sorted(requests, key=lambda r: r[0])
+    handles = []
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or any(
+            r.engine.has_work() for r in router.replicas):
+        elapsed = time.monotonic() - t0
+        while i < len(pending) and pending[i][0] <= elapsed:
+            _, req = router.submit(
+                pending[i][1], max_new_tokens=max_new,
+                params=params_for(i) if params_for is not None else None,
+            )
+            assert req is not None and req.state != "rejected", \
+                "fleet arm sized to admit everything"
+            handles.append(req)
+            i += 1
+        stepped = False
+        for r in router.replicas:
+            if r.engine.has_work():
+                with r.loop.lock:
+                    r.engine.step()
+                stepped = True
+        if not stepped and i < len(pending):
+            time.sleep(max(0.0, pending[i][0] - (time.monotonic() - t0)))
+    makespan = (time.monotonic() - t0) - pending[0][0]
+    return router, handles, makespan
+
+
+def run_fleet_bench(
+    config: str = "llama3_shakespeare",
+    n_requests: int = 32,
+    n_slots: int = 8,
+    max_new: int = 64,
+    decode_block: int = 16,
+    prompt_lens=(16, 32, 48, 64),
+    mean_interarrival_s: float = 0.001,
+    n_replicas: int = 2,
+    seed: int = 0,
+    reps: int = 4,
+    journal_dir: str | None = None,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """`cli serve-bench --fleet`: the fleet-serving workload.
+
+    Two arms, one entry:
+
+    * router overhead — ABBA-paired req/s of the Poisson trace through
+      a ONE-replica `FleetRouter` (manually stepped, no journal) vs the
+      bare `_run_engine_arm` driver on an identical engine: the pure
+      routing tax (candidate ranking, the locked prefix probe, owner
+      bookkeeping, per-step lock traffic) with the engine workload held
+      exactly like-for-like (`router_overhead_pct`; budget <= 5).
+    * drain migration — every request submitted up front through an
+      `n_replicas`-way JOURNALED fleet (greedy + seeded stochastic
+      sampling mix); after a third of the requests finish, replica r0
+      is drained MID-DECODE: its live streams snapshot out of its
+      journal, force-finish ``"migrated"`` (r0 reclaims to zero leaks),
+      and peers adopt them through the recover() preemption-resume
+      path. The fleet then drains to completion.
+      ``migrated_token_exact`` pins every migrated stream's FULL token
+      sequence (pre-drain prefix + post-adoption suffix) byte-identical
+      to an uninterrupted single-engine reference;
+      ``fleet_token_exact`` extends that to EVERY stream in the fleet
+      (routed anywhere, migrated or not); ``migration_wall_s`` is the
+      admission-gate close -> last adoption wall; ``zero_leak`` holds
+      on BOTH the drained replica and the adopter after the drain.
+    """
+    model, params, extra, vocab = build_serve_model(config)
+    requests = synthetic_requests(
+        n_requests, vocab, prompt_lens=prompt_lens,
+        mean_interarrival_s=mean_interarrival_s, seed=seed,
+    )
+    max_prompt = max(len(p) for _, p in requests)
+    max_len = -(-(max_prompt + max_new) // 16) * 16
+    jdir = journal_dir or tempfile.mkdtemp(prefix="serve_fleet_bench_")
+    base_cfg = ServeConfig(
+        n_slots=n_slots,
+        max_len=max_len,
+        decode_block=decode_block,
+        bucket=min(32, max_prompt),
+        max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests),
+        seed=seed,
+    )
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, base_cfg, max_new,
+        status_port=status_port,
+    )
+
+    # reference arm FIRST: the uninterrupted single-engine token oracle
+    # for BOTH exactness claims, and the plain-path jit warmup (greedy
+    # and both seeded sampling shapes trace here, so neither paired arm
+    # eats a cold compile). All requests up front: per-stream decode is
+    # batch-composition-independent, so the oracle is arrival-agnostic.
+    upfront = [(0.0, p) for _, p in requests]
+    ref_eng, ref_handles, _ = _run_engine_arm(
+        model, params, extra, upfront, base_cfg, max_new,
+        params_for=_journal_params_for,
+    )
+
+    # ---- router overhead arm: 1-replica fleet vs bare driver, ABBA +
+    # mean (the `_paired_makespans` discipline; fresh engines per run)
+    mk_fleet: list = []
+    mk_bare: list = []
+    for rep_i in range(reps):
+        order = ("fleet", "bare") if rep_i % 2 == 0 else ("bare", "fleet")
+        for arm in order:
+            if arm == "fleet":
+                _, _, mk = _run_fleet_arm(
+                    model, params, extra, requests, base_cfg, max_new,
+                    n_replicas=1,
+                )
+                mk_fleet.append(mk)
+            else:
+                _, _, mk = _run_engine_arm(
+                    model, params, extra, requests, base_cfg, max_new,
+                )
+                mk_bare.append(mk)
+    fleet_rps = n_requests / (sum(mk_fleet) / len(mk_fleet))
+    bare_rps = n_requests / (sum(mk_bare) / len(mk_bare))
+
+    # ---- drain-migration arm: journaled n_replicas-way fleet
+    from solvingpapers_tpu.serve.fleet import FleetRouter
+
+    engines = [
+        ServeEngine(
+            model, params,
+            dataclasses.replace(
+                base_cfg,
+                journal_path=os.path.join(jdir, f"migrate_r{i}.jsonl")),
+            extra_variables=extra,
+        )
+        for i in range(max(2, n_replicas))
+    ]
+    router = FleetRouter(engines, start=False)
+    handles = []
+    for i, (_, p) in enumerate(requests):
+        _, req = router.submit(p, max_new_tokens=max_new,
+                               params=_journal_params_for(i))
+        assert req is not None and req.state != "rejected"
+        handles.append(req)
+
+    def _step_all():
+        worked = False
+        for r in router.replicas:
+            if r.engine.has_work():
+                with r.loop.lock:
+                    r.engine.step()
+                worked = True
+        return worked
+
+    finish_target = max(1, n_requests // 3)
+    while _step_all():
+        done = sum(1 for h in handles if h.done)
+        if done >= finish_target and done < n_requests:
+            break
+    report = router.drain("r0")
+    while _step_all():
+        pass
+    assert all(r.done for r in report.migrated), \
+        "drain left adopted streams unfinished"
+
+    ref_by_idx = {h.trace_id: r.tokens
+                  for h, r in zip(handles, ref_handles)}
+    successors = {
+        old: router.replica(peer).engine._recovered[new]
+        for old, (peer, new) in report.targets.items()
+    }
+    fleet_exact = True
+    migrated_exact = True
+    for h in handles:
+        oracle = ref_by_idx[h.trace_id]
+        if h.trace_id in successors:
+            stream = successors[h.trace_id].tokens
+            if stream != oracle:
+                migrated_exact = False
+        else:
+            stream = h.tokens
+        if stream != oracle:
+            fleet_exact = False
+    leak0 = _zero_leak_fields(router.replica("r0").engine)
+    leak_peers = [_zero_leak_fields(r.engine)
+                  for r in router.replicas if r.rid != "r0"]
+
+    if status_hold_s > 0 and probe_eng is not None:
+        time.sleep(status_hold_s)
+    if probe_eng is not None:
+        probe_eng.close()
+    live_at_drain = report.entries
+    return {
+        "metric": "serve_fleet_migrated_streams",
+        "value": len(report.migrated),
+        "unit": (f"live streams migrated token-exactly by a mid-decode "
+                 f"drain ({live_at_drain} live at drain, "
+                 f"{len(router.replicas)} replicas)"),
+        "vs_baseline": round(len(report.migrated) / live_at_drain, 4)
+        if live_at_drain else 1.0,
+        "detail": {
+            "config": config,
+            "workload": "fleet",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "n_replicas": len(router.replicas),
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "mean_interarrival_s": mean_interarrival_s,
+            "reps": reps,
+            "router_overhead_pct": round(
+                (1.0 - fleet_rps / bare_rps) * 100.0, 2),
+            "fleet_requests_per_sec": round(fleet_rps, 2),
+            "bare_requests_per_sec": round(bare_rps, 2),
+            "live_at_drain": live_at_drain,
+            "migrated_streams": len(report.migrated),
+            "migration_errors": len(report.errors),
+            "migration_wall_s": round(report.wall_s, 4),
+            "migrated_token_exact": migrated_exact,
+            "fleet_token_exact": fleet_exact,
+            "zero_leak_drained": leak0["zero_leak"],
+            "zero_leak_peers": all(f["zero_leak"] for f in leak_peers),
+            "zero_leak": (leak0["zero_leak"]
+                          and all(f["zero_leak"] for f in leak_peers)),
+            "routing": {k: v for k, v in router.stats.items()},
+            **_kv_entry_fields(ref_eng),
+            **probe_fields,
+        },
+    }

@@ -2420,6 +2420,135 @@ class ServeEngine:
             "serve/recovered_requests": float(self._recovered_total),
         }
 
+    def _entry_request(self, e) -> tuple[Request | None, str | None]:
+        """Validate + materialize one live journal entry as a resumable
+        `Request` carrying its committed tokens — the shared core of
+        `recover()` (crash restart) and `adopt()` (fleet migration).
+
+        Returns ``(request, None)`` for an entry this engine can honor:
+        the request is WAITING with its deadline re-armed RELATIVE from
+        now (absolute deadlines cannot cross a process/replica boundary
+        — monotonic clocks differ), or already FINISHED with its stop
+        reason when the committed stream satisfies a finish condition
+        (the crash/drain landed between the final commit and its finish
+        record). Returns ``(None, reason)`` for an entry this engine
+        cannot resume token-exactly: grammar requests (host stepper
+        state), an unparseable params record, a prompt beyond this
+        engine's capacity, stop strings without `detokenize`, or
+        kv_exact without sidecar lanes. An SLO class this engine does
+        not track is dropped, not fatal — the class is accounting, not
+        semantics."""
+        limit = getattr(self.model, "max_positions", None)
+        cap = min(self.config.max_len, limit or self.config.max_len)
+        err = None
+        params = None
+        if e.grammar:
+            err = "grammar stepper state is not journaled"
+        else:
+            try:
+                p = dict(e.params)
+                p["stop_token_ids"] = tuple(
+                    p.get("stop_token_ids") or ())
+                p["stop"] = tuple(p.get("stop") or ())
+                params = SamplingParams(**p)
+            except (TypeError, ValueError) as exc:
+                err = f"unreplayable params: {exc}"
+        if err is None:
+            if len(e.prompt) < 1 or \
+                    len(e.prompt) + e.max_new_tokens > cap:
+                err = f"beyond this engine's capacity {cap}"
+            elif params.stop and self.detokenize is None:
+                err = "stop strings need a detokenize callable"
+            elif (params.kv_exact and self._quant
+                  and not self.config.kv_exact_lanes):
+                err = "kv_exact needs exact sidecar lanes"
+            elif params.slo is not None and (
+                self._slo is None or params.slo not in self._slo.targets
+            ):
+                # the SLO class is accounting, not semantics: keep
+                # the stream, drop the untracked tag
+                params = dataclasses.replace(params, slo=None)
+        if err is not None:
+            return None, err
+        req = Request(
+            prompt=np.asarray(e.prompt, np.int32),
+            max_new_tokens=e.max_new_tokens,
+            eos_id=e.eos_id, params=params,
+        )
+        req.trace_id = e.rid
+        req.tokens = [int(t) for t in e.tokens]
+        if e.deadline_s is not None:
+            # absolute deadlines cannot cross a restart (monotonic
+            # clocks reset), so the recovered request re-arms its
+            # ORIGINAL relative budget from now — bounded again,
+            # not unbounded
+            req.deadline = req.submit_time + e.deadline_s
+        reason = (self._stop_reason(req, req.tokens[-1])
+                  if req.tokens else None)
+        if (reason is None and req.tokens and params.stop
+                and self._stop_string_at(req, 0) is not None):
+            # commits are written AFTER stop-string truncation, so
+            # a committed stream never extends past a match — any
+            # match here means the stream was complete at the crash
+            reason = "stop"
+        if reason is not None:
+            req.state = FINISHED
+            req.finish_reason = reason
+            req.finish_time = smetrics.now()
+        return req, None
+
+    def adopt(self, entry) -> Request:
+        """Adopt a live journal entry from ANOTHER replica's journal —
+        the fleet router's stream-migration primitive (serve/fleet.py
+        `FleetRouter.drain`). The drained replica force-finishes the
+        stream ``"migrated"``; this engine continues it through the same
+        preemption-resume machinery `recover()` uses (re-prefill prompt
+        + committed tokens, discard the resampled token — TOKEN-EXACT
+        for greedy and seeded plain-decode streams, the journal
+        contract). Call with this engine's step lock held (the
+        EngineLoop lock): adoption touches the scheduler queue and the
+        journal the engine thread also owns.
+
+        The adopted request is journaled into THIS engine's journal
+        when it has one (submit + committed prefix — a crash after the
+        migration recovers the stream HERE), registered in the
+        recovered set so Last-Event-ID reconnects resolve through the
+        same path as a crash restart, and requeued at the FRONT of the
+        queue (it predates everything waiting — the same FIFO-survives
+        rule as `recover()`; when migrating several entries, adopt them
+        newest-first so the oldest ends at the head). Note the journal
+        submit re-keys `trace_id` if this engine already has a live
+        journal entry under the same id — read the id back from the
+        returned request. An entry whose committed stream already
+        satisfies a finish condition comes back FINISHED (journaled
+        through to its finish record) instead of requeued.
+
+        Raises ValueError for an entry this engine cannot resume
+        token-exactly (see `_entry_request`) — the caller decides how
+        to surface the failed migration; nothing is enqueued."""
+        req, err = self._entry_request(entry)
+        if err is not None:
+            raise ValueError(
+                f"journal entry {entry.rid} cannot be adopted ({err})")
+        self._journal_submit(req)
+        self._journal_commit(req, req.tokens)
+        if req.done:
+            self._journal_finish(req)
+        else:
+            # bypasses max_waiting like requeue_front's preemption case:
+            # the stream was already admitted once, on the drained peer
+            self.scheduler.requeue_front(req)
+            if req.deadline is not None:
+                self._waiting_deadlines += 1
+        self._recovered[req.trace_id] = req
+        self._recovered_total += 1
+        if self.trace is not None:
+            self.trace.instant(
+                "journal_adopt", "engine", "engine", rid=req.trace_id,
+                committed=len(req.tokens), done=req.done,
+            )
+        return req
+
     def recover(self) -> list[Request]:
         """Replay the journal's unfinished entries through the
         preemption-resume machinery: each live entry becomes a WAITING
@@ -2448,40 +2577,11 @@ class ServeEngine:
                 "recover() replays the write-ahead journal, which needs "
                 "ServeConfig.journal_path set"
             )
-        limit = getattr(self.model, "max_positions", None)
-        cap = min(self.config.max_len, limit or self.config.max_len)
         resumed: list[Request] = []
         for e in self.journal.live_entries():
             usage = {"prompt_tokens": len(e.prompt),
                      "completion_tokens": len(e.tokens)}
-            err = None
-            params = None
-            if e.grammar:
-                err = "grammar stepper state is not journaled"
-            else:
-                try:
-                    p = dict(e.params)
-                    p["stop_token_ids"] = tuple(
-                        p.get("stop_token_ids") or ())
-                    p["stop"] = tuple(p.get("stop") or ())
-                    params = SamplingParams(**p)
-                except (TypeError, ValueError) as exc:
-                    err = f"unreplayable params: {exc}"
-            if err is None:
-                if len(e.prompt) < 1 or \
-                        len(e.prompt) + e.max_new_tokens > cap:
-                    err = f"beyond this engine's capacity {cap}"
-                elif params.stop and self.detokenize is None:
-                    err = "stop strings need a detokenize callable"
-                elif (params.kv_exact and self._quant
-                      and not self.config.kv_exact_lanes):
-                    err = "kv_exact needs exact sidecar lanes"
-                elif params.slo is not None and (
-                    self._slo is None or params.slo not in self._slo.targets
-                ):
-                    # the SLO class is accounting, not semantics: keep
-                    # the stream, drop the untracked tag
-                    params = dataclasses.replace(params, slo=None)
+            req, err = self._entry_request(e)
             if err is not None:
                 warnings.warn(
                     f"journal entry {e.rid} cannot be recovered ({err}) "
@@ -2490,35 +2590,11 @@ class ServeEngine:
                 self._journal_op(self.journal.append_finish, e.rid,
                                  "error", usage)
                 continue
-            req = Request(
-                prompt=np.asarray(e.prompt, np.int32),
-                max_new_tokens=e.max_new_tokens,
-                eos_id=e.eos_id, params=params,
-            )
-            req.trace_id = e.rid
-            req.tokens = [int(t) for t in e.tokens]
-            if e.deadline_s is not None:
-                # absolute deadlines cannot cross a restart (monotonic
-                # clocks reset), so the recovered request re-arms its
-                # ORIGINAL relative budget from now — bounded again,
-                # not unbounded
-                req.deadline = req.submit_time + e.deadline_s
-            reason = (self._stop_reason(req, req.tokens[-1])
-                      if req.tokens else None)
-            if (reason is None and req.tokens and params.stop
-                    and self._stop_string_at(req, 0) is not None):
-                # commits are written AFTER stop-string truncation, so
-                # a committed stream never extends past a match — any
-                # match here means the stream was complete at the crash
-                reason = "stop"
-            if reason is not None:
+            if req.done:
                 # the crash landed between the final commit and its
                 # finish record: the stream is already complete
-                req.state = FINISHED
-                req.finish_reason = reason
-                req.finish_time = smetrics.now()
                 self._journal_op(self.journal.append_finish, e.rid,
-                                 reason, usage)
+                                 req.finish_reason, usage)
                 continue
             resumed.append(req)
         # oldest ends at the queue head: FIFO order survives the crash

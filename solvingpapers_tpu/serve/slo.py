@@ -61,8 +61,11 @@ DEFAULT_SLO_TARGETS = {
 DEFAULT_CLASS = "standard"
 
 # finish reasons that never count against (or for) an SLO: the client
-# walked away or the engine itself failed — neither is a latency outcome
-_EXCLUDED_REASONS = ("cancelled", "error")
+# walked away, the engine itself failed, or the stream moved to a peer
+# replica mid-flight (fleet drain — the ADOPTING replica owns the
+# latency outcome; the drained one force-finishing "migrated" must not
+# burn its own budget on a stream it deliberately handed off)
+_EXCLUDED_REASONS = ("cancelled", "error", "migrated")
 
 
 def request_latencies(req, now: float) -> dict[str, float]:

@@ -1,0 +1,615 @@
+"""Fleet-serving tests (serve/fleet.py + the router surface in
+serve/api.py).
+
+Contracts under test. Routing: prefix-cache affinity picks the replica
+whose radix tree covers the longest prompt prefix; the health gate
+excludes draining / dead-loop / unhealthy replicas; the per-class burn
+gate steers SLO traffic away from a burning replica (unless every
+candidate burns); a full replica re-routes to a peer with room instead
+of bouncing the client (the fleet-wide 503 fix), and `capacity_left`
+sums ADMITTING replicas only. Exactness: a 2-replica fleet decodes the
+greedy + seeded sampling mix token-identically to a single-engine
+reference — routing placement never changes a stream's bytes. Drain:
+`FleetRouter.drain` migrates every live stream onto a peer through the
+journal + `ServeEngine.adopt` recover path token-exactly, the drained
+replica passes `assert_no_leaks` immediately, errors are refused
+up-front (no journal -> ValueError, no admitting peer -> RuntimeError).
+HTTP: responses carry ``X-Replica-Id``; /statusz grows a ``fleet``
+section; /metrics merges fleet histograms (unlabeled series == sum of
+``replica``-labeled series); a mid-stream drain closes the SSE stream
+WITHOUT a terminal chunk or [DONE] (the reconnect signal), the
+Last-Event-ID reconnect resolves on the ADOPTING replica and the
+combined bytes equal an uninterrupted run; a blocking request rides the
+migration transparently inside one POST.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import assert_no_leaks
+from solvingpapers_tpu.serve import (
+    FleetRouter,
+    ServeConfig,
+    ServeEngine,
+)
+from solvingpapers_tpu.serve.sampling import SamplingParams
+
+
+def _gpt_tiny():
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32,
+                          n_layers=2, n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _gpt_tiny()
+    return _MODEL
+
+
+def _prompts(n, seed=0, size=8):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, size=size).astype(np.int32)
+            for _ in range(n)]
+
+
+def _cfg(**kw):
+    base = dict(n_slots=3, max_len=48, decode_block=4, bucket=8,
+                max_prefills_per_step=3)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _params_for(i):
+    """Greedy + seeded stochastic cycle: every stream replayable."""
+    if i % 3 == 1:
+        return SamplingParams(temperature=0.8, top_p=0.9, seed=100 + i)
+    if i % 3 == 2:
+        return SamplingParams(temperature=1.3, top_k=8, seed=200 + i)
+    return None
+
+
+def _fleet(n=2, cfg_for=None, **cfg_kw):
+    model, params = _model()
+    engines = [
+        ServeEngine(model, params,
+                    cfg_for(i) if cfg_for else _cfg(**cfg_kw))
+        for i in range(n)
+    ]
+    return FleetRouter(engines, start=False)
+
+
+def _step_all(router):
+    worked = False
+    for r in router.replicas:
+        if r.engine.has_work():
+            with r.loop.lock:
+                r.engine.step()
+            worked = True
+    return worked
+
+
+def _drain_fleet(router):
+    while _step_all(router):
+        pass
+
+
+# ------------------------------------------------------------- routing
+
+
+def test_prefix_affinity_routes_to_warm_replica():
+    """The replica whose radix tree covers the prompt's prefix wins the
+    ranking even when a peer is equally empty — affinity is the top
+    sort key after the gates."""
+    router = _fleet(2, prefix_cache=True)
+    rng = np.random.default_rng(5)
+    stem = rng.integers(0, 64, size=32).astype(np.int32)
+    # warm r1 ONLY: run a stem-prefixed request through it directly
+    r1 = router.replica("r1")
+    r1.engine.submit(stem, max_new_tokens=4)
+    while r1.engine.has_work():
+        r1.engine.step()
+    assert r1.probe(stem) > 0 and router.replica("r0").probe(stem) == 0
+    probe = np.concatenate([stem[:16],
+                            rng.integers(0, 64, 8).astype(np.int32)])
+    assert router.route(probe).rid == "r1"
+    # no cached prefix anywhere -> deterministic least-loaded tiebreak
+    cold = rng.integers(0, 64, size=24).astype(np.int32)
+    assert router.route(cold).rid == "r0"
+    _drain_fleet(router)
+
+
+def test_health_gate_and_draining_excluded():
+    router = _fleet(2)
+    p = _prompts(1)[0]
+    router.replica("r0").draining = True
+    assert router.route(p).rid == "r1"
+    router.replica("r1").loop.error = RuntimeError("loop died")
+    assert router.route(p) is None
+    assert router.submit(p) == (None, None)
+    assert router.health == "unhealthy"
+    router.replica("r0").draining = False
+    assert router.route(p).rid == "r0"
+    assert router.health == "healthy"
+    router.replica("r1").loop.error = None
+
+
+def test_burn_gate_steers_slo_class_away():
+    """Interactive traffic avoids a replica burning its error budget
+    for that class; when EVERY candidate burns the gate yields (routing
+    somewhere beats routing nowhere)."""
+
+    class _Hot:
+        targets = {"interactive": {"objective": 0.99}}
+
+        def burn_rate(self, cls):
+            return 5.0
+
+    router = _fleet(2)
+    router.replica("r0").engine._slo = _Hot()
+    p = _prompts(1)[0]
+    assert router.route(p, slo="interactive").rid == "r1"
+    assert router.stats["burn_avoided"] == 1
+    # untracked class and no class: the gate does not apply
+    assert router.route(p, slo="batch").rid == "r0"
+    assert router.route(p).rid == "r0"
+    # every candidate burning: gate yields rather than refusing
+    router.replica("r1").engine._slo = _Hot()
+    assert router.route(p, slo="interactive") is not None
+    router.replica("r0").engine._slo = None
+    router.replica("r1").engine._slo = None
+
+
+def test_full_replica_reroutes_to_peer_with_room():
+    """A ranked-first replica whose waiting queue is full must NOT
+    bounce the client while a peer has room — the router retries down
+    the ranking (the fleet-wide 503 fix) and `capacity_left` counts
+    admitting replicas only."""
+    router = _fleet(2, cfg_for=lambda i: _cfg(prefix_cache=True,
+                                              max_waiting=2))
+    rng = np.random.default_rng(9)
+    stem = rng.integers(0, 64, size=32).astype(np.int32)
+    r0 = router.replica("r0")
+    r0.engine.submit(stem, max_new_tokens=4)
+    while r0.engine.has_work():
+        r0.engine.step()
+    # fill r0's waiting queue (no stepping: everything queues)
+    for p in _prompts(2, seed=3):
+        assert r0.engine.submit(p, max_new_tokens=4).state != "rejected"
+    assert r0.engine.scheduler.capacity_left == 0
+    probe = np.concatenate([stem[:16],
+                            rng.integers(0, 64, 8).astype(np.int32)])
+    assert router.route(probe).rid == "r0"  # affinity still ranks it first
+    rep, req = router.submit(probe, max_new_tokens=4)
+    assert rep.rid == "r1" and req.state != "rejected"
+    assert router.stats["rerouted_full"] == 1
+    # fleet capacity: only ADMITTING replicas count
+    total = router.capacity_left
+    r1 = router.replica("r1")
+    assert total == r1.engine.scheduler.capacity_left
+    r1.draining = True
+    assert router.capacity_left == 0
+    r1.draining = False
+    _drain_fleet(router)
+
+
+def test_duplicate_journal_path_refused(tmp_path):
+    model, params = _model()
+    cfg = _cfg(journal_path=str(tmp_path / "same.jsonl"))
+    engines = [ServeEngine(model, params, cfg)]
+    with pytest.raises(ValueError, match="OWN journal"):
+        FleetRouter(engines + [ServeEngine(model, params, cfg)],
+                    start=False)
+
+
+# ----------------------------------------------------------- exactness
+
+
+def test_fleet_token_exact_vs_single_engine():
+    """Routing placement never changes a stream's bytes: every request
+    through a 2-replica fleet (greedy + seeded sampling mix) decodes
+    token-identically to a single-engine reference."""
+    model, params = _model()
+    prompts = _prompts(9, seed=1)
+    ref_eng = ServeEngine(model, params, _cfg())
+    refs = [ref_eng.submit(p, max_new_tokens=10, params=_params_for(i))
+            for i, p in enumerate(prompts)]
+    ref_eng.run()
+
+    router = _fleet(2)
+    handles, placed = [], set()
+    for i, p in enumerate(prompts):
+        rep, req = router.submit(p, max_new_tokens=10,
+                                 params=_params_for(i))
+        assert req is not None and req.state != "rejected"
+        handles.append(req)
+        placed.add(rep.rid)
+    _drain_fleet(router)
+    # the load balancer actually spread the work
+    assert placed == {"r0", "r1"}
+    for h, r in zip(handles, refs):
+        assert h.tokens == r.tokens
+    for rep in router.replicas:
+        assert_no_leaks(rep.engine)
+
+
+# --------------------------------------------------------------- drain
+
+
+def test_drain_migrates_live_streams_token_exact(tmp_path):
+    """The headline: drain r0 mid-decode; every live stream finishes on
+    the peer byte-identical to an uninterrupted reference, the drained
+    replica reclaims to zero leaks IMMEDIATELY, and the report maps
+    every migrated id to its adopter."""
+    model, params = _model()
+    prompts = _prompts(6, seed=2)
+    ref_eng = ServeEngine(model, params, _cfg())
+    refs = [ref_eng.submit(p, max_new_tokens=12, params=_params_for(i))
+            for i, p in enumerate(prompts)]
+    ref_eng.run()
+
+    router = _fleet(
+        2, cfg_for=lambda i: _cfg(
+            journal_path=str(tmp_path / f"r{i}.jsonl")))
+    handles, where = [], {}
+    for i, p in enumerate(prompts):
+        rep, req = router.submit(p, max_new_tokens=12,
+                                 params=_params_for(i),
+                                 trace_id=f"mig-{i}")
+        handles.append(req)
+        where[req.trace_id] = rep.rid
+    _step_all(router)  # one block everywhere: streams live mid-decode
+    live_r0 = [h for h in handles
+               if where[h.trace_id] == "r0" and not h.done]
+    assert live_r0, "test needs live streams on r0 at drain time"
+
+    report = router.drain("r0")
+    assert router.replica("r0").draining
+    assert not router.replica("r0").admitting
+    assert_no_leaks(router.replica("r0").engine)  # reclaimed at drain
+    assert report.entries == len(live_r0)
+    assert report.errors == []
+    assert sorted(report.targets) == sorted(h.trace_id for h in live_r0)
+    for h in live_r0:  # the original request objects force-finished
+        assert h.done and h.finish_reason == "migrated"
+    assert all(peer == "r1" for peer, _ in report.targets.values())
+
+    _drain_fleet(router)
+    assert all(r.done for r in report.migrated)
+    succ = {old: router.replica(peer).engine._recovered[new]
+            for old, (peer, new) in report.targets.items()}
+    for h, r in zip(handles, refs):
+        stream = (succ[h.trace_id].tokens if h.trace_id in succ
+                  else h.tokens)
+        assert stream == r.tokens, h.trace_id
+    # owner map follows the stream to its adopter
+    for old in report.targets:
+        assert router.owner(old).rid == "r1"
+    for rep in router.replicas:
+        assert_no_leaks(rep.engine)
+    assert router.stats["drains"] == 1
+    assert router.stats["migrated_streams"] == len(live_r0)
+    # nothing admits to a draining replica; undrain reopens it
+    assert router.route(prompts[0]).rid == "r1"
+    router.undrain("r0")
+    assert router.replica("r0").admitting
+
+
+def test_drain_refusals(tmp_path):
+    router = _fleet(2)  # no journals
+    with pytest.raises(ValueError, match="journal"):
+        router.drain("r0")
+    with pytest.raises(KeyError, match="unknown replica"):
+        router.drain("r9")
+    jrouter = _fleet(
+        2, cfg_for=lambda i: _cfg(
+            journal_path=str(tmp_path / f"j{i}.jsonl")))
+    jrouter.replica("r1").draining = True
+    with pytest.raises(RuntimeError, match="no admitting peer"):
+        jrouter.drain("r0")
+    # the refusal must not have closed r0's admission gate
+    assert jrouter.replica("r0").admitting
+
+
+# ------------------------------------------------------- fleet metrics
+
+
+def test_prom_sets_merge_equals_sum_of_replicas():
+    """The merged (unlabeled) set's histograms equal the exact
+    `LogHistogram.merge` of the replicas' — counts and sum — and the
+    fleet gauges ride the merged set."""
+    router = _fleet(2)
+    for i, p in enumerate(_prompts(6, seed=4)):
+        router.submit(p, max_new_tokens=6, params=_params_for(i))
+    _drain_fleet(router)
+    sets = router.prom_sets()
+    (step0, labels0, merged), *per = sets
+    assert labels0 is None
+    assert [lab["replica"] for _, lab, _ in per] == ["r0", "r1"]
+    assert merged["fleet/replicas"] == 2.0
+    assert merged["fleet/admitting"] == 2.0
+    assert merged["fleet/routed"] == 6.0
+    from solvingpapers_tpu.metrics.hist import LogHistogram
+
+    hist_names = [k for k, v in merged.items()
+                  if isinstance(v, LogHistogram)]
+    assert hist_names, "fleet snapshot must carry latency histograms"
+    for k in hist_names:
+        shards = [snap[k] for _, _, snap in per if k in snap]
+        assert merged[k].count == sum(s.count for s in shards)
+        assert merged[k].counts.sum() == sum(
+            s.counts.sum() for s in shards)
+        assert merged[k].sum == pytest.approx(
+            sum(s.sum for s in shards))
+    for rep in router.replicas:
+        assert_no_leaks(rep.engine)
+
+
+# -------------------------------------------------------- HTTP surface
+
+
+def _sse(url, body=None, headers=None, timeout=120):
+    data = json.dumps(body or {}).encode()
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        replica = r.headers.get("X-Replica-Id")
+        cur = None
+        for raw in r:
+            line = raw.decode().rstrip("\n")
+            if line.startswith("id: "):
+                cur = line[4:]
+            elif line.startswith("data: "):
+                if line[6:] == "[DONE]":
+                    break
+                events.append((cur, json.loads(line[6:])))
+    return replica, events
+
+
+@pytest.fixture(scope="module")
+def fleet_server(tmp_path_factory):
+    from solvingpapers_tpu.serve.api import ApiServer
+
+    model, params = _model()
+    jdir = tmp_path_factory.mktemp("fleet_j")
+    engines = [
+        ServeEngine(model, params, _cfg(
+            api_port=0, n_slots=2,
+            journal_path=str(jdir / f"r{i}.jsonl")))
+        for i in range(2)
+    ]
+    router = FleetRouter(engines)  # started loops: the real topology
+    srv = ApiServer(
+        router=router,
+        decode=lambda ids: "".join(chr(97 + i % 26) for i in ids),
+        model_name="gpt-tiny",
+    )
+    yield srv, router
+    srv.close()
+
+
+def test_http_replica_header_and_statusz_fleet(fleet_server):
+    srv, router = fleet_server
+    body = {"prompt": [1, 2, 3, 4], "max_tokens": 6}
+    req = urllib.request.Request(
+        srv.url("/v1/completions"), data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        doc = json.loads(r.read())
+        assert r.headers["X-Replica-Id"] in {"r0", "r1"}
+    assert doc["choices"][0]["finish_reason"] == "length"
+    with urllib.request.urlopen(srv.url("/statusz"), timeout=30) as r:
+        status = json.loads(r.read())
+    fleet = status["fleet"]
+    assert sorted(fleet["replicas"]) == ["r0", "r1"]
+    assert fleet["routing"]["routed"] >= 1
+    for d in fleet["replicas"].values():
+        assert d["admitting"] and d["health"] == "healthy"
+    with urllib.request.urlopen(srv.url("/healthz"), timeout=30) as r:
+        assert r.read().strip() == b"ok"
+
+
+def test_http_metrics_merged_plus_labeled(fleet_server):
+    """/metrics carries ONE # TYPE per name, the unlabeled fleet merge,
+    and per-replica labeled series whose histogram counts SUM to the
+    merged series (the scrape-side aggregation contract)."""
+    srv, router = fleet_server
+    for i in range(3):  # traffic on the fleet so histograms are non-empty
+        _sse(srv.url("/v1/completions"),
+             {"prompt": [5 + i, 6, 7], "max_tokens": 4, "stream": True})
+    with urllib.request.urlopen(srv.url("/metrics"), timeout=30) as r:
+        text = r.read().decode()
+    lines = text.splitlines()
+    types: dict = {}
+    for ln in lines:
+        if ln.startswith("# TYPE "):
+            name, kind = ln.split()[2], ln.split()[3]
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+    assert types.get("fleet_replicas") == "gauge"
+    assert "fleet_replicas 2.0" in lines
+    # histogram _count invariant: unlabeled == sum over replica series
+    hist_names = [n for n, k in types.items() if k == "histogram"]
+    assert hist_names
+    merged_seen = 0
+    for name in hist_names:
+        unlabeled = labeled = None
+        for ln in lines:
+            if ln.startswith(f"{name}_count "):
+                unlabeled = int(float(ln.rsplit(" ", 1)[1]))
+            elif ln.startswith(f"{name}_count{{"):
+                labeled = (labeled or 0) + int(
+                    float(ln.rsplit(" ", 1)[1]))
+        assert unlabeled is not None
+        assert unlabeled == (labeled or 0), name
+        merged_seen += unlabeled
+    assert merged_seen > 0, "traffic must have recorded observations"
+    assert 'replica="r0"' in text and 'replica="r1"' in text
+
+
+def _live_tokens(rep, rid, max_new):
+    e = rep.engine.journal.lookup(rid)
+    if (e is None or e.finished or len(e.tokens) >= max_new
+            or not rep.engine.journal.is_live(rid)):
+        return None
+    return len(e.tokens)
+
+
+def _drain_while_live(router, rid, max_new, thread, deadline_s=60):
+    """Catch `rid` live mid-decode and drain its replica UNDER the held
+    step lock (RLock: drain's `_locked` re-enters) — the stream is
+    deterministically live at the drain, no racing the engine loop.
+    Returns ``(owner, report)``; ``(None, None)`` when the stream
+    finished before it could be caught (caller retries)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        owner = router.owner(rid)
+        if owner is not None:
+            with owner.loop.lock:
+                if _live_tokens(owner, rid, max_new) is not None:
+                    return owner, router.drain(owner.rid)
+            if not thread.is_alive():
+                return None, None  # finished un-migrated: retry
+        time.sleep(0.001)
+    pytest.fail(f"{rid} never observed live mid-decode")
+
+
+def test_http_mid_stream_drain_migrates_sse(fleet_server):
+    """The zero-drop protocol end to end: a live SSE stream's replica
+    drains; the first connection ends WITHOUT a terminal chunk or
+    [DONE]; the Last-Event-ID reconnect lands on the ADOPTING replica
+    and the combined bytes equal an uninterrupted reference."""
+    srv, router = fleet_server
+    model, params = _model()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    ref_eng = ServeEngine(model, params, _cfg())
+    ref = ref_eng.submit(np.asarray(prompt, np.int32),
+                         max_new_tokens=40)
+    ref_eng.run()
+    dec = srv.decode
+
+    for attempt in range(6):
+        rid = f"mig-sse-{attempt}"
+        first: dict = {}
+
+        def client(rid=rid, first=first):
+            req = urllib.request.Request(
+                srv.url("/v1/completions"),
+                data=json.dumps({"prompt": prompt, "max_tokens": 40,
+                                 "temperature": 0,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid}, method="POST")
+            chunks, ids, done = [], [], False
+            with urllib.request.urlopen(req, timeout=120) as r:
+                first["replica"] = r.headers.get("X-Replica-Id")
+                cur = None
+                for raw in r:
+                    line = raw.decode().rstrip("\n")
+                    if line.startswith("id: "):
+                        cur = line[4:]
+                    elif line.startswith("data: "):
+                        if line[6:] == "[DONE]":
+                            done = True
+                            break
+                        chunks.append(json.loads(line[6:]))
+                        ids.append(cur)
+                    elif line.startswith(": migrated"):
+                        first["comment"] = line
+            first.update(chunks=chunks, ids=ids, done=done)
+
+        t = threading.Thread(target=client)
+        t.start()
+        owner, report = _drain_while_live(router, rid, 40, t)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        if owner is not None:
+            break
+    else:
+        pytest.fail("stream always finished before the drain landed")
+
+    assert (rid, ) == tuple(report.targets)
+    peer, new_rid = report.targets[rid]
+    assert peer != owner.rid and new_rid == rid
+    assert not first["done"], "migrated stream must NOT see [DONE]"
+    assert "migrated" in first.get("comment", "")
+    assert all("finish_reason" not in c["choices"][0]
+               or c["choices"][0]["finish_reason"] is None
+               for c in first["chunks"])
+
+    seen = len(first["chunks"]) and int(first["ids"][-1].split(":")[1])
+    replica2, ev2 = _sse(srv.url("/v1/completions"), {},
+                         {"Last-Event-ID": f"{rid}:{seen}"})
+    assert replica2 == peer
+    head = "".join(c["choices"][0].get("text", "")
+                   for c in first["chunks"])
+    tail = "".join(e["choices"][0].get("text", "") for _, e in ev2)
+    assert ev2[-1][1]["choices"][0]["finish_reason"] == "length"
+    assert head + tail == dec(ref.tokens)
+    assert ev2[-1][0] == f"{rid}:40"
+    assert_no_leaks(router.replica(owner.rid).engine)
+    router.undrain(owner.rid)
+
+
+def test_http_blocking_request_rides_migration(fleet_server):
+    """A non-streaming POST in flight across a drain returns ONE
+    complete response (the front door swaps to the adopted successor
+    internally) with the adopter's X-Replica-Id and reference bytes."""
+    srv, router = fleet_server
+    model, params = _model()
+    prompt = [2, 7, 1, 8, 2, 8]
+    ref_eng = ServeEngine(model, params, _cfg())
+    ref = ref_eng.submit(np.asarray(prompt, np.int32),
+                         max_new_tokens=40)
+    ref_eng.run()
+
+    for attempt in range(6):
+        rid = f"mig-blk-{attempt}"
+        out: dict = {}
+
+        def client(rid=rid, out=out):
+            req = urllib.request.Request(
+                srv.url("/v1/completions"),
+                data=json.dumps({"prompt": prompt, "temperature": 0,
+                                 "max_tokens": 40}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": rid}, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out["replica"] = r.headers.get("X-Replica-Id")
+                out["doc"] = json.loads(r.read())
+
+        t = threading.Thread(target=client)
+        t.start()
+        owner, report = _drain_while_live(router, rid, 40, t)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        if owner is not None:
+            break
+    else:
+        pytest.fail("request always finished before the drain landed")
+    peer, _ = report.targets[rid]
+    assert out["replica"] == peer
+    doc = out["doc"]
+    assert doc["choices"][0]["finish_reason"] == "length"
+    assert doc["choices"][0]["text"] == srv.decode(ref.tokens)
+    assert doc["usage"]["completion_tokens"] == 40
+    router.undrain(owner.rid)

@@ -266,6 +266,148 @@ def test_prometheus_histogram_wins_derived_name_collisions():
     assert value_lines == ["x_count 1"]
 
 
+def test_histogram_nway_merge_associative_and_commutative():
+    """Fleet-merge algebra: merging replicas' shards is associative
+    and commutative — the merged /metrics series cannot depend on
+    replica order or on whether shards were pre-combined."""
+    import itertools
+
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    rng = np.random.default_rng(11)
+    shards = []
+    for i in range(4):
+        h = LogHistogram()
+        for v in rng.lognormal(-1.0 + 0.4 * i, 1.5, 300 + 50 * i):
+            h.add(v)
+        shards.append(h)
+
+    def eq(a, b):
+        return ((a.counts == b.counts).all() and a.count == b.count
+                and a.min == b.min and a.max == b.max
+                and a.sum == pytest.approx(b.sum, rel=1e-9))
+
+    flat = LogHistogram.merge(shards)
+    # associativity: ((0+1)+2)+3 == 0+((1+2)+3) == flat N-way
+    left = LogHistogram.merge(
+        [LogHistogram.merge(shards[:2]), shards[2], shards[3]])
+    right = LogHistogram.merge(
+        [shards[0], LogHistogram.merge(
+            [shards[1], LogHistogram.merge(shards[2:])])])
+    assert eq(left, flat) and eq(right, flat)
+    # commutativity: every permutation of the shards merges identically
+    for perm in itertools.permutations(shards):
+        assert eq(LogHistogram.merge(list(perm)), flat)
+    # the inputs are untouched (merge copies; a scrape must not
+    # mutate the live per-replica histograms it aggregates)
+    assert sum(s.count for s in shards) == flat.count
+
+
+def test_histogram_merge_while_recording_never_tears():
+    """The fleet /metrics race: merging a LIVE histogram (a serving
+    thread mid-`add`) must never tear — every merged snapshot satisfies
+    bucket-total == count, and the quiescent merge is exact."""
+    import threading
+
+    from solvingpapers_tpu.metrics import LogHistogram
+
+    src = LogHistogram()
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            src.add(1e-3 * (1 + i % 997))
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(300):
+            m = LogHistogram.merge([src])
+            assert int(m.counts.sum()) == m.count
+    finally:
+        stop.set()
+        t.join()
+    m = LogHistogram.merge([src])
+    assert int(m.counts.sum()) == m.count == len(src)
+    assert m.sum == pytest.approx(src.sum)
+
+
+def test_prometheus_render_constant_labels():
+    """`labels=` stamps a constant label set on every series — gauges,
+    histogram buckets (joined with `le`), _sum/_count and the
+    `last_step` rider — with sanitized names and escaped values."""
+    from solvingpapers_tpu.metrics import LogHistogram, PrometheusTextWriter
+
+    h = LogHistogram(lo=0.01, hi=10.0, buckets_per_decade=2)
+    h.add(0.3)
+    text = PrometheusTextWriter.render(
+        7, {"serve/ttft_s": h, "serve/qps": 2.0},
+        labels={"replica": "r0", "mo del": 'a"b\nc\\d'})
+    lines = text.splitlines()
+    ls = '{replica="r0",mo_del="a\\"b\\nc\\\\d"}'
+    assert f"serve_qps{ls} 2.0" in lines
+    assert f"last_step{ls} 7" in lines
+    assert "# TYPE serve_ttft_s histogram" in lines
+    buckets = [ln for ln in lines
+               if ln.startswith("serve_ttft_s_bucket{")]
+    assert buckets and all(
+        ln.startswith('serve_ttft_s_bucket{replica="r0",'
+                      'mo_del="a\\"b\\nc\\\\d",le="')
+        for ln in buckets)
+    assert f"serve_ttft_s_count{ls} 1" in lines
+    # unlabeled render is byte-stable vs the pre-label contract
+    assert PrometheusTextWriter.render(7, {"a": 1.0}) == (
+        "# TYPE a gauge\na 1.0\n"
+        "# TYPE last_step gauge\nlast_step 7\n")
+
+
+def test_prometheus_render_sets_fleet_contract():
+    """The fleet /metrics shape: ONE `# TYPE` per metric name across
+    all label sets, per-set `last_step{labels}` riders, (name, labels)
+    dedupe with last write winning, and a histogram in any set claiming
+    its derived names across ALL sets."""
+    from solvingpapers_tpu.metrics import LogHistogram, PrometheusTextWriter
+
+    h0 = LogHistogram(lo=0.01, hi=10.0, buckets_per_decade=2)
+    h1 = LogHistogram(lo=0.01, hi=10.0, buckets_per_decade=2)
+    for v in (0.02, 0.3):
+        h0.add(v)
+    h1.add(5.0)
+    merged = LogHistogram.merge([h0, h1])
+    text = PrometheusTextWriter.render_sets([
+        (9, None, {"serve/ttft_s": merged, "fleet/replicas": 2.0}),
+        # the gauge colliding with the histogram's _count is dropped
+        (9, {"replica": "r0"}, {"serve/ttft_s": h0, "serve/qps": 1.0,
+                                "serve/ttft_s_count": 99.0}),
+        (4, {"replica": "r1"}, {"serve/ttft_s": h1, "serve/qps": 3.0}),
+    ])
+    lines = text.splitlines()
+    for name in ("serve_ttft_s", "serve_qps", "last_step"):
+        assert sum(ln.startswith(f"# TYPE {name} ")
+                   for ln in lines) == 1, name
+    assert 'serve_qps{replica="r0"} 1.0' in lines
+    assert 'serve_qps{replica="r1"} 3.0' in lines
+    assert "fleet_replicas 2.0" in lines
+    assert "last_step 9" in lines
+    assert 'last_step{replica="r0"} 9' in lines
+    assert 'last_step{replica="r1"} 4' in lines
+    # merged _count == sum of the labeled _counts (scrape aggregation)
+    assert "serve_ttft_s_count 3" in lines
+    assert 'serve_ttft_s_count{replica="r0"} 2' in lines
+    assert 'serve_ttft_s_count{replica="r1"} 1' in lines
+    assert not any(ln.startswith("serve_ttft_s_count{replica=\"r0\"} 99")
+                   for ln in lines)
+    # dedupe pointwise on (name, labels): the last write wins
+    text2 = PrometheusTextWriter.render_sets([
+        (1, {"replica": "r0"}, {"x": 1.0}),
+        (2, {"replica": "r0"}, {"x": 5.0}),
+    ])
+    xs = [ln for ln in text2.splitlines() if ln.startswith('x{')]
+    assert xs == ['x{replica="r0"} 5.0']
+
+
 # ----------------------------------------------------- writer robustness
 
 

@@ -52,7 +52,15 @@ journal-on vs journal-off req/s — journal_overhead_pct, fsync batched
 per step — plus a kill-and-recover arm: abandon a journaled engine
 mid-decode, replay the journal through a fresh one, and record
 recovery_wall_s / recovered_requests / recovered_token_exact with the
-zero-leak drain invariant).
+zero-leak drain invariant),
+
+and with `--fleet --append` for the fleet-serving workload (ABBA-paired
+1-replica FleetRouter vs bare engine req/s — router_overhead_pct, the
+pure routing tax — plus a drain-migration arm: a journaled 2-replica
+fleet mid-decode drain of r0, peers adopting its live streams through
+the recover() path, recording migration_wall_s / migrated_streams /
+migrated_token_exact / fleet_token_exact with zero-leak on BOTH
+replicas).
 
 Every entry records the `kv_dtype` / `kv_pool_bytes` /
 `greedy_agreement_rate` triple (exact pools report their compute dtype
