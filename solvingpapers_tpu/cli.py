@@ -500,6 +500,9 @@ def cmd_serve(args) -> int:
         fault_step_deadline_s=args.step_deadline,
         journal_path=args.journal,
         journal_strict=args.journal_strict,
+        timeseries=args.timeseries_interval > 0,
+        timeseries_interval_s=args.timeseries_interval or 1.0,
+        timeseries_capacity=args.timeseries_capacity,
     )
     n_replicas = max(1, args.replicas)
     engines = []
@@ -521,6 +524,7 @@ def cmd_serve(args) -> int:
             print(f"[serve] journal {rep_cfg.journal_path}: recovered "
                   f"{len(resumed)} in-flight request(s)", file=sys.stderr)
         engines.append(eng)
+    router = None
     if n_replicas > 1:
         from solvingpapers_tpu.serve.fleet import FleetRouter
 
@@ -551,6 +555,21 @@ def cmd_serve(args) -> int:
         print("[serve] shutting down: draining streams, closing engine",
               file=sys.stderr)
         server.close()
+        if args.trace_out:
+            # export AFTER close so the drain/shutdown spans make the
+            # file; the recorders outlive their engines
+            try:
+                if router is not None:
+                    router.export_chrome_fleet(args.trace_out)
+                elif engines[0].trace is not None:
+                    engines[0].trace.export_chrome(args.trace_out)
+                else:
+                    raise ValueError("tracing is off — pass --trace")
+                print(f"[serve] trace -> {args.trace_out}",
+                      file=sys.stderr)
+            except ValueError as e:
+                print(f"[serve] --trace-out skipped: {e}",
+                      file=sys.stderr)
     return 0
 
 
@@ -696,6 +715,7 @@ def cmd_serve_bench(args) -> int:
             seed=args.seed,
             status_port=args.status_port,
             status_hold_s=args.status_hold_s,
+            trace_out=args.trace_out if args.trace else None,
         )
     elif args.journal:
         result = run_journal_bench(
@@ -875,6 +895,12 @@ def cmd_trace_summary(args) -> int:
         )
         return 2
     except (ValueError, TypeError, AttributeError, KeyError) as e:
+        if isinstance(e, ValueError) and "partial fleet export" in str(e):
+            # the stitcher's own diagnosis is the clearest message we
+            # could print — a truncated fleet file must not masquerade
+            # as a generic parse failure
+            print(f"{args.trace}: {e}", file=sys.stderr)
+            return 2
         print(
             f"{args.trace} does not parse as a Chrome trace-event JSON "
             f"({type(e).__name__}: {e}) — expected the flight recorder's "
@@ -884,6 +910,16 @@ def cmd_trace_summary(args) -> int:
         return 2
     except OSError as e:
         print(f"cannot read {args.trace}: {e}", file=sys.stderr)
+        return 2
+    if getattr(args, "fleet", False) and "fleet" not in summary:
+        print(
+            f"{args.trace} holds no fleet events: --fleet expects the "
+            "stitched export (FleetRouter.export_chrome_fleet, "
+            "`serve --replicas N --trace --trace-out`, or "
+            "`serve-bench --fleet --trace-out`); this looks like a "
+            "single-engine trace — rerun without --fleet",
+            file=sys.stderr,
+        )
         return 2
     if summary["n_requests"] or summary["rejected"]:
         print(format_summary(summary, top=args.top))
@@ -1412,6 +1448,22 @@ def main(argv=None) -> int:
                             "HTTP accept/parse/handoff/drain spans join "
                             "engine lifecycle spans per request; "
                             "GET /v1/requests/<id> works either way")
+    p_srv.add_argument("--trace-out", default=None, metavar="PATH",
+                       help="[--trace] on shutdown write the Chrome "
+                            "trace-event JSON here — with --replicas > 1 "
+                            "the STITCHED fleet export (router + every "
+                            "replica as its own Perfetto process, flows "
+                            "following requests across reroutes and "
+                            "migrations), the single-engine export "
+                            "otherwise; feed `cli trace-summary --fleet`")
+    p_srv.add_argument("--timeseries-interval", type=float, default=1.0,
+                       help="rolling time-series snapshot cadence in "
+                            "seconds (ServeConfig.timeseries_interval_s; "
+                            "0 disables the store and /timeseriesz)")
+    p_srv.add_argument("--timeseries-capacity", type=int, default=120,
+                       help="time-series ring capacity in windows — the "
+                            "retrospective spans capacity x interval "
+                            "seconds at O(capacity x series) memory")
     p_srv.add_argument("--seed", type=int, default=0)
 
     p_tsum = sub.add_parser("trace-summary")
@@ -1422,6 +1474,14 @@ def main(argv=None) -> int:
                              "TrainConfig.trace_path)")
     p_tsum.add_argument("--top", type=int, default=5,
                         help="how many slowest requests to print")
+    p_tsum.add_argument("--fleet", action="store_true",
+                        help="require the stitched fleet section: exit 2 "
+                             "with a clear message when the trace holds "
+                             "no fleet events (a single-engine export) "
+                             "— a manifest that declares replicas the "
+                             "file is missing (truncated/partial "
+                             "export) is exit 2 with or without this "
+                             "flag")
 
     p_eval = sub.add_parser("eval")
     _add_common(p_eval)

@@ -21,6 +21,10 @@ first-line inspection surface with zero dependencies:
                registry, memory ledger, mesh observatory (collective
                ledger + pipeline-bubble report) — whatever the owner's
                `statusz_fn` assembles
+    /timeseriesz  the rolling in-process time-series ring
+               (metrics/timeseries.TimeSeriesStore.doc()) as JSON —
+               present iff the owner bound a `timeseries_fn`, 404
+               otherwise
 
 `StatusServer` is a `ThreadingHTTPServer` on a daemon thread bound to
 127.0.0.1 by default (inspection surface, not an API — front it with a
@@ -72,10 +76,15 @@ class StatusServer:
         port: int = 0,
         prefix: str = "",
         health_fn: Callable[[], str] | None = None,
+        timeseries_fn: Callable[[], dict] | None = None,
     ):
         self.statusz_fn = statusz_fn
         self.metrics_fn = metrics_fn
         self.prefix = prefix
+        # timeseries_fn() -> TimeSeriesStore.doc(): the rolling
+        # retrospective served as /timeseriesz JSON; None (an owner
+        # without a store) keeps the endpoint a 404
+        self.timeseries_fn = timeseries_fn
         # health_fn() -> "healthy" | "degraded" | "unhealthy": /healthz
         # answers 503 for "unhealthy" (a draining engine must fall out
         # of its load balancer), 200 otherwise — "degraded" keeps the
@@ -120,11 +129,26 @@ class StatusServer:
                             + "\n",
                             "application/json",
                         )
+                    elif path == "/timeseriesz":
+                        if server.timeseries_fn is None:
+                            self._send(
+                                404,
+                                "no time-series store (run with "
+                                "timeseries enabled)\n",
+                                "text/plain",
+                            )
+                        else:
+                            self._send(
+                                200,
+                                json.dumps(server.timeseries_fn(),
+                                           default=str) + "\n",
+                                "application/json",
+                            )
                     else:
                         self._send(
                             404,
                             "not found — try /healthz, /metrics, "
-                            "/statusz\n",
+                            "/statusz, /timeseriesz\n",
                             "text/plain",
                         )
                 except BrokenPipeError:  # client went away mid-write

@@ -560,6 +560,17 @@ class ApiServer:
                     # occupancy/health/rung table + routing counters
                     doc["fleet"] = self.router.statusz()
                 self._send_json(h, 200, doc)
+            elif path == "/timeseriesz":
+                # the rolling retrospective: per-replica docs in fleet
+                # mode, the single engine's doc otherwise; 404 when the
+                # owner runs without a store (timeseries=False)
+                if self.router is not None:
+                    self._send_json(h, 200, self.router.timeseriesz())
+                elif getattr(self.engine, "timeseries", None) is not None:
+                    self._send_json(h, 200, self.engine.timeseries.doc())
+                else:
+                    self._send(h, 404, "no time-series store (run with "
+                               "timeseries enabled)\n", "text/plain")
             elif path == "/v1/models":
                 self._send_json(h, 200, {
                     "object": "list",
@@ -641,31 +652,83 @@ class ApiServer:
             },
         }
 
+    @staticmethod
+    def _hop_phases(req) -> dict[str, float]:
+        """One migration hop's engine phases from its Request stamps:
+        queue / prefill / decode up to ITS finish (a migrated husk
+        finishes "migrated" at the drain, so its intervals are closed
+        — the trail's partition stays exact across the hop). A hop
+        admitted but frozen before its first token spent its whole
+        admitted life in prefill."""
+        ph: dict[str, float] = {}
+        if req.admit_time is not None:
+            ph["queue"] = req.admit_time - req.submit_time
+            if req.first_token_time is not None:
+                ph["prefill"] = req.first_token_time - req.admit_time
+                if req.finish_time is not None:
+                    ph["decode"] = req.finish_time - req.first_token_time
+            elif req.finish_time is not None:
+                ph["prefill"] = req.finish_time - req.admit_time
+        elif req.finish_time is not None:
+            ph["queue"] = req.finish_time - req.submit_time
+        return ph
+
     def _assemble_timeline(self, rec: dict) -> dict:
         """One JSON timeline from the HTTP record + the engine Request's
         own lifecycle timestamps. Phases are adjacent intervals —
-        accept -> parse -> queue_handoff -> queue -> prefill -> decode ->
-        sse_drain — so their sum equals t_done - t_accept (the server-
-        observed e2e wall) to the clock's resolution; in-flight requests
-        report the phases they have reached so far."""
+        accept -> parse -> [route] -> queue_handoff -> queue -> prefill
+        -> decode -> [migrate -> peer_queue -> peer_prefill ->
+        peer_decode ...] -> sse_drain — so their sum equals t_done -
+        t_accept (the server-observed e2e wall) to the clock's
+        resolution; in-flight requests report the phases they have
+        reached so far.
+
+        Fleet: `route` is the router's ranking+retry wall
+        (`Request.fleet_route_s`), carved out of the handoff window it
+        happens inside so the partition is preserved; after a drain
+        migration the trail keeps EVERY hop — the original replica's
+        phases up to its "migrated" finish (the husks `rec["hops"]`
+        preserved before the front door swapped in each successor),
+        the `migrate` gap (freeze -> adoption on the peer), then the
+        adopting replica's phases as peer_*."""
         req = rec["req"]
+        hops = rec.get("hops") or []
+        chain = [hp["req"] for hp in hops] + [req]
+        req0 = chain[0]
         cfg = self.engine.config
         phases: dict[str, float] = {
             "accept": rec["t_body"] - rec["t_accept"],
             "parse": rec["t_parsed"] - rec["t_body"],
-            "queue_handoff": max(req.submit_time - rec["t_parsed"], 0.0),
         }
-        if req.admit_time is not None:
-            phases["queue"] = req.admit_time - req.submit_time
-            if req.first_token_time is not None:
-                phases["prefill"] = req.first_token_time - req.admit_time
-                if req.finish_time is not None:
-                    phases["decode"] = (req.finish_time
-                                        - req.first_token_time)
-        elif req.finish_time is not None:
-            # never admitted (cancel/timeout in the queue, or rejected):
-            # its whole engine life was queue time
-            phases["queue"] = req.finish_time - req.submit_time
+        handoff = max(req0.submit_time - rec["t_parsed"], 0.0)
+        route_s = min(max(getattr(req0, "fleet_route_s", 0.0), 0.0),
+                      handoff)
+        if route_s > 0:
+            phases["route"] = route_s
+        phases["queue_handoff"] = handoff - route_s
+        if not hops:
+            if req.admit_time is not None:
+                phases["queue"] = req.admit_time - req.submit_time
+                if req.first_token_time is not None:
+                    phases["prefill"] = (req.first_token_time
+                                         - req.admit_time)
+                    if req.finish_time is not None:
+                        phases["decode"] = (req.finish_time
+                                            - req.first_token_time)
+            elif req.finish_time is not None:
+                # never admitted (cancel/timeout in the queue, or
+                # rejected): its whole engine life was queue time
+                phases["queue"] = req.finish_time - req.submit_time
+        else:
+            phases.update(self._hop_phases(req0))
+            for prev, nxt in zip(chain, chain[1:]):
+                if prev.finish_time is not None:
+                    phases["migrate"] = (
+                        phases.get("migrate", 0.0)
+                        + max(nxt.submit_time - prev.finish_time, 0.0))
+                for k, v in self._hop_phases(nxt).items():
+                    key = f"peer_{k}"
+                    phases[key] = phases.get(key, 0.0) + v
         if rec["t_done"] is not None and req.finish_time is not None:
             phases["sse_drain"] = max(rec["t_done"] - req.finish_time, 0.0)
         phases = {k: round(v, 6) for k, v in phases.items()}
@@ -707,6 +770,26 @@ class ApiServer:
             # in flight (or excluded finish): class known, verdict not
             doc["slo"] = {"class": self.engine._slo.classify(req),
                           "attained": None}
+        if self.router is not None:
+            # the fleet trail facts: which replica served (or is
+            # serving) the request, how many peers refused before one
+            # took it, and — after a drain migration — every hop the
+            # stream took (the husks' engine ids + finish reasons plus
+            # the live successor), matching the phases' migrate/peer_*
+            # entries above
+            doc["fleet"] = {
+                "replica": rec.get("replica"),
+                "reroutes": int(rec.get("reroutes") or 0),
+                "migrated": bool(hops),
+                "hops": [
+                    {"replica": hp.get("replica"),
+                     "engine_req": hp["req"].id,
+                     "finish_reason": hp["req"].finish_reason}
+                    for hp in hops
+                ] + [{"replica": rec.get("replica"),
+                      "engine_req": req.id,
+                      "finish_reason": req.finish_reason}],
+            }
         return doc
 
     def _post(self, h) -> None:
@@ -786,7 +869,8 @@ class ApiServer:
                 status=409, code="resume_offset_beyond_committed",
             )
 
-    def _sse_open(self, h, trace_id: str, replica: str | None = None):
+    def _sse_open(self, h, trace_id: str, replica: str | None = None,
+                  reroutes: int = 0):
         """Send the SSE response headers and return THE event writer
         (one framing implementation for live streams, re-attached
         resumes and journal-only replays): each chunk is an optional
@@ -802,6 +886,10 @@ class ApiServer:
         h.send_header("X-Request-Id", trace_id)
         if replica is not None:
             h.send_header("X-Replica-Id", replica)
+        if reroutes:
+            # submit was retried on a peer after ranked replicas
+            # refused — reroute visibility alongside X-Replica-Id
+            h.send_header("X-Fleet-Reroutes", str(reroutes))
         h.end_headers()
 
         def event(obj, eid: int | None = None) -> None:
@@ -878,13 +966,19 @@ class ApiServer:
             # still decoding) is the stream the cursor belongs to
             adopted = self._find_recovered(rid)
             if adopted is not None and adopted is not req:
-                req = adopted
                 if rec is not None:
-                    rec["req"] = req
+                    # keep the husk: its phases are the original
+                    # replica's leg of the request trail
+                    rec.setdefault("hops", []).append(
+                        {"req": req, "replica": rec.get("replica")})
+                    rec["req"] = adopted
+                req = adopted
         if req is not None:
             self._check_resume_offset(offset, len(req.tokens), rid)
             owner = (self.router.owner(rid)
                      if self.router is not None else None)
+            if rec is not None and owner is not None:
+                rec["replica"] = owner.rid
             new_rec = {
                 "trace_id": rid, "req": req, "chat": chat, "stream": True,
                 "t_accept": smetrics.now(), "t_body": smetrics.now(),
@@ -1087,6 +1181,14 @@ class ApiServer:
             # which replica admitted it (fleet mode) — the
             # X-Replica-Id response header, for debugging routing
             "replica": replica.rid if replica is not None else None,
+            # how many ranked peers refused before one admitted it
+            # (router retry-on-full) — the X-Fleet-Reroutes header
+            "reroutes": int(getattr(req, "fleet_reroutes", 0) or 0),
+            # migration hops: each drain that moved this stream swaps
+            # rec["req"] to the adopted successor; the husk is kept
+            # here FIRST, so /v1/requests/<id> can stitch the full
+            # trail (original replica's phases + migrate gap + peer's)
+            "hops": [],
         }
         with self._timeline_lock:
             self._timelines[trace_id] = rec
@@ -1137,6 +1239,8 @@ class ApiServer:
             headers = {**self._retry_headers(), "X-Request-Id": trace_id}
             if rec["replica"] is not None:
                 headers["X-Replica-Id"] = rec["replica"]
+            if rec["reroutes"]:
+                headers["X-Fleet-Reroutes"] = str(rec["reroutes"])
             self._send_json(h, 503, err.body(), headers)
             return
         rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
@@ -1198,7 +1302,8 @@ class ApiServer:
         sse_write fault site) is `_sse_open`'s — one writer for live
         streams and journal replays."""
         event = self._sse_open(h, rec["trace_id"],
-                               replica=rec.get("replica"))
+                               replica=rec.get("replica"),
+                               reroutes=int(rec.get("reroutes") or 0))
         self._bump_active(1)
         emitted = start
         events = 0
@@ -1352,8 +1457,22 @@ class ApiServer:
                 # waiting (its committed prefix is this one's; SSE
                 # clients get the reconnect protocol instead)
                 nxt = self._find_recovered(req.trace_id)
+                if nxt is None:
+                    # the drain force-finishes the husk BEFORE the peer
+                    # adopts it, so this thread can wake mid-migration:
+                    # give the in-flight adoption a bounded window to
+                    # land before honestly reporting the husk
+                    deadline = time.monotonic() + 5.0
+                    while nxt is None and time.monotonic() < deadline:
+                        time.sleep(0.002)
+                        nxt = self._find_recovered(req.trace_id)
                 if nxt is None or nxt is req:
                     break  # adoption failed: report the husk honestly
+                # keep the husk: its queue/prefill/decode up to the
+                # "migrated" finish are the original replica's leg of
+                # the request trail (/v1/requests/<id>)
+                rec.setdefault("hops", []).append(
+                    {"req": req, "replica": rec.get("replica")})
                 req = nxt
                 rec["req"] = req
                 owner = self.router.owner(req.trace_id)
@@ -1368,6 +1487,8 @@ class ApiServer:
             headers = {"X-Request-Id": rec["trace_id"]}
             if rec.get("replica") is not None:
                 headers["X-Replica-Id"] = rec["replica"]
+            if rec.get("reroutes"):
+                headers["X-Fleet-Reroutes"] = str(rec["reroutes"])
             if req.finish_reason == "error":
                 # no bytes have gone out on a blocking response: the
                 # honest status is a 500 with the structured envelope,

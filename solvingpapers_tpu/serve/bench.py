@@ -2532,6 +2532,45 @@ def _run_fleet_arm(model, params, extra, requests, serve_cfg, max_new,
     return router, handles, makespan
 
 
+def _migrated_trail_fields(handles, successors) -> dict:
+    """Check the fleet trail invariant on every migrated stream: phase
+    walls re-derived from the request stamps — route + queue + prefill
+    + decode on the drained replica, the migration hop, then peer
+    queue/prefill/decode — each clamped non-negative exactly like the
+    API's `/v1/requests/<id>` assembler, must PARTITION the
+    route-start -> peer-finish e2e wall. With the router and every
+    engine stamping from the same `metrics.now` clock no clamp ever
+    fires and the error is zero; cross-replica clock skew or misordered
+    migration stamps surface here as nonzero ``trail_partition_err_pct``
+    (the acceptance budget is 5, matching the CI smoke's HTTP-side
+    check)."""
+    worst = 0.0
+    n = 0
+    for h in handles:
+        succ = successors.get(h.trace_id)
+        if succ is None:
+            continue
+        n += 1
+        route_s = max(getattr(h, "fleet_route_s", 0.0) or 0.0, 0.0)
+        phases = [route_s]
+        for r in (h, succ):
+            admit = (r.admit_time if r.admit_time is not None
+                     else r.submit_time)
+            first = (r.first_token_time if r.first_token_time is not None
+                     else r.finish_time)
+            phases.append(max(admit - r.submit_time, 0.0))
+            phases.append(max(first - admit, 0.0))
+            phases.append(max(r.finish_time - first, 0.0))
+        phases.append(max(succ.submit_time - h.finish_time, 0.0))
+        e2e = max(succ.finish_time - h.submit_time + route_s, 1e-9)
+        worst = max(worst, abs(sum(phases) - e2e) / e2e * 100.0)
+    return {
+        "trail_partition_ok": n > 0 and worst <= 5.0,
+        "trail_partition_err_pct": round(worst, 3),
+        "trail_partition_streams": n,
+    }
+
+
 def run_fleet_bench(
     config: str = "llama3_shakespeare",
     n_requests: int = 32,
@@ -2546,10 +2585,11 @@ def run_fleet_bench(
     journal_dir: str | None = None,
     status_port: int | None = None,
     status_hold_s: float = 0.0,
+    trace_out: str | None = None,
 ) -> dict:
     """`cli serve-bench --fleet`: the fleet-serving workload.
 
-    Two arms, one entry:
+    Three arms, one entry:
 
     * router overhead — ABBA-paired req/s of the Poisson trace through
       a ONE-replica `FleetRouter` (manually stepped, no journal) vs the
@@ -2557,6 +2597,11 @@ def run_fleet_bench(
       routing tax (candidate ranking, the locked prefix probe, owner
       bookkeeping, per-step lock traffic) with the engine workload held
       exactly like-for-like (`router_overhead_pct`; budget <= 5).
+    * fleet trace overhead — the same pairing with a ONE-replica fleet
+      on BOTH sides, tracing on vs off: the whole fabric's tax (router
+      recorder + route-decision spans + per-engine recorders) with the
+      routing work held like-for-like (`fleet_trace_overhead_pct`;
+      budget <= 2, same as the single-engine flight recorder's).
     * drain migration — every request submitted up front through an
       `n_replicas`-way JOURNALED fleet (greedy + seeded stochastic
       sampling mix); after a third of the requests finish, replica r0
@@ -2571,6 +2616,13 @@ def run_fleet_bench(
       (routed anywhere, migrated or not); ``migration_wall_s`` is the
       admission-gate close -> last adoption wall; ``zero_leak`` holds
       on BOTH the drained replica and the adopter after the drain.
+      The drain fleet runs TRACED, so with `trace_out` set the stitched
+      fleet trace (router + every replica, one Perfetto process each)
+      is exported for `cli trace-summary --fleet`; and every migrated
+      stream's trail is re-derived from its request stamps and checked
+      against the fleet trail invariant — phase walls partition the
+      route-start -> peer-finish e2e wall (``trail_partition_ok``,
+      worst ``trail_partition_err_pct`` <= 5).
     """
     model, params, extra, vocab = build_serve_model(config)
     requests = synthetic_requests(
@@ -2631,14 +2683,34 @@ def run_fleet_bench(
     fleet_rps = n_requests / (sum(mk_fleet) / len(mk_fleet))
     bare_rps = n_requests / (sum(mk_bare) / len(mk_bare))
 
-    # ---- drain-migration arm: journaled n_replicas-way fleet
+    # ---- fleet trace overhead arm: traced vs untraced 1-replica fleet,
+    # same ABBA discipline — both sides pay the router, so the delta is
+    # the fabric alone (router recorder + route spans + engine recorders)
+    tcfg = dataclasses.replace(base_cfg, trace=True)
+    mk_traced: list = []
+    mk_plain: list = []
+    for rep_i in range(reps):
+        order = (("traced", "plain") if rep_i % 2 == 0
+                 else ("plain", "traced"))
+        for arm in order:
+            _, _, mk = _run_fleet_arm(
+                model, params, extra, requests,
+                tcfg if arm == "traced" else base_cfg, max_new,
+                n_replicas=1,
+            )
+            (mk_traced if arm == "traced" else mk_plain).append(mk)
+    traced_rps = n_requests / (sum(mk_traced) / len(mk_traced))
+    plain_rps = n_requests / (sum(mk_plain) / len(mk_plain))
+
+    # ---- drain-migration arm: journaled n_replicas-way fleet, TRACED
+    # (the stitched-export + trail-invariant surface under test)
     from solvingpapers_tpu.serve.fleet import FleetRouter
 
     engines = [
         ServeEngine(
             model, params,
             dataclasses.replace(
-                base_cfg,
+                base_cfg, trace=True,
                 journal_path=os.path.join(jdir, f"migrate_r{i}.jsonl")),
             extra_variables=extra,
         )
@@ -2693,6 +2765,11 @@ def run_fleet_bench(
     leak0 = _zero_leak_fields(router.replica("r0").engine)
     leak_peers = [_zero_leak_fields(r.engine)
                   for r in router.replicas if r.rid != "r0"]
+    trail_fields = _migrated_trail_fields(handles, successors)
+    trace_fields = {}
+    if trace_out:
+        router.export_chrome_fleet(trace_out)
+        trace_fields["fleet_trace_out"] = trace_out
 
     if status_hold_s > 0 and probe_eng is not None:
         time.sleep(status_hold_s)
@@ -2722,6 +2799,10 @@ def run_fleet_bench(
                 (1.0 - fleet_rps / bare_rps) * 100.0, 2),
             "fleet_requests_per_sec": round(fleet_rps, 2),
             "bare_requests_per_sec": round(bare_rps, 2),
+            "fleet_trace_overhead_pct": round(
+                (1.0 - traced_rps / plain_rps) * 100.0, 2),
+            "fleet_traced_requests_per_sec": round(traced_rps, 2),
+            "fleet_untraced_requests_per_sec": round(plain_rps, 2),
             "live_at_drain": live_at_drain,
             "migrated_streams": len(report.migrated),
             "migration_errors": len(report.errors),
@@ -2733,6 +2814,8 @@ def run_fleet_bench(
             "zero_leak": (leak0["zero_leak"]
                           and all(f["zero_leak"] for f in leak_peers)),
             "routing": {k: v for k, v in router.stats.items()},
+            **trail_fields,
+            **trace_fields,
             **_kv_entry_fields(ref_eng),
             **probe_fields,
         },

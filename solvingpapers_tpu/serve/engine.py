@@ -507,6 +507,17 @@ class ServeConfig:
     trace_dump_events: int = 256
     trace_slow_step_factor: float = 10.0
     trace_reject_burst: int = 8
+    # rolling in-process time series (metrics/timeseries.py): a fixed-
+    # budget ring of periodic metric samples (gauges + per-window
+    # counter/histogram deltas), sampled opportunistically from step()
+    # — no timer thread. Served as /timeseriesz JSON + /statusz
+    # sparklines and attached to every anomaly dump, so a quarantine/
+    # degradation/drain artifact carries the preceding N-window of
+    # engine state. On by default: capacity x interval bounds memory
+    # at O(capacity x n_series) floats (~2 minutes at the defaults).
+    timeseries: bool = True
+    timeseries_capacity: int = 120
+    timeseries_interval_s: float = 1.0
     # jax.profiler window over engine steps [start, stop)
     profile_dir: str | None = None
     profile_steps: tuple[int, int] = (10, 15)
@@ -1418,6 +1429,18 @@ class ServeEngine:
         # trace-summary phase sums equal measured TTFT + decode wall.
         self.trace = None
         self._mon = None
+        # rolling retrospective (metrics/timeseries.py): sampled from
+        # step() when the interval elapses — created BEFORE the anomaly
+        # monitor so every dump can carry the preceding window
+        self.timeseries = None
+        if cfg.timeseries:
+            from solvingpapers_tpu.metrics.timeseries import TimeSeriesStore
+
+            self.timeseries = TimeSeriesStore(
+                capacity=cfg.timeseries_capacity,
+                interval_s=cfg.timeseries_interval_s,
+                clock=smetrics.now,
+            )
         if cfg.trace:
             from solvingpapers_tpu.metrics.trace import (
                 AnomalyMonitor,
@@ -1434,6 +1457,9 @@ class ServeEngine:
                     last_n=cfg.trace_dump_events,
                     slow_step_factor=cfg.trace_slow_step_factor,
                     reject_burst=cfg.trace_reject_burst,
+                    timeseries_fn=(self.timeseries.doc
+                                   if self.timeseries is not None
+                                   else None),
                 )
         elif cfg.trace_dump_path:
             raise ValueError(
@@ -1813,6 +1839,8 @@ class ServeEngine:
                 host=cfg.status_host, port=cfg.status_port,
                 # /healthz answers 503 while the engine is unhealthy
                 health_fn=lambda: self.health,
+                timeseries_fn=(self.timeseries.doc
+                               if self.timeseries is not None else None),
             )
 
     # ------------------------------------------------------------- submit
@@ -1998,8 +2026,15 @@ class ServeEngine:
             # "accepted work survives", not "every knock on the door")
             self._journal_submit(req)
             if self.trace is not None:
+                # rid: the client trace id rides the submit instant so
+                # the stitched fleet export can join this replica's
+                # per-request flow to the router's route/migrate spans
+                # (absent on direct submits — no key, not a null)
+                rid_arg = ({"rid": req.trace_id}
+                           if req.trace_id is not None else {})
                 self.trace.instant("submit", "request", "queue", req=req.id,
-                                   ts=req.submit_time, prompt_len=prompt.size)
+                                   ts=req.submit_time, prompt_len=prompt.size,
+                                   **rid_arg)
                 if self._mon is not None:
                     self._mon.observe_accept()
         return req
@@ -2050,6 +2085,7 @@ class ServeEngine:
                 # tight external drive loop must not busy-spin)
                 time.sleep(min(0.005, self._recover_at - now))
                 self._step_idx += 1
+                self._timeseries_tick()
                 return []
             self._recover()
         t0 = smetrics.now()
@@ -2073,7 +2109,39 @@ class ServeEngine:
                 self._note_recovery()
         if self._ladder is not None:
             self._ladder_step()
+        self._timeseries_tick()
         return finished
+
+    def _timeseries_tick(self) -> None:
+        """Opportunistic rolling-retrospective sample: append one
+        window of load gauges + per-window counter/histogram deltas
+        when `timeseries_interval_s` has elapsed. Rides step() (no
+        timer thread), so an idle engine stops producing windows —
+        the gap in the ring IS the record of the idle stretch."""
+        ts = self.timeseries
+        if ts is None or not ts.due():
+            return
+        snap = self.metrics.snapshot()
+        gauges = {
+            "occupancy": round(self.pool.occupancy, 4),
+            "queue_depth": float(len(self.scheduler)),
+            "n_free": float(self.pool.n_free),
+        }
+        if getattr(self.pool, "page_budget", 0):
+            gauges["pages_free"] = float(self.pool.pages_free)
+        cumulative = {
+            k: float(snap[k]) for k in (
+                "serve/tokens_out", "serve/tokens_prefilled",
+                "serve/requests_finished", "serve/requests_rejected",
+                "serve/steps",
+            ) if k in snap
+        }
+        # histogram deltas: count/sum increments per window — enough
+        # to recover windowed mean latency without O(n) percentiles
+        for name, h in self.metrics._latency_hists():
+            cumulative[f"serve/{name}_count"] = float(h.count)
+            cumulative[f"serve/{name}_sum"] = float(h.sum)
+        ts.sample(gauges, cumulative)
 
     def _step_inner(self) -> list[Request]:
         if not self._profile_done:
@@ -2919,6 +2987,15 @@ class ServeEngine:
             d["compile"] = self.registry.snapshot()
         if self.ledger is not None:
             d["mem"] = self.ledger.snapshot()
+        if self.timeseries is not None and len(self.timeseries):
+            # the human rendering of the rolling retrospective: one
+            # sparkline per series (right edge = now); the raw rows
+            # live on /timeseriesz
+            d["timeseries"] = {
+                "interval_s": self.timeseries.interval_s,
+                "windows": len(self.timeseries),
+                "sparklines": self.timeseries.sparklines(),
+            }
         return d
 
     def close(self, drain_s: float = 0.0) -> None:

@@ -76,6 +76,8 @@ adopting replica owns the latency outcome.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -83,6 +85,9 @@ from collections import OrderedDict
 import numpy as np
 
 from solvingpapers_tpu.metrics.hist import LogHistogram
+from solvingpapers_tpu.metrics.trace import (FlightRecorder,
+                                             fleet_events_to_chrome)
+from solvingpapers_tpu.serve import metrics as smetrics
 from solvingpapers_tpu.serve.api import EngineLoop
 
 __all__ = ["FleetRouter", "MigrationReport", "Replica"]
@@ -170,7 +175,8 @@ class FleetRouter:
     owner_cap = 4096
 
     def __init__(self, engines, *, replica_ids=None,
-                 burn_threshold: float = 1.0, start: bool = True):
+                 burn_threshold: float = 1.0, start: bool = True,
+                 stale_shard_cutoff_s: float = 300.0):
         engines = list(engines)
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
@@ -194,6 +200,12 @@ class FleetRouter:
         # the burning class (1.0 = the error budget is fully consumed
         # over the window); >= everything disables the gate
         self.burn_threshold = burn_threshold
+        # a non-admitting replica whose metrics shard has seen no
+        # traffic for longer than this is EXCLUDED from the /metrics
+        # N-way histogram merge (its numbers describe a rotation it is
+        # no longer part of); the labeled per-replica set still serves
+        # the shard, tagged with serve/shard_age_s + serve/shard_stale
+        self.stale_shard_cutoff_s = stale_shard_cutoff_s
         self._lock = threading.Lock()
         self._owners: OrderedDict[str, Replica] = OrderedDict()
         self.stats = {
@@ -201,6 +213,20 @@ class FleetRouter:
             "rerouted_full": 0, "drains": 0, "migrated_streams": 0,
             "migration_errors": 0,
         }
+        # the router's own flight recorder: route-decision spans with
+        # per-candidate scores, reroute attempts, drain/migration hops
+        # — created iff any replica records (same opt-in as the
+        # engines', on the SAME patchable clock, so the stitched fleet
+        # export aligns router and replica timelines on one time base)
+        self.trace: FlightRecorder | None = None
+        traced = [e for e in engines
+                  if getattr(e, "trace", None) is not None]
+        if traced:
+            self.trace = FlightRecorder(
+                capacity=getattr(traced[0].config, "trace_capacity",
+                                 65536),
+                clock=smetrics.now,
+            )
 
     # ------------------------------------------------------------ routing
 
@@ -212,14 +238,21 @@ class FleetRouter:
                 f"unknown replica {rid!r} (have "
                 f"{sorted(self._by_id)})") from None
 
-    def _rank(self, prompt: np.ndarray, slo: str | None) -> list[Replica]:
+    def _rank(self, prompt: np.ndarray, slo: str | None
+              ) -> tuple[list[Replica], list[dict]]:
         """Admitting replicas, best first: health gate -> per-class
         burn gate -> prefix affinity -> least-loaded (free fraction of
-        the scarcest resource, then queue room, then replica id)."""
+        the scarcest resource, then queue room, then replica id).
+        Returns ``(ranked, scores)``: one score row per replica (the
+        route-decision evidence the router's trace span records) —
+        ranked candidates carry the signals the sort used, excluded
+        replicas carry the gate that dropped them."""
+        excluded: dict[str, str] = {
+            r.rid: "not_admitting"
+            for r in self.replicas if not r.admitting
+        }
         cands = [r for r in self.replicas if r.admitting]
-        if not cands:
-            return []
-        if slo is not None and len(cands) > 1:
+        if cands and slo is not None and len(cands) > 1:
             cool = [
                 r for r in cands
                 if r.engine._slo is None
@@ -229,6 +262,9 @@ class FleetRouter:
             if cool and len(cool) < len(cands):
                 with self._lock:
                     self.stats["burn_avoided"] += 1
+                for r in cands:
+                    if r not in cool:
+                        excluded[r.rid] = "burn"
                 cands = cool
         matches = {r.rid: r.probe(prompt) for r in cands}
         best = max(matches.values(), default=0)
@@ -242,12 +278,22 @@ class FleetRouter:
             return (-matches[r.rid], -r.free_fraction(),
                     -r.engine.scheduler.capacity_left, r.rid)
 
-        return sorted(cands, key=key)
+        ranked = sorted(cands, key=key)
+        scores = [
+            {"replica": r.rid, "match": matches[r.rid],
+             "free": round(r.free_fraction(), 4),
+             "queue_room": r.engine.scheduler.capacity_left}
+            for r in ranked
+        ]
+        scores += [{"replica": rid, "excluded": why}
+                   for rid, why in sorted(excluded.items())]
+        return ranked, scores
 
     def route(self, prompt, slo: str | None = None) -> Replica | None:
         """The admission replica for `prompt` (None when nothing
         admits); `submit` is the same ranking with full-queue retry."""
-        ranked = self._rank(np.asarray(prompt, np.int32).reshape(-1), slo)
+        ranked, _ = self._rank(
+            np.asarray(prompt, np.int32).reshape(-1), slo)
         return ranked[0] if ranked else None
 
     def submit(self, prompt, *, max_new_tokens: int = 64, params=None,
@@ -261,13 +307,24 @@ class FleetRouter:
         room: the router retries down the ranked list and only surfaces
         the LAST rejection when every candidate refused — the
         fleet-wide 503 fix. ValueError (a malformed request) propagates
-        immediately: it would fail identically everywhere."""
+        immediately: it would fail identically everywhere.
+
+        The accepted request carries the routing outcome as plain
+        attributes — ``fleet_reroutes`` (how many ranked peers refused
+        before this one took it; the ``X-Fleet-Reroutes`` header) and
+        ``fleet_route_s`` (ranking + retry wall, the trail's "route"
+        phase) — so the front door's request trail works with tracing
+        OFF; with the router recorder on, the same decision lands as a
+        ``route`` span (per-candidate scores in args) plus one
+        ``reroute`` instant per refusing peer."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         slo = getattr(params, "slo", None) if params is not None else None
-        ranked = self._rank(prompt, slo)
+        t0 = smetrics.now()
+        ranked, scores = self._rank(prompt, slo)
         if not ranked:
             return None, None
         last = None
+        refusals: list[tuple[float, str]] = []  # (ts, rid) per refusal
         for i, rep in enumerate(ranked):
             try:
                 req = rep.loop.submit(
@@ -278,6 +335,7 @@ class FleetRouter:
             except RuntimeError:
                 # the loop died between the ranking and the submit:
                 # treat like any other per-replica refusal
+                refusals.append((smetrics.now(), rep.rid))
                 continue
             if req.state != "rejected":
                 with self._lock:
@@ -285,8 +343,25 @@ class FleetRouter:
                     if i:
                         self.stats["rerouted_full"] += 1
                 self._remember(req.trace_id, rep)
+                dur = max(smetrics.now() - t0, 0.0)
+                req.fleet_reroutes = i
+                req.fleet_route_s = dur
+                if self.trace is not None:
+                    for ts, frm in refusals:
+                        self.trace.instant(
+                            "reroute", "fleet", "router", req=req.id,
+                            ts=ts, rid=req.trace_id, rejected_by=frm)
+                    self.trace.complete(
+                        "route", "fleet", "router", ts=t0, dur=dur,
+                        req=req.id, rid=req.trace_id, replica=rep.rid,
+                        attempts=i + 1, scores=scores)
                 return rep, req
+            refusals.append((smetrics.now(), rep.rid))
             last = (rep, req)
+        if self.trace is not None:
+            self.trace.instant(
+                "route_failed", "fleet", "router", ts=smetrics.now(),
+                attempts=len(ranked), scores=scores)
         if last is None:
             return None, None
         return last
@@ -365,23 +440,45 @@ class FleetRouter:
         ``replica="rN"``-labeled set per replica. Each replica's
         snapshot AND the merge of its live histograms happen under its
         step lock, so a histogram mid-`add` can never tear the merged
-        series (the merge itself is also copy-safe — hist.merge_from)."""
+        series (the merge itself is also copy-safe — hist.merge_from).
+
+        Staleness: a shard that stopped moving describes a rotation
+        the replica is no longer part of — silently merging it skews
+        the fleet quantiles toward history. Every labeled set carries
+        ``serve/shard_age_s`` (seconds since the shard last recorded)
+        and ``serve/shard_stale`` (1 when the replica is NOT admitting
+        and its age exceeds `stale_shard_cutoff_s`); stale shards are
+        SKIPPED by the histogram merge (tagged, not silently merged —
+        the labeled set still serves the frozen numbers) and counted
+        in ``fleet/stale_shards``."""
         merged: dict[str, LogHistogram] = {}
         per = []
         max_step = 0
+        stale_shards = 0
         for r in self.replicas:
-            def grab(eng=r.engine):
+            m = r.engine.metrics
+            ref = m._t_last if m._t_last is not None else m._t_first
+            age = (max(smetrics.now() - ref, 0.0)
+                   if ref is not None else 0.0)
+            stale = (not r.admitting
+                     and age > self.stale_shard_cutoff_s)
+            stale_shards += stale
+
+            def grab(eng=r.engine, stale=stale):
                 snap = eng.metrics.prom_snapshot()
-                for k, v in snap.items():
-                    if isinstance(v, LogHistogram):
-                        acc = merged.get(k)
-                        if acc is None:
-                            merged[k] = acc = LogHistogram(
-                                *v.layout[:2],
-                                buckets_per_decade=v.layout[2])
-                        acc.merge_from(v)
+                if not stale:
+                    for k, v in snap.items():
+                        if isinstance(v, LogHistogram):
+                            acc = merged.get(k)
+                            if acc is None:
+                                merged[k] = acc = LogHistogram(
+                                    *v.layout[:2],
+                                    buckets_per_decade=v.layout[2])
+                            acc.merge_from(v)
                 return eng._step_idx, snap
             step, snap = r.loop._locked(grab)
+            snap["serve/shard_age_s"] = round(age, 3)
+            snap["serve/shard_stale"] = float(stale)
             max_step = max(max_step, step)
             per.append((step, {"replica": r.rid}, snap))
         fleet = {
@@ -391,6 +488,7 @@ class FleetRouter:
             "fleet/draining": float(
                 sum(r.draining for r in self.replicas)),
             "fleet/capacity_left": float(self.capacity_left),
+            "fleet/stale_shards": float(stale_shards),
         }
         with self._lock:
             for k, v in self.stats.items():
@@ -430,6 +528,46 @@ class FleetRouter:
             "policy": {"burn_threshold": self.burn_threshold},
             "routing": routing,
         }
+
+    def timeseriesz(self) -> dict:
+        """The fleet ``/timeseriesz`` body: one rolling-retrospective
+        doc per replica that keeps one (`ServeConfig.timeseries`)."""
+        out = {}
+        for r in self.replicas:
+            store = getattr(r.engine, "timeseries", None)
+            if store is not None:
+                out[r.rid] = store.doc()
+        return {"replicas": out}
+
+    # ----------------------------------------------------- stitched export
+
+    def to_chrome_fleet(self) -> dict:
+        """ONE Chrome trace for the whole fleet: the router recorder
+        plus every replica recorder stitched process-per-replica
+        (metrics/trace.fleet_events_to_chrome — all recorders share
+        the engine clock, so one t0 aligns the sections; flows follow
+        each request across reroutes and migrations via the rid args
+        the router spans and engine submit instants carry)."""
+        sections = []
+        if self.trace is not None:
+            sections.append(("router", self.trace.events()))
+        for r in self.replicas:
+            rec = getattr(r.engine, "trace", None)
+            if rec is not None:
+                sections.append((r.rid, rec.events()))
+        if not sections:
+            raise ValueError(
+                "no recorders to stitch: run the replicas with "
+                "ServeConfig.trace=True")
+        return fleet_events_to_chrome(sections)
+
+    def export_chrome_fleet(self, path: str) -> str:
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_fleet(), f)
+        return path
 
     # ------------------------------------------------------------- drain
 
@@ -488,6 +626,7 @@ class FleetRouter:
                 f"no admitting peer to drain {rid!r} into — refusing "
                 "to drop its live streams")
         t0 = time.monotonic()
+        t_d0 = smetrics.now()  # trace time base (patchable in tests)
         rep.draining = True
 
         def freeze(eng=rep.engine):
@@ -502,6 +641,7 @@ class FleetRouter:
         entries = rep.loop._locked(freeze)
         migrated, errors, targets = [], [], {}
         for e in reversed(entries):  # newest-first: see the docstring
+            t_m0 = smetrics.now()
             slo = (e.params or {}).get("slo") if peer_slo_route else None
             target = self.route(np.asarray(e.prompt, np.int32), slo=slo)
             if target is None or target is rep:
@@ -517,11 +657,26 @@ class FleetRouter:
             self._remember(req.trace_id, target)
             targets[e.rid] = (target.rid, req.trace_id)
             migrated.append(req)
+            if self.trace is not None:
+                # the migration hop: freeze-to-adopt on the router's
+                # lane, carrying the rid so the stitched flow follows
+                # the stream from the drained replica to its peer
+                self.trace.complete(
+                    "migrate", "fleet", "router", ts=t_m0,
+                    dur=max(smetrics.now() - t_m0, 0.0), req=req.id,
+                    rid=req.trace_id, src=rid, dst=target.rid,
+                    old_rid=e.rid)
         migrated.reverse()  # report in arrival order
         with self._lock:
             self.stats["drains"] += 1
             self.stats["migrated_streams"] += len(migrated)
             self.stats["migration_errors"] += len(errors)
+        if self.trace is not None:
+            self.trace.complete(
+                "drain", "fleet", "router", ts=t_d0,
+                dur=max(smetrics.now() - t_d0, 0.0), replica=rid,
+                entries=len(entries), migrated=len(migrated),
+                errors=len(errors))
         return MigrationReport(
             replica=rid, entries=len(entries), migrated=migrated,
             targets=targets, errors=errors,

@@ -612,4 +612,181 @@ def test_http_blocking_request_rides_migration(fleet_server):
     assert doc["choices"][0]["finish_reason"] == "length"
     assert doc["choices"][0]["text"] == srv.decode(ref.tokens)
     assert doc["usage"]["completion_tokens"] == 40
+
+    # the stitched trail: GET /v1/requests/<id> keeps EVERY hop — the
+    # drained replica's husk plus the adopter — and its phase walls
+    # (migrate + peer_* included) still partition the server e2e wall
+    with urllib.request.urlopen(srv.url(f"/v1/requests/{rid}"),
+                                timeout=30) as r:
+        trail = json.loads(r.read())
+    fl = trail["fleet"]
+    assert fl["migrated"] is True and fl["replica"] == peer
+    assert len(fl["hops"]) == 2
+    assert fl["hops"][0]["replica"] == owner.rid
+    assert fl["hops"][0]["finish_reason"] == "migrated"
+    assert fl["hops"][1]["replica"] == peer
+    assert fl["hops"][1]["finish_reason"] == "length"
+    assert "migrate" in trail["phases"]
+    assert "peer_decode" in trail["phases"]
+    assert trail["phase_sum_s"] == pytest.approx(
+        trail["e2e_s"], rel=0.05, abs=1e-3)
     router.undrain(owner.rid)
+
+
+# ----------------------------------------------------- fleet trace fabric
+
+
+def test_router_trace_stamps_route_decisions():
+    """A traced fleet gives the ROUTER its own flight recorder: an
+    accepted submit stamps a `route` span carrying the per-candidate
+    score rows the ranking used, each full-queue refusal stamps a
+    `reroute` instant naming the replica that bounced the request, and
+    the request itself records `fleet_reroutes`/`fleet_route_s` (the
+    trail's route phase). An untraced fleet keeps the recorder None."""
+    router = _fleet(2, cfg_for=lambda i: _cfg(
+        trace=True, prefix_cache=True, max_waiting=2))
+    assert router.trace is not None
+    rng = np.random.default_rng(9)
+    stem = rng.integers(0, 64, size=32).astype(np.int32)
+    r0 = router.replica("r0")
+    r0.engine.submit(stem, max_new_tokens=4)
+    while r0.engine.has_work():
+        r0.engine.step()
+    for p in _prompts(2, seed=3):  # fill r0's waiting queue
+        assert r0.engine.submit(p, max_new_tokens=4).state != "rejected"
+    assert r0.engine.scheduler.capacity_left == 0
+    probe = np.concatenate([stem[:16],
+                            rng.integers(0, 64, 8).astype(np.int32)])
+    rep, req = router.submit(probe, max_new_tokens=4)
+    assert rep.rid == "r1" and req.state != "rejected"
+    assert req.fleet_reroutes == 1 and req.fleet_route_s >= 0.0
+
+    evs = router.trace.events()
+    (route,) = [e for e in evs if e.name == "route"]
+    assert route.cat == "fleet"
+    assert route.args["replica"] == "r1"
+    assert route.args["attempts"] == 2
+    assert route.args["rid"] == req.trace_id
+    rows = {s["replica"]: s for s in route.args["scores"]}
+    assert rows["r0"]["match"] > rows["r1"]["match"]  # affinity evidence
+    assert rows["r0"]["queue_room"] == 0
+    assert "free" in rows["r1"]
+    (reroute,) = [e for e in evs if e.name == "reroute"]
+    assert reroute.args["rejected_by"] == "r0"
+    assert reroute.args["rid"] == req.trace_id
+    assert _fleet(2).trace is None  # tracing off -> no router recorder
+    _drain_fleet(router)
+
+
+def test_prom_sets_tags_and_skips_stale_shards():
+    """A shard that stopped moving is TAGGED, not silently merged:
+    every labeled set carries `serve/shard_age_s` + `serve/shard_stale`;
+    a stale shard (NOT admitting and past the cutoff) is skipped by the
+    fleet histogram merge while `fleet/stale_shards` counts it — but
+    age alone never marks an admitting replica stale, and the labeled
+    set keeps serving the frozen numbers either way."""
+    from solvingpapers_tpu.metrics.hist import LogHistogram
+    from solvingpapers_tpu.serve import metrics as smetrics
+
+    router = _fleet(2)
+    for p in _prompts(3, seed=11):
+        for r in router.replicas:
+            r.engine.submit(p, max_new_tokens=4)
+    _drain_fleet(router)
+    r1 = router.replica("r1")
+    router.stale_shard_cutoff_s = 0.05
+    r1.engine.metrics._t_last = smetrics.now() - 1.0
+    (_, _, merged), (_, _, s0), (_, _, s1) = router.prom_sets()
+    assert s1["serve/shard_age_s"] >= 0.9
+    assert s0["serve/shard_stale"] == 0.0
+    assert s1["serve/shard_stale"] == 0.0  # old but ADMITTING: not stale
+    assert merged["fleet/stale_shards"] == 0.0
+    key = next(k for k, v in s0.items()
+               if isinstance(v, LogHistogram) and v.count
+               and isinstance(s1.get(k), LogHistogram) and s1[k].count)
+    assert merged[key].count == s0[key].count + s1[key].count
+
+    r1.draining = True  # not admitting + past the cutoff -> stale
+    r1.engine.metrics._t_last = smetrics.now() - 1.0
+    (_, _, merged), (_, _, s0), (_, lab1, s1) = router.prom_sets()
+    assert lab1 == {"replica": "r1"}
+    assert s1["serve/shard_stale"] == 1.0
+    assert merged["fleet/stale_shards"] == 1.0
+    assert merged["fleet/admitting"] == 1.0
+    assert merged[key].count == s0[key].count  # merge skipped the shard
+    assert s1[key].count > 0  # ...but the labeled set still serves it
+    r1.draining = False
+    for r in router.replicas:
+        assert_no_leaks(r.engine)
+
+
+def test_http_rerouted_response_carries_reroute_header():
+    """A response whose submit was retried on a peer carries
+    ``X-Fleet-Reroutes: <n>`` next to X-Replica-Id; directly-placed
+    requests omit the header entirely."""
+    from solvingpapers_tpu.serve.api import ApiServer
+
+    model, params = _model()
+    engines = [ServeEngine(model, params, _cfg(
+        api_port=0, n_slots=1, max_waiting=1, prefix_cache=True))
+        for _ in range(2)]
+    router = FleetRouter(engines)  # started loops
+    srv = ApiServer(
+        router=router,
+        decode=lambda ids: "".join(chr(97 + i % 26) for i in ids),
+        model_name="gpt-tiny")
+    try:
+        rng = np.random.default_rng(21)
+        stem = rng.integers(0, 64, size=24).astype(np.int32)
+        r0 = router.replica("r0")
+        with r0.loop.lock:
+            warm = r0.engine.submit(stem, max_new_tokens=2)
+        while not warm.done:
+            time.sleep(0.002)
+        probe = [int(t) for t in stem[:16]] + [1, 2, 3]
+
+        def post(body):
+            req = urllib.request.Request(
+                srv.url("/v1/completions"),
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.headers, json.loads(r.read())
+
+        # directly placed (unrelated prompt): no header at all
+        h, _ = post({"prompt": [5, 6, 7], "max_tokens": 2,
+                     "temperature": 0})
+        assert h.get("X-Fleet-Reroutes") is None
+
+        for _ in range(25):
+            while r0.engine.has_work():
+                time.sleep(0.002)
+            # slot + the 1-deep waiting queue: r0 stays FULL while
+            # these decode, yet affinity still ranks it first for the
+            # probe — the router must retry down the ranking. `a` has
+            # to reach a slot BEFORE `b` queues, or `b` bounces off
+            # the 1-deep queue `a` still occupies.
+            with r0.loop.lock:
+                a = r0.engine.submit(
+                    rng.integers(0, 64, 8).astype(np.int32),
+                    max_new_tokens=40)
+            while a.admit_time is None and not a.done:
+                time.sleep(0.001)
+            with r0.loop.lock:
+                b = r0.engine.submit(
+                    rng.integers(0, 64, 8).astype(np.int32),
+                    max_new_tokens=40)
+            if (a.state == "rejected" or b.state == "rejected"
+                    or r0.engine.scheduler.capacity_left > 0):
+                continue
+            h, doc = post({"prompt": probe, "max_tokens": 2,
+                           "temperature": 0})
+            if h.get("X-Fleet-Reroutes") == "1":
+                assert h["X-Replica-Id"] == "r1"
+                assert doc["choices"][0]["finish_reason"] == "length"
+                break
+        else:
+            pytest.fail("never caught r0 full: reroute header unseen")
+    finally:
+        srv.close()

@@ -1,0 +1,237 @@
+"""Rolling in-process time series (metrics/timeseries.py).
+
+`TimeSeriesStore` is the fleet's retrospective memory: a fixed-budget
+ring of periodic snapshots — raw gauges plus per-window DELTAS of
+cumulative counters — sampled opportunistically from the engine's
+`step()` (no timer thread). These tests pin the store's semantics with
+a fake clock (delta-vs-raw rules, None alignment for late series, ring
+eviction, the `due()` cadence guard), the sparkline rendering, the
+AnomalyMonitor attachment (every anomaly dump carries the preceding
+retrospective), and the engine integration: `/statusz` sparklines,
+`statusz_providers`' `timeseries_fn`, and the `timeseries=False`
+opt-out leaving every surface absent rather than empty.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.metrics.timeseries import TimeSeriesStore, sparkline
+from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+from solvingpapers_tpu.serve import ServeConfig, ServeEngine
+
+pytestmark = pytest.mark.fast
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ sparkline
+
+
+def test_sparkline_scales_min_to_max():
+    s = sparkline([0.0, 1.0, 2.0, 3.0])
+    assert len(s) == 4
+    assert s[0] == "▁" and s[-1] == "█"
+
+
+def test_sparkline_flat_nones_width_and_empty():
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"  # flat -> lowest block
+    assert sparkline([None, 1.0, None, 2.0])[0] == " "
+    assert sparkline([None, 1.0, None, 2.0])[2] == " "
+    # width keeps the NEWEST points (right edge is "now")
+    assert sparkline([0.0, 0.0, 9.0, 9.0], width=2) == "▁▁"
+    assert sparkline([]) == ""
+    assert sparkline([None, None]) == ""
+
+
+# ----------------------------------------------------------- the store
+
+
+def test_store_validates_knobs():
+    with pytest.raises(ValueError, match="capacity"):
+        TimeSeriesStore(capacity=0)
+    with pytest.raises(ValueError, match="interval_s"):
+        TimeSeriesStore(interval_s=0.0)
+
+
+def test_due_follows_the_interval():
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=8, interval_s=1.0, clock=clk)
+    assert ts.due()  # never sampled
+    ts.sample({"g": 1.0})
+    assert not ts.due()
+    clk.t += 0.5
+    assert not ts.due()
+    clk.t += 0.5
+    assert ts.due()
+
+
+def test_cumulative_stores_deltas_first_window_raw_and_clamps():
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=8, interval_s=1.0, clock=clk)
+    ts.sample({}, cumulative={"tok": 10.0})
+    clk.t += 1
+    ts.sample({}, cumulative={"tok": 25.0})
+    clk.t += 1
+    ts.sample({}, cumulative={"tok": 5.0})  # counter went BACKWARDS
+    rows = ts.doc()["series"]["tok"]
+    # first window = raw (pre-store life is window 0), then deltas,
+    # and a backwards counter clamps to 0 instead of a negative rate
+    assert rows == [10.0, 15.0, 0.0]
+
+
+def test_late_series_backfills_and_absent_records_none():
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=8, interval_s=1.0, clock=clk)
+    ts.sample({"a": 1.0})
+    clk.t += 1
+    ts.sample({"a": 2.0, "b": 7.0})  # b appears mid-run
+    clk.t += 1
+    ts.sample({"b": 8.0})  # a absent this window
+    doc = ts.doc()
+    assert doc["series"]["a"] == [1.0, 2.0, None]
+    assert doc["series"]["b"] == [None, 7.0, 8.0]
+    assert doc["n"] == 3 and len(doc["t"]) == 3
+
+
+def test_ring_evicts_oldest_at_capacity():
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=3, interval_s=1.0, clock=clk)
+    for i in range(5):
+        ts.sample({"g": float(i)})
+        clk.t += 1
+    doc = ts.doc()
+    assert doc["n"] == 3 and len(ts) == 3
+    assert doc["series"]["g"] == [2.0, 3.0, 4.0]
+    assert doc["t"] == [102.0, 103.0, 104.0]
+
+
+def test_sparklines_render_and_omit_all_none_series():
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=8, interval_s=1.0, clock=clk)
+    ts.sample({"busy": 0.0})
+    clk.t += 1
+    ts.sample({"busy": 1.0, "late": None})
+    lines = ts.sparklines(width=10)
+    assert lines["busy"] == "▁█"
+    assert "late" not in lines  # no finite point yet -> omitted
+
+
+# ----------------------------------------------- anomaly-dump attachment
+
+
+def test_anomaly_dump_carries_the_retrospective(tmp_path):
+    import json
+
+    from solvingpapers_tpu.metrics.trace import AnomalyMonitor, FlightRecorder
+
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=4, interval_s=1.0, clock=clk)
+    ts.sample({"queue_depth": 3.0})
+    rec = FlightRecorder()
+    rec.instant("ctx", "engine", "engine")
+    mon = AnomalyMonitor(rec, str(tmp_path / "anom.jsonl"),
+                         snapshot_fn=lambda: {"serve/steps": 1.0},
+                         min_steps=4, slow_step_factor=5.0,
+                         timeseries_fn=ts.doc)
+    for _ in range(8):
+        mon.observe_step(0.01)
+    mon.observe_step(0.5)
+    assert mon.dumps == 1
+    (d,) = [json.loads(ln) for ln in
+            (tmp_path / "anom.jsonl").read_text().splitlines()]
+    assert d["timeseries"]["series"]["queue_depth"] == [3.0]
+    assert d["timeseries"]["n"] == 1
+
+
+# ------------------------------------------------------ engine integration
+
+
+@pytest.fixture(scope="module")
+def gpt_tiny():
+    cfg = GPTConfig(vocab_size=64, block_size=64, dim=32, n_layers=2,
+                    n_heads=2, dropout=0.0)
+    model = GPT(cfg)
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _run_traffic(eng, n=3):
+    rng = np.random.default_rng(5)
+    for _ in range(n):
+        eng.submit(rng.integers(0, 64, size=8).astype(np.int32),
+                   max_new_tokens=6)
+    eng.run()
+
+
+def test_engine_samples_windows_and_statusz_sparklines(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        # every step is due: a short run still rolls several windows
+        timeseries_interval_s=1e-9, timeseries_capacity=16,
+    ))
+    _run_traffic(eng)
+    assert eng.timeseries is not None and len(eng.timeseries) >= 2
+    doc = eng.timeseries.doc()
+    for key in ("occupancy", "queue_depth", "serve/tokens_out",
+                "serve/steps", "serve/itl_s_count"):
+        assert key in doc["series"], key
+    # the counter rows are per-window deltas: their sum equals the
+    # cumulative total the metrics snapshot reports
+    snap = eng.metrics.snapshot()
+    total = sum(v for v in doc["series"]["serve/tokens_out"]
+                if v is not None)
+    assert total == snap["serve/tokens_out"]
+    d = eng.statusz()
+    assert d["timeseries"]["windows"] == len(eng.timeseries)
+    assert d["timeseries"]["sparklines"]  # at least one rendered series
+    eng.close()
+
+
+def test_timeseries_opt_out_leaves_surfaces_absent(gpt_tiny):
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=2, max_len=32, decode_block=4, bucket=8,
+        timeseries=False,
+    ))
+    _run_traffic(eng, n=1)
+    assert eng.timeseries is None
+    assert "timeseries" not in eng.statusz()
+    eng.close()
+
+
+def test_status_server_serves_timeseriesz():
+    import json
+    import urllib.request
+
+    from solvingpapers_tpu.metrics.http import StatusServer
+
+    clk = FakeClock()
+    ts = TimeSeriesStore(capacity=4, interval_s=1.0, clock=clk)
+    ts.sample({"g": 1.0})
+    srv = StatusServer(statusz_fn=dict, metrics_fn=lambda: (0, {}),
+                       timeseries_fn=ts.doc)
+    try:
+        with urllib.request.urlopen(srv.url("/timeseriesz"),
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["series"]["g"] == [1.0]
+    finally:
+        srv.close()
+    # no store bound -> 404, not an empty 200
+    srv = StatusServer(statusz_fn=dict, metrics_fn=lambda: (0, {}))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url("/timeseriesz"), timeout=10)
+        assert ei.value.code == 404
+    finally:
+        srv.close()

@@ -424,6 +424,141 @@ def test_summarize_joins_http_phases(gpt_tiny):
     assert "http front door:" in out and "disconnects: 1" in out
 
 
+# ------------------------------------------------------------------ fleet
+
+
+def _fleet_sections():
+    """Router + two replica recorders with a migrated request: the
+    synthetic fleet the stitching/summary tests drive."""
+    router = FlightRecorder()
+    r0 = FlightRecorder()
+    r1 = FlightRecorder()
+    router.complete("route", "fleet", "router", ts=1.0, dur=0.002,
+                    req=5, rid="rid-a", replica="r0", attempts=1,
+                    scores=[{"replica": "r0"}])
+    r0.instant("submit", "request", "queue", ts=1.002, req=5,
+               prompt_len=4, rid="rid-a")
+    r0.complete("queue", "request", "queue", ts=1.002, dur=0.001, req=5)
+    r0.instant("finish", "request", "slot0", ts=1.01, req=5,
+               reason="migrated")
+    router.complete("migrate", "fleet", "router", ts=1.02, dur=0.004,
+                    req=5, rid="rid-a", src="r0", dst="r1")
+    router.complete("drain", "fleet", "router", ts=1.02, dur=0.005,
+                    replica="r0", entries=1, migrated=1, errors=0)
+    r1.instant("journal_adopt", "engine", "engine", ts=1.024,
+               rid="rid-a", committed=3, done=False)
+    r1.instant("finish", "request", "slot0", ts=1.05, req=6,
+               reason="length")
+    return [("router", router.events()), ("r0", r0.events()),
+            ("r1", r1.events())]
+
+
+def test_fleet_events_to_chrome_structure():
+    from solvingpapers_tpu.metrics.trace import fleet_events_to_chrome
+
+    obj = fleet_events_to_chrome(_fleet_sections())
+    evs = obj["traceEvents"]
+    # the manifest leads: declared sections survive an events-only
+    # round trip (load_chrome) so partial exports stay detectable
+    assert evs[0]["name"] == "fleet_manifest"
+    assert evs[0]["args"]["sections"] == ["router", "r0", "r1"]
+    pnames = {e["pid"]: e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert pnames == {1: "router", 2: "r0", 3: "r1"}
+    # timestamps are relative to the earliest event ACROSS sections
+    route = next(e for e in evs if e.get("name") == "route")
+    assert route["ts"] == 0.0 and route["pid"] == 1
+    # the cross-section flow follows the rid through all three
+    # processes (route -> submit -> migrate -> adopt)
+    import zlib
+
+    fid = zlib.crc32(b"rid-a")
+    flows = sorted((e for e in evs if e.get("cat") == "fleet_flow"
+                    and e.get("id") == fid), key=lambda e: e["ts"])
+    assert {e["pid"] for e in flows} == {1, 2, 3}
+    assert [f["ph"] for f in flows] == ["s", "t", "t", "f"]
+    assert all(f["name"] == "req:rid-a" for f in flows)
+    # duplicate section labels are refused, not silently shadowed
+    with pytest.raises(ValueError, match="duplicate"):
+        fleet_events_to_chrome([("r0", []), ("r0", [])])
+
+
+def test_summarize_fleet_section_present_iff_fleet_events(gpt_tiny):
+    """The `fleet` summary key exists exactly when the trace holds
+    fleet events — a single-engine export keeps the key ABSENT (the
+    same pinning as the PR-6 `mesh` section), so pre-fleet traces
+    summarize byte-identically."""
+    from solvingpapers_tpu.metrics.trace import fleet_events_to_chrome
+
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    eng.submit(_prompts(1, seed=31)[0], max_new_tokens=4)
+    eng.run()
+    assert "fleet" not in summarize_trace(eng.trace.to_chrome())
+
+    summary = summarize_trace(fleet_events_to_chrome(_fleet_sections()))
+    fleet = summary["fleet"]
+    assert fleet["sections"] == ["router", "r0", "r1"]
+    assert fleet["routing"]["route"] == 1
+    assert fleet["routing"]["migrations"] == 1
+    assert fleet["migrations"] == [
+        {"rid": "rid-a", "from": "r0", "to": "r1"}]
+    assert fleet["requests_by_replica"] == {"r0": 1, "r1": 1}
+    assert fleet["drain_wall_s"] == pytest.approx(0.005, rel=1e-3)
+    out = format_summary(summary)
+    assert "fleet:" in out and "r0 -> r1" in out
+
+
+def test_partial_fleet_export_refused(tmp_path):
+    """A stitched file whose manifest declares sections the event list
+    is missing (a truncated/filtered export) must raise, not summarize
+    a slice of the fleet as the whole."""
+    import json as _json
+
+    from solvingpapers_tpu.metrics.trace import fleet_events_to_chrome
+
+    obj = fleet_events_to_chrome(_fleet_sections())
+    partial = [e for e in obj["traceEvents"] if e.get("pid") != 3]
+    with pytest.raises(ValueError, match="partial fleet export"):
+        summarize_trace({"traceEvents": partial})
+    # the cli surfaces the stitcher's own message with exit 2
+    from solvingpapers_tpu.cli import main as cli_main
+
+    p = tmp_path / "partial.json"
+    p.write_text(_json.dumps({"traceEvents": partial}))
+    assert cli_main(["trace-summary", str(p)]) == 2
+
+
+def test_cli_trace_summary_fleet_flag_contract(gpt_tiny, tmp_path,
+                                               capsys):
+    """`trace-summary --fleet` exits 0 on a stitched export and 2 with
+    a clear message on a single-engine trace; without the flag the
+    single-engine trace keeps summarizing exactly as before."""
+    import json as _json
+
+    from solvingpapers_tpu.cli import main as cli_main
+    from solvingpapers_tpu.metrics.trace import fleet_events_to_chrome
+
+    stitched = tmp_path / "fleet.json"
+    stitched.write_text(_json.dumps(
+        fleet_events_to_chrome(_fleet_sections())))
+    assert cli_main(["trace-summary", str(stitched), "--fleet"]) == 0
+    assert "fleet:" in capsys.readouterr().out
+
+    model, params = gpt_tiny
+    eng = ServeEngine(model, params, ServeConfig(
+        n_slots=1, max_len=64, decode_block=4, bucket=8, trace=True,
+    ))
+    eng.submit(_prompts(1, seed=32)[0], max_new_tokens=4)
+    eng.run()
+    single = eng.trace.export_chrome(str(tmp_path / "single.json"))
+    assert cli_main(["trace-summary", single, "--fleet"]) == 2
+    assert "holds no fleet events" in capsys.readouterr().err
+    assert cli_main(["trace-summary", single]) == 0
+
+
 # ------------------------------------------------------------------- cli
 
 

@@ -58,7 +58,7 @@ BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
                "survivors_token_exact", "zero_leak", "ladder_zero_leak",
                "slots_clean", "recovered_token_exact",
                "journal_degraded_exercised", "migrated_token_exact",
-               "fleet_token_exact")
+               "fleet_token_exact", "trail_partition_ok")
 
 # name-pattern -> (kind, higher_is_better); first match wins.
 # kind: "pct" = absolute percentage-point band — overheads hover near 0
