@@ -11,6 +11,9 @@ star: "every notebook's train() cell becomes a CLI entrypoint"):
     cli serve  --config gpt_shakespeare [--checkpoint-dir ckpts]
                [--port 8000] — OpenAI-compatible /v1/completions +
                /v1/chat/completions (SSE streaming, json_object mode)
+    cli replay --config gpt_shakespeare --journal serve.jsonl
+               [--config-overrides kv_quant=int8] [--out report.json]
+               — config-canary divergence gate (exit 2 on divergence)
     cli serve-bench --config llama3_shakespeare [--trace] [--http]
     cli kernel-bench [--config gpt_shakespeare] [--out BENCH_kernels.json]
     cli trace-summary serve_trace.json [--top 10]
@@ -391,19 +394,16 @@ def cmd_sample(args) -> int:
     return 0
 
 
-def cmd_serve(args) -> int:
-    """Serve a model over the OpenAI-compatible HTTP front door
-    (serve/api.py): POST /v1/completions + /v1/chat/completions (SSE
-    streaming, json_object mode) plus /healthz /metrics /statusz on ONE
-    port. Ctrl-C / SIGTERM shuts down in order: drain active streams,
-    close the engine, stop the HTTP threads."""
-    import signal
-    import threading
-
+def _serve_model(args, *, quiet_random_init: bool = False):
+    """Build the serving model EXACTLY as `cli serve` does — config
+    densification, `jax.random.key(args.seed)` init, optional
+    checkpoint restore, and the full-vocab token table. `cli replay`
+    reuses this so a journal recorded by a serving process replays
+    byte-exactly in a different process: same seed -> same params ->
+    same logits. Returns (model, params, extra, table, encode, decode)
+    or an int exit code on a usage error."""
     from solvingpapers_tpu.configs import get_config
     from solvingpapers_tpu.configs.factory import build_char_lm_run
-    from solvingpapers_tpu.serve.api import ApiServer
-    from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
     from solvingpapers_tpu.serve.openai import extend_token_table
 
     cfg = get_config(args.config)
@@ -443,7 +443,7 @@ def cmd_serve(args) -> int:
         _, params, extra_restored = restored
         if extra_restored:
             extra = extra_restored
-    else:
+    elif not quiet_random_init:
         print("[serve] no --checkpoint-dir: serving RANDOM-INIT params "
               "(endpoint/latency demo, not a language model)",
               file=sys.stderr)
@@ -470,6 +470,25 @@ def cmd_serve(args) -> int:
     def decode(ids):
         return "".join(table[int(i)] or "" for i in ids)
 
+    return model, params, extra, table, encode, decode
+
+
+def cmd_serve(args) -> int:
+    """Serve a model over the OpenAI-compatible HTTP front door
+    (serve/api.py): POST /v1/completions + /v1/chat/completions (SSE
+    streaming, json_object mode) plus /healthz /metrics /statusz on ONE
+    port. Ctrl-C / SIGTERM shuts down in order: drain active streams,
+    close the engine, stop the HTTP threads."""
+    import signal
+    import threading
+
+    from solvingpapers_tpu.serve.api import ApiServer
+    from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
+
+    built = _serve_model(args)
+    if isinstance(built, int):
+        return built
+    model, params, extra, table, encode, decode = built
     slo_targets = None
     if args.slo:
         from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS
@@ -573,6 +592,118 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """Replay a request journal against a candidate serving config and
+    gate on the divergence report (serve/replay.py) — the config-canary
+    check: journal production traffic, replay it under the proposed
+    knobs, ship only if the streams still match.
+
+    Builds the model exactly as `cli serve` does (same --config /
+    --seed / --checkpoint-dir => same params), loads the journal's
+    finished streams, re-serves them on a fresh engine shaped by the
+    engine flags + --config-overrides, and prints the report JSON.
+    With overrides, the un-overridden config is re-served too for
+    paired latency/throughput deltas.
+
+    Exit codes: 0 = gate passed; 2 = divergence beyond
+    --byte-exact-min / --agreement-min (the CI-able canary signal);
+    1 = operational failure (unreadable journal, nothing comparable)."""
+    from solvingpapers_tpu.serve.engine import ServeConfig
+    from solvingpapers_tpu.serve.journal import JournalError
+    from solvingpapers_tpu.serve.replay import ReplayHarness, apply_overrides
+
+    built = _serve_model(args, quiet_random_init=True)
+    if isinstance(built, int):
+        return built
+    model, params, extra, _, _, decode = built
+    if not args.checkpoint_dir:
+        print("[replay] no --checkpoint-dir: random-init params — fine "
+              "iff the journal was recorded by the same seed's "
+              "random-init server", file=sys.stderr)
+
+    limit = getattr(model, "max_positions", None) or 512
+    max_len = args.max_len or min(512, limit)
+    base_cfg = ServeConfig(
+        n_slots=args.slots,
+        max_len=max_len,
+        decode_block=args.decode_block,
+        bucket=min(args.bucket, max_len),
+        sample_cap=args.sample_cap,
+        paged=args.paged,
+        kv_quant=args.kv_quant,
+        kv_quant_block=args.kv_quant_block,
+        kv_exact_lanes=args.kv_exact_lanes,
+        speculative=args.speculative,
+        spec_k=args.spec_k,
+        spec_rounds=args.spec_rounds,
+        max_waiting=args.max_waiting,
+    )
+    overrides = {}
+    for kv in args.config_overrides or []:
+        if "=" not in kv:
+            print(f"[replay] --config-overrides takes KEY=VALUE pairs, "
+                  f"got {kv!r}", file=sys.stderr)
+            return 2
+        k, v = kv.split("=", 1)
+        overrides[k] = v
+    try:
+        candidate = apply_overrides(base_cfg, overrides)
+    except (ValueError, TypeError) as e:
+        print(f"[replay] {e}", file=sys.stderr)
+        return 2
+
+    harness = ReplayHarness(model, params, extra_variables=extra or None,
+                            detokenize=decode)
+    try:
+        entries = harness.load(args.journal)
+    except FileNotFoundError:
+        print(f"[replay] journal not found: {args.journal}",
+              file=sys.stderr)
+        return 1
+    except JournalError as e:
+        print(f"[replay] {e}", file=sys.stderr)
+        return 1
+    print(f"[replay] {args.journal}: {len(entries)} journaled "
+          f"request(s)", file=sys.stderr)
+
+    report = harness.run(
+        entries, candidate,
+        baseline=base_cfg if overrides else None,
+        cut_stride=args.cut_stride,
+        max_cuts=args.max_cuts,
+        max_requests=args.max_requests,
+        pace=args.pace,
+        journal_path=args.journal,
+    )
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+        print(f"[replay] wrote {args.out}", file=sys.stderr)
+
+    if report["streams_compared"] == 0:
+        print("[replay] no byte-comparable streams (greedy or seeded) "
+              "in the journal — the gate is undecidable", file=sys.stderr)
+        return 1
+    bex = report["byte_exact_rate"]
+    agr = report["agreement_rate"]
+    print(f"[replay] byte_exact_rate={bex} agreement_rate={agr} "
+          f"compared={report['streams_compared']} "
+          f"skipped={len(report['skipped'])} "
+          f"wall={report['replay_wall_s']}s", file=sys.stderr)
+    failed = []
+    if bex < args.byte_exact_min:
+        failed.append(f"byte_exact_rate {bex} < {args.byte_exact_min}")
+    if args.agreement_min and (agr is None or agr < args.agreement_min):
+        failed.append(f"agreement_rate {agr} < {args.agreement_min}")
+    if failed:
+        print(f"[replay] DIVERGENCE GATE FAILED: {'; '.join(failed)}",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
 def cmd_serve_bench(args) -> int:
     """Continuous-batching engine vs sequential one-shot generate on a
     synthetic Poisson arrival stream — or, with --shared-prefix, prefix
@@ -590,10 +721,11 @@ def cmd_serve_bench(args) -> int:
         return 2
     if sum((args.shared_prefix, args.sampling, args.paged, args.http,
             args.speculative, args.slo, args.chaos, args.journal,
-            args.fleet, args.kv_quant is not None)) > 1:
+            args.fleet, args.replay, args.kv_quant is not None)) > 1:
         print("--shared-prefix, --sampling, --paged, --http, "
-              "--speculative, --slo, --chaos, --journal, --fleet and "
-              "--kv-quant are separate workloads; pick one per run",
+              "--speculative, --slo, --chaos, --journal, --fleet, "
+              "--replay and --kv-quant are separate workloads; pick "
+              "one per run",
               file=sys.stderr)
         return 2
     from solvingpapers_tpu.serve.bench import (
@@ -605,6 +737,7 @@ def cmd_serve_bench(args) -> int:
         run_paged_bench,
         run_prefix_bench,
         run_quant_bench,
+        run_replay_bench,
         run_sampling_bench,
         run_serve_bench,
         run_slo_bench,
@@ -646,7 +779,7 @@ def cmd_serve_bench(args) -> int:
     if args.obs_hlo_dir:
         if any((args.shared_prefix, args.sampling, args.paged, args.http,
                 args.speculative, args.slo, args.chaos, args.journal,
-                args.fleet, args.kv_quant is not None)):
+                args.fleet, args.replay, args.kv_quant is not None)):
             # say so instead of silently dropping the flag — a user
             # waiting on dumps should not debug an empty directory
             print("--obs-hlo-dir only dumps from the Poisson workload's "
@@ -656,7 +789,22 @@ def cmd_serve_bench(args) -> int:
         else:
             # Poisson workload: the probe engine is the one that dumps
             trace_kwargs["obs_hlo_dir"] = args.obs_hlo_dir
-    if args.kv_quant:
+    if args.replay:
+        result = run_replay_bench(
+            config=args.config,
+            n_requests=n_requests,
+            n_slots=n_slots,
+            max_new=args.max_new_tokens or 48,
+            decode_block=args.decode_block or 8,
+            prompt_lens=tuple(prompt_lens),
+            train_steps=args.replay_train_steps,
+            seed=args.seed,
+            page_size=args.page_size,
+            kv_quant_block=args.kv_quant_block,
+            status_port=args.status_port,
+            status_hold_s=args.status_hold_s,
+        )
+    elif args.kv_quant:
         result = run_quant_bench(
             config=args.config,
             n_requests=n_requests,
@@ -1217,6 +1365,24 @@ def main(argv=None) -> int:
                               "the watchdog deadline is set BELOW it "
                               "(max(0.25, 0.75x)) so the stall "
                               "deterministically trips the fire path")
+    p_serve.add_argument("--replay", action="store_true",
+                         help="replay-observatory workload instead: "
+                              "journal a seeded greedy+seeded-sampling "
+                              "workload on a briefly-trained model, "
+                              "replay it through serve/replay.py "
+                              "against (a) the identical config on "
+                              "BOTH pool layouts — replay_byte_exact, "
+                              "the never-flip CI gate — and (b) an "
+                              "int8-kv candidate — "
+                              "replay_agreement_rate, the graded "
+                              "teacher-forced score (serve/bench.py "
+                              "run_replay_bench; defaults config "
+                              "gpt_tiny_long via tools/bench_serve.py)")
+    p_serve.add_argument("--replay-train-steps", type=int, default=150,
+                         help="[--replay] brief training steps before "
+                              "journaling (int8 agreement on random "
+                              "init measures argmax tie-breaking, not "
+                              "quantization quality; 0 = random init)")
     p_serve.add_argument("--kv-quant", default=None, choices=["int8"],
                          help="quantized-KV workload instead: int8 cache "
                               "storage vs exact on a briefly-trained "
@@ -1466,6 +1632,78 @@ def main(argv=None) -> int:
                             "seconds at O(capacity x series) memory")
     p_srv.add_argument("--seed", type=int, default=0)
 
+    p_rep = sub.add_parser(
+        "replay",
+        help="replay a request journal against a candidate config and "
+             "gate on stream divergence (serve/replay.py): exit 0 = "
+             "match, exit 2 = divergence beyond the thresholds, exit "
+             "1 = operational failure",
+    )
+    _add_common(p_rep)
+    p_rep.add_argument("--journal", required=True, metavar="PATH",
+                       help="journal to replay — the live file a "
+                            "`cli serve --journal` wrote (a concurrent "
+                            "rotation mid-read is tolerated) or a "
+                            "copied snapshot")
+    p_rep.add_argument("--config-overrides", nargs="*", default=None,
+                       metavar="KEY=VALUE",
+                       help="ServeConfig fields for the CANDIDATE "
+                            "(e.g. kv_quant=int8 paged=true "
+                            "decode_block=16); values parse as JSON "
+                            "then fall back to raw strings; when "
+                            "given, the un-overridden config is "
+                            "re-served too for paired latency/"
+                            "throughput deltas")
+    p_rep.add_argument("--out", default=None,
+                       help="also write the report JSON here")
+    p_rep.add_argument("--byte-exact-min", type=float, default=1.0,
+                       help="exit 2 if byte_exact_rate over the "
+                            "greedy+seeded streams falls below this "
+                            "(default 1.0 — identical configs must "
+                            "match exactly)")
+    p_rep.add_argument("--agreement-min", type=float, default=0.0,
+                       help="exit 2 if the teacher-forced greedy "
+                            "agreement_rate falls below this — the "
+                            "graded gate for deliberately-lossy "
+                            "candidates like kv_quant=int8 (0 "
+                            "disables; pair with --byte-exact-min 0)")
+    p_rep.add_argument("--max-requests", type=int, default=None,
+                       help="replay only the first N journaled "
+                            "requests")
+    p_rep.add_argument("--cut-stride", type=int, default=8,
+                       help="token stride of the teacher-forced "
+                            "agreement cuts (0 disables the "
+                            "agreement pass)")
+    p_rep.add_argument("--max-cuts", type=int, default=512,
+                       help="total agreement-cut budget (overflow is "
+                            "disclosed as cuts_dropped, never "
+                            "silently truncated)")
+    p_rep.add_argument("--pace", action="store_true",
+                       help="re-serve at the recorded arrival offsets "
+                            "instead of submitting upfront (realistic "
+                            "latency deltas, slower wall clock)")
+    p_rep.add_argument("--slots", type=int, default=8)
+    p_rep.add_argument("--max-len", type=int, default=None,
+                       help="engine sequence capacity (default: "
+                            "min(512, model max positions)) — match "
+                            "the recording server's")
+    p_rep.add_argument("--decode-block", type=int, default=8)
+    p_rep.add_argument("--bucket", type=int, default=32)
+    p_rep.add_argument("--sample-cap", type=int, default=64)
+    p_rep.add_argument("--max-waiting", type=int, default=256)
+    p_rep.add_argument("--paged", action="store_true")
+    p_rep.add_argument("--kv-quant", default=None, choices=["int8"])
+    p_rep.add_argument("--kv-quant-block", type=int, default=16)
+    p_rep.add_argument("--kv-exact-lanes", type=int, default=0)
+    p_rep.add_argument("--speculative", default=None,
+                       choices=["ngram", "mtp"])
+    p_rep.add_argument("--spec-k", type=int, default=4)
+    p_rep.add_argument("--spec-rounds", type=int, default=None)
+    p_rep.add_argument("--seed", type=int, default=0,
+                       help="model-init seed — must match the "
+                            "recording server's for byte-exactness "
+                            "without a checkpoint")
+
     p_tsum = sub.add_parser("trace-summary")
     p_tsum.add_argument("trace",
                         help="Chrome trace-event JSON exported by the "
@@ -1501,6 +1739,7 @@ def main(argv=None) -> int:
         "train": cmd_train,
         "sample": cmd_sample,
         "serve": cmd_serve,
+        "replay": cmd_replay,
         "serve-bench": cmd_serve_bench,
         "kernel-bench": cmd_kernel_bench,
         "trace-summary": cmd_trace_summary,

@@ -12,7 +12,10 @@ crash-safe warm restart via ServeEngine.recover, SSE stream
 resumption over Last-Event-ID) + fleet serving (serve/fleet.py:
 multi-replica FleetRouter with prefix-affinity + SLO-aware routing,
 merged fleet metrics, journal-backed zero-drop stream migration via
-FleetRouter.drain)."""
+FleetRouter.drain) + replay observatory (serve/replay.py: journal-
+backed shadow-traffic replay against a candidate config, byte-level
+stream diffing + teacher-forced agreement scoring, the config-canary
+divergence gate)."""
 
 from solvingpapers_tpu.serve.api import ApiServer, EngineLoop, serve_api
 from solvingpapers_tpu.serve.engine import ServeConfig, ServeEngine
@@ -28,7 +31,12 @@ from solvingpapers_tpu.serve.faults import (
     InjectedFault,
 )
 from solvingpapers_tpu.serve.grammar import JsonStepper
-from solvingpapers_tpu.serve.journal import Journal, JournalEntry, JournalError
+from solvingpapers_tpu.serve.journal import (
+    Journal,
+    JournalEntry,
+    JournalError,
+    read_entries,
+)
 from solvingpapers_tpu.serve.kv_pool import (
     KVSlotPool,
     PagedKVPool,
@@ -37,6 +45,7 @@ from solvingpapers_tpu.serve.kv_pool import (
 )
 from solvingpapers_tpu.serve.metrics import ServeMetrics
 from solvingpapers_tpu.serve.prefix_cache import PrefixCache, PrefixMatch
+from solvingpapers_tpu.serve.replay import ReplayHarness
 from solvingpapers_tpu.serve.sampling import SamplingParams, fused_sample
 from solvingpapers_tpu.serve.scheduler import FIFOScheduler, Request
 from solvingpapers_tpu.serve.slo import DEFAULT_SLO_TARGETS, SloTracker
@@ -56,6 +65,8 @@ __all__ = [
     "Journal",
     "JournalEntry",
     "JournalError",
+    "read_entries",
+    "ReplayHarness",
     "serve_api",
     "ServeConfig",
     "ServeEngine",

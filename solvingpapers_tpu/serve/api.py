@@ -296,6 +296,9 @@ class ApiServer:
     # dict per request served). A client re-using an id overwrites the
     # older entry — last-wins, like the header contract implies.
     timeline_cap = 1024
+    # replay runs kept for GET /v1/replay/<id> — same bounded evict-
+    # oldest discipline (each record holds a full divergence report)
+    replay_cap = 16
 
     def __init__(self, engine=None, *, encode=None, decode=None,
                  token_table=None, model_name: str = "solvingpapers",
@@ -338,6 +341,15 @@ class ApiServer:
         self._retry_lock = threading.Lock()
         self._timelines: OrderedDict[str, dict] = OrderedDict()
         self._timeline_lock = threading.Lock()
+        # replay observatory (serve/replay.py): bounded run registry,
+        # one run in flight at a time (each run builds its own engine —
+        # a second concurrent build would thrash the host), and the
+        # replay/* gauge payload of the LAST finished run (empty until
+        # one exists — the present-iff-enabled key-surface contract)
+        self._replays: OrderedDict[str, dict] = OrderedDict()
+        self._replay_lock = threading.Lock()
+        self._replay_active = False
+        self._replay_gauge_vals: dict[str, float] = {}
         vocab = getattr(getattr(engine.model, "cfg", None), "vocab_size",
                         None) or (1 << 31)
         self.vocab_size = vocab
@@ -409,6 +421,9 @@ class ApiServer:
             "serve/http_disconnects": float(c["disconnects"]),
             "serve/http_rejected": float(c["rejected"]),
             "serve/http_client_errors": float(c["client_errors"]),
+            # replay/* from the last finished replay run — {} until one
+            # has run, so a replay-less server's key surface is unchanged
+            **self._replay_gauge_vals,
         }
 
     def _bump(self, key: str, delta: int = 1) -> None:
@@ -579,6 +594,8 @@ class ApiServer:
                 })
             elif path.startswith("/v1/requests/"):
                 self._request_status(h, path[len("/v1/requests/"):])
+            elif path.startswith("/v1/replay/"):
+                self._replay_status(h, path[len("/v1/replay/"):])
             else:
                 self._send(h, 404, "not found\n", "text/plain")
         except (BrokenPipeError, ConnectionResetError):
@@ -651,6 +668,111 @@ class ApiServer:
                 "grammar": entry.grammar,
             },
         }
+
+    # ---------------------------------------------------------- replay
+
+    def _post_replay(self, h) -> None:
+        """POST /v1/replay: launch a bounded background replay of a
+        journal against a candidate config (serve/replay.py) — the
+        live engine's weights on a FRESH engine, the live engine never
+        touched. Body: ``journal`` (default: this engine's own journal
+        path), ``config_overrides`` (ServeConfig field -> value),
+        ``max_requests`` (corpus cap, default 256), ``cut_stride``,
+        ``pace``. One run in flight at a time (409 otherwise); poll
+        GET /v1/replay/<id> for progress + the report. 202 on
+        accept."""
+        from solvingpapers_tpu.serve import replay as replay_mod
+
+        try:
+            body = self._read_body(h)
+            journal = body.get("journal") or self.engine.config.journal_path
+            if not journal:
+                raise ApiError(
+                    "no journal to replay: pass 'journal' (a path this "
+                    "server can read) or serve with --journal",
+                    param="journal")
+            overrides = body.get("config_overrides") or {}
+            if not isinstance(overrides, dict):
+                raise ApiError("config_overrides must be an object",
+                               param="config_overrides")
+            try:
+                candidate = replay_mod.apply_overrides(
+                    self.engine.config, dict(overrides))
+            except (ValueError, TypeError) as e:
+                raise ApiError(str(e), param="config_overrides") from None
+            max_requests = int(body.get("max_requests", 256))
+            cut_stride = int(body.get("cut_stride", 8))
+            pace = bool(body.get("pace", False))
+        except ApiError as e:
+            self._send_error(h, e)
+            return
+        with self._replay_lock:
+            if self._replay_active:
+                self._send_json(h, 409, {"error": {
+                    "message": "a replay run is already in flight — "
+                               "poll it to completion first",
+                    "type": "invalid_request_error", "param": None,
+                    "code": "replay_in_flight",
+                }})
+                return
+            self._replay_active = True
+            run_id = uuid.uuid4().hex[:12]
+            rec = {
+                "id": run_id, "state": "running",
+                "progress": {"done": 0, "total": 1},
+                "journal": journal, "config_overrides": overrides,
+                "report": None, "error": None,
+            }
+            self._replays[run_id] = rec
+            while len(self._replays) > self.replay_cap:
+                self._replays.popitem(last=False)
+
+        def work():
+            try:
+                harness = replay_mod.ReplayHarness.from_engine(
+                    self.engine)
+                entries = harness.load(journal)
+
+                def prog(done, total):
+                    rec["progress"] = {"done": done, "total": total}
+
+                rec["report"] = harness.run(
+                    entries, candidate, cut_stride=cut_stride,
+                    max_requests=max_requests, pace=pace,
+                    journal_path=journal, progress=prog)
+                rec["state"] = "finished"
+                # the replay/* gauges ride the LIVE engine's /metrics
+                # and /statusz through the registered provider
+                self._replay_gauge_vals = replay_mod.report_gauges(
+                    rec["report"])
+            except Exception as e:  # noqa: BLE001 — surfaced via GET
+                rec["error"] = f"{type(e).__name__}: {e}"
+                rec["state"] = "error"
+            finally:
+                with self._replay_lock:
+                    self._replay_active = False
+
+        threading.Thread(target=work, name="replay", daemon=True).start()
+        self._send_json(h, 202, {"id": run_id, "state": "running"},
+                        {"Location": f"/v1/replay/{run_id}"})
+
+    def _replay_status(self, h, run_id: str) -> None:
+        """GET /v1/replay/<id>: state + progress while running, the
+        full divergence report once finished, the error string on
+        failure. Bounded registry — evicted runs 404."""
+        with self._replay_lock:
+            rec = self._replays.get(run_id)
+            doc = dict(rec) if rec is not None else None
+        if doc is None:
+            self._send_json(h, 404, {"error": {
+                "message": f"no replay run {run_id!r} (unknown or "
+                           f"evicted past the last {self.replay_cap} "
+                           "runs)",
+                "type": "invalid_request_error", "param": None,
+                "code": "replay_not_found",
+            }})
+            return
+        self._send_json(h, 200, doc)
 
     @staticmethod
     def _hop_phases(req) -> dict[str, float]:
@@ -798,6 +920,9 @@ class ApiServer:
         # response byte is carved into contiguous spans on this clock
         t_accept = smetrics.now()
         path = h.path.split("?", 1)[0]
+        if path == "/v1/replay":
+            self._post_replay(h)
+            return
         chat = path == "/v1/chat/completions"
         if not chat and path != "/v1/completions":
             self._send(h, 404, "not found\n", "text/plain")

@@ -2481,6 +2481,183 @@ def run_journal_bench(
     }
 
 
+def run_replay_bench(
+    config: str = "gpt_tiny_long",
+    n_requests: int = 24,
+    n_slots: int = 8,
+    max_new: int = 48,
+    decode_block: int = 8,
+    prompt_lens=(16, 32, 48, 64),
+    train_steps: int = 200,
+    seed: int = 0,
+    page_size: int = 16,
+    kv_quant_block: int = 16,
+    cut_stride: int = 8,
+    journal_dir: str | None = None,
+    status_port: int | None = None,
+    status_hold_s: float = 0.0,
+) -> dict:
+    """`cli serve-bench --replay`: the replay observatory's own gate.
+
+    Journals a seeded workload (greedy + two seeded stochastic shapes,
+    `_journal_params_for` — every stream byte-replayable), then replays
+    it through `serve.replay.ReplayHarness` three ways:
+
+    1. IDENTICAL config, lane pool — `byte_exact_rate` must be 1.0
+       (same params, same seed chains, same pool: any flip is a replay
+       or determinism bug). `replay_byte_exact` folds this arm AND the
+       paged arm into the never-flip bool CI asserts.
+    2. IDENTICAL config, paged pool — the same journal-record-replay
+       discipline on the paged engine's own journal.
+    3. INT8-KV candidate from the lane journal — the config-canary
+       direction: byte exactness is EXPECTED to break (that is what
+       the canary detects, `quant_byte_exact_rate` discloses how
+       fast via `replay_first_divergence_p50`) while the teacher-
+       forced GREEDY `replay_agreement_rate` grades per-step quality
+       and is held to the same >= 0.99 band as `run_quant_bench`'s
+       `greedy_agreement_rate` gate. Seeded cuts re-draw through the
+       pinned seed chain, where int8 perturbation flips sampled
+       tokens far more readily (the quant bench's
+       `rollout_agreement_rate` analogue, ~0.95-0.98 on this family)
+       — disclosed as `replay_agreement_rate_seeded`, never gated.
+
+    Trained model for the same reason as the quant bench: agreement
+    under perturbation on random init measures argmax tie-breaking
+    over near-uniform logits, not replay quality (`train_steps`
+    discloses it; 0 = random init)."""
+    from solvingpapers_tpu.data.synthetic import synthetic_text
+    from solvingpapers_tpu.serve.replay import ReplayHarness
+
+    model, params, extra, vocab = build_serve_model(config)
+    text = synthetic_text(n_chars=80000, seed=seed)
+    ids = np.frombuffer(text.encode("ascii", "replace"),
+                        np.uint8).astype(np.int32) % vocab
+    if train_steps > 0:
+        params = _train_bench_model(model, ids, train_steps, seed=seed)
+    # corpus-slice prompts, all submitted upfront: replay exactness is
+    # per-request and independent of arrival timing (the paced mode is
+    # exercised by the latency-delta surface, not this gate)
+    rng = np.random.default_rng(seed)
+    requests = []
+    for i in range(n_requests):
+        length = prompt_lens[i % len(prompt_lens)]
+        start = int(rng.integers(0, ids.size - length))
+        requests.append((0.0, ids[start:start + length]))
+    max_prompt = max(len(p) for _, p in requests)
+    grain = math.lcm(page_size, kv_quant_block)
+    max_len = -(-(max_prompt + max_new) // grain) * grain
+    limit = getattr(model, "max_positions", None)
+    if limit is not None and max_len > limit:
+        max_len = limit // grain * grain
+    jdir = journal_dir or tempfile.mkdtemp(prefix="serve_replay_bench_")
+    base = dict(
+        n_slots=n_slots, max_len=max_len, decode_block=decode_block,
+        bucket=min(32, max_prompt), max_prefills_per_step=n_slots,
+        max_waiting=max(256, n_requests), seed=seed,
+    )
+    lane_rec_cfg = ServeConfig(
+        **base, journal_path=os.path.join(jdir, "lane.jsonl"))
+    paged_rec_cfg = ServeConfig(
+        **base, paged=True, page_size=page_size,
+        journal_path=os.path.join(jdir, "paged.jsonl"))
+
+    by_len: dict = {}
+    for _, p in requests:
+        by_len.setdefault(len(p), p)
+    warm = [(0.0, p) for p in by_len.values()]
+    probe_fields, probe_eng = _obs_probe(
+        model, params, extra, warm, ServeConfig(**base), max_new,
+        status_port=status_port,
+    )
+
+    # ---- record: journaled lane + paged runs --------------------------
+    lane_eng, _, _ = _run_engine_arm(
+        model, params, extra, requests, lane_rec_cfg, max_new,
+        params_for=_journal_params_for)
+    lane_eng.journal.sync()
+    jstats = lane_eng.journal.stats()
+    leak_fields = _zero_leak_fields(lane_eng)
+    kv_fields = _kv_entry_fields(lane_eng)
+    lane_eng.close()
+    paged_eng, _, _ = _run_engine_arm(
+        model, params, extra, requests, paged_rec_cfg, max_new,
+        params_for=_journal_params_for)
+    paged_eng.journal.sync()
+    paged_eng.close()
+
+    # ---- replay: identical lane, identical paged, int8 candidate -----
+    harness = ReplayHarness(model, params, extra_variables=extra)
+    lane_entries = harness.load(lane_rec_cfg.journal_path)
+    paged_entries = harness.load(paged_rec_cfg.journal_path)
+    t0 = time.monotonic()
+    lane_report = harness.run(
+        lane_entries, ServeConfig(**base), cut_stride=cut_stride,
+        journal_path=lane_rec_cfg.journal_path)
+    paged_report = harness.run(
+        paged_entries, ServeConfig(**base, paged=True,
+                                   page_size=page_size),
+        cut_stride=0,  # agreement is the lane arms' story; this one
+        # pins byte exactness on the second pool layout
+        journal_path=paged_rec_cfg.journal_path)
+    quant_report = harness.run(
+        lane_entries,
+        ServeConfig(**base, kv_quant="int8",
+                    kv_quant_block=kv_quant_block),
+        cut_stride=cut_stride,
+        journal_path=lane_rec_cfg.journal_path)
+    replay_wall_s = time.monotonic() - t0
+
+    byte_exact = (lane_report["byte_exact_rate"] == 1.0
+                  and paged_report["byte_exact_rate"] == 1.0)
+    agreement = quant_report["agreement_rate_greedy"]
+
+    if status_hold_s > 0 and probe_eng is not None:
+        time.sleep(status_hold_s)
+    if probe_eng is not None:
+        probe_eng.close()
+    return {
+        "metric": "serve_replay_agreement_rate",
+        "value": round(float(agreement), 4),
+        "unit": ("teacher-forced greedy agreement of the int8-kv "
+                 "candidate replayed from the lane journal (identical-"
+                 "config replays must be byte-exact on both pools)"),
+        "vs_baseline": round(float(agreement) / 0.99, 4),
+        "detail": {
+            "config": config,
+            "workload": "replay",
+            "n_requests": n_requests,
+            "n_slots": n_slots,
+            "max_new_tokens": max_new,
+            "decode_block": decode_block,
+            "prompt_lens": list(prompt_lens),
+            "train_steps": train_steps,
+            "cut_stride": cut_stride,
+            "replay_byte_exact": byte_exact,
+            "replay_byte_exact_rate_lane": lane_report["byte_exact_rate"],
+            "replay_byte_exact_rate_paged":
+                paged_report["byte_exact_rate"],
+            "replay_agreement_rate": round(float(agreement), 4),
+            "replay_agreement_rate_seeded":
+                quant_report["agreement_rate_seeded"],
+            "replay_agreement_rate_all": quant_report["agreement_rate"],
+            "identical_agreement_rate": lane_report["agreement_rate"],
+            "quant_byte_exact_rate": quant_report["byte_exact_rate"],
+            "replay_first_divergence_p50":
+                quant_report["first_divergence_p50"],
+            "replay_streams_compared": lane_report["streams_compared"],
+            "replay_streams_skipped": len(lane_report["skipped"]),
+            "replay_cut_positions": quant_report["cut_positions"],
+            "replay_wall_s": round(replay_wall_s, 4),
+            "journal_records": jstats["records"],
+            "journal_bytes": jstats["bytes_written"],
+            "journal_rotations": jstats["rotations"],
+            **leak_fields,
+            **kv_fields,
+            **probe_fields,
+        },
+    }
+
+
 def _run_fleet_arm(model, params, extra, requests, serve_cfg, max_new,
                    n_replicas, params_for=None, journal_dir=None):
     """The Poisson trace through a manually-stepped `FleetRouter`:

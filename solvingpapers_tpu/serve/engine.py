@@ -2039,6 +2039,64 @@ class ServeEngine:
                     self._mon.observe_accept()
         return req
 
+    def replay_submit(self, prompt, max_new_tokens: int = 64, *,
+                      eos_id=_UNSET, params: SamplingParams | None = None,
+                      committed=()) -> Request:
+        """Shadow-traffic submission for the replay harness
+        (serve/replay.py): `submit`'s full validation and admission
+        with the side effects a re-serve must not have stripped out —
+        no deadline is armed, no WAL records are written (the engine
+        must be journal-off: shadow traffic written into a live
+        journal would replay itself on the next recovery), the
+        recorded `params.max_tokens` never overrides the harness's
+        explicit budget (replay budgets to the RECORDED stream length
+        so comparisons stay prefix-aligned), and an SLO tag this
+        engine does not track is dropped instead of rejected
+        (`_entry_request`'s rule: the class is accounting, not
+        semantics).
+
+        `committed` pre-loads the request with recorded tokens,
+        pinning the recorded seed chain through the preemption-resume
+        machinery: admission re-prefills prompt + committed[:-1],
+        discards the resampled token, and the next draw lands at
+        sample index ``len(committed)``. With ``max_new_tokens =
+        len(committed) + 1`` the engine produces exactly ONE token,
+        directly comparable to the recorded token at that offset —
+        the teacher-forced cut-replay primitive. Host-side only: the
+        resume path is the one recover()/adopt() already exercise, so
+        a replay-less engine compiles nothing new."""
+        if self.journal is not None:
+            raise ValueError(
+                "replay_submit needs a journal-off engine: shadow "
+                "traffic must not write WAL records (build the replay "
+                "engine from serve.replay.sanitize_config)"
+            )
+        params = params or SamplingParams()
+        if params.max_tokens is not None:
+            params = dataclasses.replace(params, max_tokens=None)
+        if params.slo is not None and (
+                self._slo is None or params.slo not in self._slo.targets):
+            params = dataclasses.replace(params, slo=None)
+        committed = [int(t) for t in committed]
+        if committed and len(committed) >= max_new_tokens:
+            raise ValueError(
+                f"committed prefix ({len(committed)} tokens) must leave "
+                f"budget to generate (max_new_tokens {max_new_tokens})"
+            )
+        req = self.submit(prompt, max_new_tokens, eos_id=eos_id,
+                          params=params)
+        if req.state == REJECTED:
+            raise ValueError(
+                "replay submission rejected "
+                f"({req.reject_reason or 'queue full'}) — size the "
+                "replay config's max_waiting to the corpus"
+            )
+        if committed:
+            # pre-step is the safe window: the request is queued but
+            # cannot be admitted until the owner's next step()
+            req.tokens = committed
+        return req
+
     def cancel(self, req: Request) -> None:
         """Cancel a request: a WAITING one leaves the queue and finishes
         "cancelled" immediately; an ACTIVE one keeps its lane until the
@@ -2482,6 +2540,7 @@ class ServeEngine:
         return {
             "serve/journal_records": float(s["records"]),
             "serve/journal_bytes": float(s["bytes_written"]),
+            "serve/journal_rotations": float(s["rotations"]),
             "serve/journal_fsync_s": s["fsync_s"],
             "serve/journal_live": float(s["live"]),
             "serve/journal_degraded": float(self._journal_degraded),

@@ -76,7 +76,7 @@ import threading
 import time
 from collections import OrderedDict
 
-__all__ = ["Journal", "JournalEntry", "JournalError"]
+__all__ = ["Journal", "JournalEntry", "JournalError", "read_entries"]
 
 
 class JournalError(RuntimeError):
@@ -105,6 +105,73 @@ class JournalEntry:
     finished: bool = False
     finish_reason: str | None = None
     usage: dict | None = None
+
+
+def read_entries(path: str, *, retries: int = 1,
+                 retry_delay_s: float = 0.05) -> list[JournalEntry]:
+    """Read-only snapshot of a journal file — the replay harness's
+    loader (`serve/replay.py`), safe against a LIVE writer on the same
+    path. Returns every reconstructible entry in arrival order, both
+    finished (tokens, outcome, usage folded in) and still-live ones;
+    the caller filters for its corpus.
+
+    Concurrency contract: one whole-file read. Appends are single
+    `write()` calls of newline-terminated lines, so the only partial
+    line a snapshot can see is the final one — tolerated exactly like
+    a crash-torn tail. Compaction (`Journal._rotate_locked`) swaps the
+    file via atomic tmp + rename; an open descriptor keeps reading the
+    pre-rotation inode, and the one observable race — the path briefly
+    unresolvable around the swap on non-POSIX rename semantics — is
+    absorbed by retrying ENOENT `retries` times before giving up.
+    Mid-file corruption still raises `JournalError`: only the tail can
+    legitimately be torn."""
+    for attempt in range(retries + 1):
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().split("\n")
+            break
+        except FileNotFoundError:
+            if attempt >= retries:
+                raise
+            time.sleep(retry_delay_s)
+    entries: OrderedDict[str, JournalEntry] = OrderedDict()
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i >= len(lines) - 2:
+                break  # torn tail: a crash or an in-flight append
+            raise JournalError(
+                f"{path}:{i + 1}: malformed journal record before the "
+                "final line — the file is corrupt, not merely torn"
+            ) from None
+        kind = rec.get("kind")
+        if kind == "submit":
+            e = JournalEntry(
+                rid=rec["rid"], prompt=list(rec["prompt"]),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                eos_id=rec.get("eos_id"), params=rec.get("params") or {},
+                arrival=float(rec.get("arrival", 0.0)),
+                grammar=bool(rec.get("grammar", False)),
+                deadline_s=rec.get("deadline_s"),
+                tokens=list(rec.get("tokens", ())),
+            )
+            # a reused rid (registry last-wins) replaces the old entry
+            entries[e.rid] = e
+        elif kind == "commit":
+            e = entries.get(rec["rid"])
+            if e is not None and not e.finished:
+                e.tokens.extend(int(t) for t in rec["tokens"])
+        elif kind == "finish":
+            e = entries.get(rec["rid"])
+            if e is not None and not e.finished:
+                e.finished = True
+                e.finish_reason = rec.get("reason")
+                e.usage = rec.get("usage")
+        # unknown kinds are skipped, the loader's forward-compat rule
+    return list(entries.values())
 
 
 class Journal:

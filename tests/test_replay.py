@@ -1,0 +1,460 @@
+"""Replay observatory tests (serve/replay.py + ServeEngine.replay_submit
++ journal.read_entries + the HTTP replay surface in serve/api.py).
+
+Contracts under test. Exactness: an identical-config replay of a
+journaled greedy + seeded-stochastic workload is byte-exact on BOTH
+pool layouts and scores agreement 1.0 (teacher-forced cuts pin the
+recorded seed chains via the committed-prefix path). Grading: a lossy
+int8-kv candidate produces a structurally complete report whose
+divergences carry first-divergence offsets — never a crash. Screening:
+unreplayable entries (grammar, stop strings without a detokenizer,
+kv_exact without lanes, tokenless, still-live) land as ``skipped`` with
+reasons, never divergences. Snapshot loading: a torn final line is
+tolerated; mid-file corruption raises; a journal rotating under a
+concurrent reader never tears a record, and the brief ENOENT window a
+non-POSIX rename can expose is absorbed by one retry. Zero cost when
+unused: a replay-less engine compiles the same program set whether or
+not replay traffic ran on a twin, and its metrics carry no replay/*
+keys. HTTP: POST /v1/replay runs bounded in the background and GET
+/v1/replay/<id> serves progress then the report; the replay/* gauges
+appear on the LIVE engine's /metrics only after a run finishes.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from solvingpapers_tpu.serve import (
+    ApiServer,
+    Journal,
+    JournalError,
+    ReplayHarness,
+    ServeConfig,
+    ServeEngine,
+    read_entries,
+)
+from solvingpapers_tpu.serve.replay import (
+    apply_overrides,
+    report_gauges,
+    sanitize_config,
+)
+from solvingpapers_tpu.serve.sampling import SamplingParams
+
+
+def _gpt_tiny():
+    from solvingpapers_tpu.models.gpt import GPT, GPTConfig
+
+    model = GPT(GPTConfig(vocab_size=64, block_size=64, dim=32,
+                          n_layers=2, n_heads=2, dropout=0.0))
+    params = model.init({"params": jax.random.key(0)},
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+_MODEL = None
+
+
+def _model():
+    global _MODEL
+    if _MODEL is None:
+        _MODEL = _gpt_tiny()
+    return _MODEL
+
+
+def _prompts(n, seed=0, size=8):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 64, size=size).astype(np.int32)
+            for _ in range(n)]
+
+
+def _cfg(**kw):
+    base = dict(n_slots=3, max_len=32, decode_block=4, bucket=8,
+                max_prefills_per_step=3)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _params_for(i):
+    """Greedy + seeded stochastic cycle: every stream byte-replayable."""
+    if i % 3 == 1:
+        return SamplingParams(temperature=0.8, top_p=0.9, seed=100 + i)
+    if i % 3 == 2:
+        return SamplingParams(temperature=1.3, top_k=8, seed=200 + i)
+    return None
+
+
+def _record(path, n=6, max_new=8, cfg=None, params_for=_params_for):
+    """Serve a small workload through a journaled engine and return the
+    (closed) engine's handles — the recorded reference streams."""
+    model, params = _model()
+    eng = ServeEngine(model, params,
+                      cfg or _cfg(journal_path=path))
+    hs = [eng.submit(p, max_new_tokens=max_new,
+                     params=params_for(i) if params_for else None)
+          for i, p in enumerate(_prompts(n))]
+    eng.run()
+    eng.journal.sync()
+    eng.close()
+    return hs
+
+
+# ------------------------------------------------------------ exactness
+
+
+@pytest.mark.parametrize("candidate_kw", [{}, {"paged": True,
+                                               "page_size": 8}])
+def test_identical_config_replay_byte_exact(tmp_path, candidate_kw):
+    path = str(tmp_path / "j.jsonl")
+    cfg = _cfg(journal_path=path, **candidate_kw)
+    _record(path, cfg=cfg)
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    entries = h.load(path)
+    report = h.run(entries, _cfg(**candidate_kw), cut_stride=4)
+    assert report["streams_total"] == 6
+    assert report["streams_compared"] == 6  # greedy + seeded, all
+    assert report["byte_exact_rate"] == 1.0, report["diverged"]
+    assert report["agreement_rate"] == 1.0
+    assert report["agreement_rate_greedy"] == 1.0
+    assert report["agreement_rate_seeded"] == 1.0
+    assert report["cut_positions"] > 0
+    assert not report["skipped"]
+    kinds = {r["kind"] for r in report["streams"]}
+    assert kinds == {"greedy", "seeded"}
+    assert report["replay_metrics"]["tokens_per_sec"] > 0
+
+
+def test_quant_candidate_graded_never_crashes(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _record(path)
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    report = h.run(h.load(path),
+                   _cfg(kv_quant="int8", kv_quant_block=8),
+                   cut_stride=4)
+    # lossy storage: byte exactness MAY break (that is the canary's
+    # point) but the report stays structurally complete and graded
+    assert report["streams_compared"] == 6
+    assert 0.0 <= report["byte_exact_rate"] <= 1.0
+    assert 0.0 <= report["agreement_rate"] <= 1.0
+    # per-kind split: the greedy score is the gated one, the seeded
+    # score discloses seed-chain sensitivity to the lossy candidate
+    assert 0.0 <= report["agreement_rate_greedy"] <= 1.0
+    assert 0.0 <= report["agreement_rate_seeded"] <= 1.0
+    for d in report["diverged"]:
+        assert 0 <= d["first_divergence"] <= d["recorded_tokens"]
+    if report["diverged"]:
+        assert report["first_divergence_p50"] is not None
+
+
+def test_baseline_deltas_and_max_requests(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _record(path)
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    report = h.run(h.load(path), _cfg(decode_block=8),
+                   baseline=_cfg(), cut_stride=0, max_requests=3)
+    assert report["streams_total"] == 3
+    assert report["agreement_rate"] is None  # cut pass disabled
+    assert "baseline_metrics" in report and "deltas" in report
+    assert any(k.endswith("_delta_pct") for k in report["deltas"])
+
+
+# ------------------------------------------------------------ screening
+
+
+def test_unreplayable_entries_become_skips(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    j.append_submit("gram", [1, 2], 4, None, {}, 0.0, grammar=True)
+    j.append_commit("gram", [5])
+    j.append_finish("gram", "length", {})
+    j.append_submit("stop", [1, 2], 4, None,
+                    {"temperature": 0.0, "stop": ["xy"]}, 0.1)
+    j.append_commit("stop", [5])
+    j.append_finish("stop", "stop", {})
+    j.append_submit("kvx", [1, 2], 4, None,
+                    {"temperature": 0.0, "kv_exact": True}, 0.2)
+    j.append_commit("kvx", [5])
+    j.append_finish("kvx", "length", {})
+    j.append_submit("none", [1, 2], 4, None, {}, 0.3)
+    j.append_finish("none", "length", {})
+    j.append_submit("live", [1, 2], 4, None, {}, 0.4)
+    j.append_commit("live", [5])
+    j.append_submit("ok", [1, 2], 4, None, {}, 0.5)
+    j.append_commit("ok", [5, 6])
+    j.append_finish("ok", "length", {})
+    j.sync()
+    j.close()
+    model, params = _model()
+    h = ReplayHarness(model, params)  # no detokenize
+    report = h.run(h.load(path), _cfg(kv_quant="int8", kv_quant_block=8),
+                   cut_stride=0)
+    reasons = {s["rid"]: s["reason"] for s in report["skipped"]}
+    assert "grammar" in reasons["gram"]
+    assert "detokenize" in reasons["stop"]
+    assert "kv_exact" in reasons["kvx"]
+    assert "no committed tokens" in reasons["none"]
+    assert "still live" in reasons["live"]
+    # skips are NEVER divergences; the one clean entry still replays
+    assert report["streams_replayed"] == 1
+    assert report["streams_compared"] == 1
+    assert all(s["rid"] != "ok" for s in report["skipped"])
+
+
+def test_empty_corpus_report_shape(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path)
+    j.append_submit("live", [1, 2], 4, None, {}, 0.0)
+    j.sync()
+    j.close()
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    report = h.run(h.load(path), _cfg())
+    assert report["streams_replayed"] == 0
+    assert report["byte_exact_rate"] is None
+    assert report["agreement_rate"] is None
+    # gauges omit the None aggregates rather than zero-filling
+    g = report_gauges(report)
+    assert "replay/byte_exact_rate" not in g
+    assert g["replay/streams_compared"] == 0.0
+
+
+# -------------------------------------------------------- snapshot load
+
+
+def test_torn_final_line_does_not_abort_replay(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _record(path, n=3)
+    with open(path, "a") as f:
+        f.write('{"kind":"commit","rid":"x","tok')  # crash-torn tail
+    entries = read_entries(path)
+    assert len(entries) == 3
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    report = h.run(entries, _cfg(), cut_stride=0)
+    assert report["byte_exact_rate"] == 1.0
+
+
+def test_midfile_corruption_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    _record(path, n=3)
+    lines = open(path).read().splitlines()
+    lines[1] = '{"kind": "comm'
+    open(path, "w").write("\n".join(lines) + "\n")
+    with pytest.raises(JournalError):
+        read_entries(path)
+
+
+def test_rotation_under_concurrent_reader(tmp_path):
+    """A journal compacting (atomic tmp+rename swap) while a reader
+    loops `read_entries` on the same path: every snapshot parses —
+    whole pre-rotation file or whole post-rotation file, never a
+    hybrid, never a torn record, never JournalError."""
+    path = str(tmp_path / "j.jsonl")
+    j = Journal(path, rotate_finished=4)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                for e in read_entries(path):
+                    assert e.rid.startswith("r")
+            except (JournalError, FileNotFoundError) as exc:
+                errors.append(exc)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(200):  # 200 finishes / rotate_finished=4 -> ~50
+            rid = f"r{i}"     # rotations under the reader's feet
+            j.append_submit(rid, [1, 2, 3], 8, None, {}, float(i))
+            j.append_commit(rid, [4, 5])
+            j.append_finish(rid, "length", {})
+            j.sync()
+    finally:
+        stop.set()
+        t.join()
+        j.close()
+    assert j.rotations > 10
+    assert not errors, errors[0]
+
+
+def test_enoent_during_swap_retried_once(tmp_path, monkeypatch):
+    """Non-POSIX rename semantics can expose a brief window where the
+    path resolves to nothing mid-swap; read_entries absorbs exactly
+    one, and still raises when the file is genuinely gone."""
+    path = str(tmp_path / "j.jsonl")
+    _record(path, n=3)
+    real_open = open
+    fails = {"n": 1}
+
+    def flaky_open(p, *a, **kw):
+        if p == path and fails["n"] > 0:
+            fails["n"] -= 1
+            raise FileNotFoundError(p)
+        return real_open(p, *a, **kw)
+
+    monkeypatch.setattr("builtins.open", flaky_open)
+    assert len(read_entries(path, retry_delay_s=0.0)) == 3
+    with pytest.raises(FileNotFoundError):
+        read_entries(str(tmp_path / "gone.jsonl"), retry_delay_s=0.0)
+
+
+# ------------------------------------------------- config plumbing
+
+
+def test_apply_overrides_and_sanitize():
+    cfg = _cfg()
+    out = apply_overrides(cfg, {"kv_quant": "int8", "decode_block": "8",
+                                "paged": "true"})
+    assert out.kv_quant == "int8" and out.decode_block == 8 and out.paged
+    with pytest.raises(ValueError, match="unknown ServeConfig field"):
+        apply_overrides(cfg, {"decode_blocks": 8})
+    s = sanitize_config(_cfg(journal_path="x.jsonl", api_port=0,
+                             max_waiting=4), n_requests=100)
+    assert s.journal_path is None and s.api_port is None
+    assert s.max_waiting == 101
+
+
+def test_replay_submit_contract(tmp_path):
+    model, params = _model()
+    jeng = ServeEngine(model, params,
+                       _cfg(journal_path=str(tmp_path / "j.jsonl")))
+    with pytest.raises(ValueError, match="journal-off"):
+        jeng.replay_submit(np.arange(4, dtype=np.int32))
+    jeng.close()
+    eng = ServeEngine(model, params, _cfg())
+    with pytest.raises(ValueError, match="budget"):
+        eng.replay_submit(np.arange(4, dtype=np.int32),
+                          max_new_tokens=2, committed=[1, 2])
+    # recorded max_tokens must not shadow the explicit replay budget
+    h = eng.replay_submit(np.arange(4, dtype=np.int32), max_new_tokens=3,
+                          params=SamplingParams(max_tokens=1))
+    eng.run()
+    assert len(h.tokens) == 3
+    eng.close()
+
+
+# ------------------------------------------------- zero cost when unused
+
+
+def test_replayless_engine_program_set_and_metrics_pinned():
+    model, params = _model()
+    cfg = _cfg(xla_obs=True)
+    plain = ServeEngine(model, params, cfg)
+    for i, p in enumerate(_prompts(4)):
+        plain.submit(p, max_new_tokens=6, params=_params_for(i))
+    plain.run()
+    plain_programs = set(plain.registry.snapshot()["programs"])
+    snap = plain.metrics.snapshot()
+    assert not any(k.startswith("replay/") for k in snap)
+    plain.close()
+
+    replay = ServeEngine(model, params, cfg)
+    hs = [replay.replay_submit(p, max_new_tokens=6,
+                               params=_params_for(i))
+          for i, p in enumerate(_prompts(4))]
+    # a teacher-forced cut through the committed-prefix resume path
+    replay.replay_submit(_prompts(1)[0], max_new_tokens=5,
+                         committed=[int(t) for t in hs[0].tokens[:4]])
+    replay.run()
+    assert set(replay.registry.snapshot()["programs"]) <= plain_programs
+    replay.close()
+
+
+# ----------------------------------------------------------------- http
+
+
+@pytest.fixture(scope="module")
+def replay_server(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("rj") / "j.jsonl")
+    model, params = _model()
+    eng = ServeEngine(model, params,
+                      _cfg(journal_path=path, api_port=0))
+    for i, p in enumerate(_prompts(4)):
+        eng.submit(p, max_new_tokens=6, params=_params_for(i))
+    eng.run()
+    eng.journal.sync()
+    srv = ApiServer(eng, model_name="gpt-tiny")
+    yield srv, eng, path
+    srv.close()
+
+
+def _http(srv, path, body=None, method=None):
+    req = urllib.request.Request(
+        srv.url(path),
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+        method=method or ("POST" if body is not None else "GET"),
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_replay_endpoint(replay_server):
+    srv, eng, _path = replay_server
+    code, doc = _http(srv, "/v1/replay", {"config_overrides": {"nope": 1}})
+    assert code == 400 and "nope" in doc["error"]["message"]
+    code, doc = _http(srv, "/v1/replay/absent0000", method="GET")
+    assert code == 404 and doc["error"]["code"] == "replay_not_found"
+
+    code, doc = _http(srv, "/v1/replay", {"cut_stride": 4})
+    assert code == 202, doc
+    rid = doc["id"]
+    deadline = 120.0
+    import time as _t
+    t0 = _t.monotonic()
+    while _t.monotonic() - t0 < deadline:
+        code, doc = _http(srv, f"/v1/replay/{rid}", method="GET")
+        assert code == 200
+        if doc["state"] != "running":
+            break
+        _t.sleep(0.05)
+    assert doc["state"] == "finished", doc.get("error")
+    rep = doc["report"]
+    assert rep["byte_exact_rate"] == 1.0, rep["diverged"]
+    assert rep["agreement_rate"] == 1.0
+    assert doc["progress"]["done"] == doc["progress"]["total"]
+    # the finished run's gauges ride the LIVE engine's metrics through
+    # the front door's provider (present only now that a run finished)
+    snap = eng.metrics.snapshot()
+    assert snap["replay/byte_exact_rate"] == 1.0
+    assert snap["replay/streams_compared"] == 4.0
+
+
+def test_http_replay_single_flight(replay_server):
+    srv, _eng, _path = replay_server
+    with srv._replay_lock:
+        srv._replay_active = True
+    try:
+        code, doc = _http(srv, "/v1/replay", {})
+        assert code == 409
+        assert doc["error"]["code"] == "replay_in_flight"
+    finally:
+        with srv._replay_lock:
+            srv._replay_active = False
+
+
+def test_report_gauge_contract(tmp_path):
+    assert report_gauges(None) == {}
+    path = str(tmp_path / "j.jsonl")
+    _record(path, n=3)
+    model, params = _model()
+    h = ReplayHarness(model, params)
+    g = report_gauges(h.run(h.load(path), _cfg(), cut_stride=4))
+    assert g["replay/byte_exact_rate"] == 1.0
+    assert g["replay/agreement_rate"] == 1.0
+    assert g["replay/streams_compared"] == 3.0
+    assert g["replay/wall_s"] > 0
+    assert "replay/first_divergence_p50" not in g  # no divergences

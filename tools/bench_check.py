@@ -58,7 +58,8 @@ BOOL_FIELDS = ("stream_token_exact", "greedy_token_exact",
                "survivors_token_exact", "zero_leak", "ladder_zero_leak",
                "slots_clean", "recovered_token_exact",
                "journal_degraded_exercised", "migrated_token_exact",
-               "fleet_token_exact", "trail_partition_ok")
+               "fleet_token_exact", "trail_partition_ok",
+               "replay_byte_exact")
 
 # name-pattern -> (kind, higher_is_better); first match wins.
 # kind: "pct" = absolute percentage-point band — overheads hover near 0
@@ -86,8 +87,12 @@ _RULES: tuple[tuple[tuple[str, ...], str, bool], ...] = (
     # deliver.
     (("attention_share_pct",), None, False),
     (("_share_pct",), "pct_scaled", False),
+    # quant_byte_exact_rate is the int8 candidate's DISCLOSED byte
+    # divergence — expected well below 1.0 and scale-dependent, so it
+    # rides the scale-gated rate band like agreement does (the
+    # never-flip identical-config story is `replay_byte_exact` above)
     (("agreement_rate", "acceptance_rate", "hit_rate", "attainment",
-      "goodput_ratio"), "rate", True),
+      "goodput_ratio", "byte_exact_rate"), "rate", True),
     (("requests_per_sec", "tokens_per_sec", "tokens_per_step",
       "speedup", "peak_active_slots", "streams_survived",
       "recovered_requests", "goodput_ladder_ratio", "_gbps"), "rel", True),

@@ -54,6 +54,15 @@ mid-decode, replay the journal through a fresh one, and record
 recovery_wall_s / recovered_requests / recovered_token_exact with the
 zero-leak drain invariant),
 
+and with `--replay --append` for the replay-observatory workload
+(journal a seeded greedy+stochastic workload on a briefly-trained
+model, replay it through serve/replay.py against the identical config
+on BOTH pool layouts — replay_byte_exact, the never-flip gate — and
+against an int8-kv candidate — replay_agreement_rate, the graded
+teacher-forced score held to the same >= 0.99 band as --kv-quant,
+with quant_byte_exact_rate / replay_first_divergence_p50 disclosing
+how fast byte exactness decays under the lossy candidate),
+
 and with `--fleet --append` for the fleet-serving workload (ABBA-paired
 1-replica FleetRouter vs bare engine req/s — router_overhead_pct, the
 pure routing tax — plus a drain-migration arm: a journaled 2-replica
@@ -101,7 +110,8 @@ def main() -> int:
             # value-taking flags also spell --flag=value
             return any(a == name or a.startswith(name + "=") for a in argv)
 
-        if flagged("--speculative") or flagged("--kv-quant"):
+        if (flagged("--speculative") or flagged("--kv-quant")
+                or "--replay" in argv):
             default = "gpt_tiny_long"
         elif "--shared-prefix" in argv or "--paged" in argv:
             default = "gpt_shakespeare"
